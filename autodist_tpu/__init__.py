@@ -1,0 +1,22 @@
+"""autodist_tpu: a TPU-native distributed training framework.
+
+Brand-new JAX/XLA/pjit/Pallas implementation of the capabilities of the
+reference AutoDist system (petuum/autodist): a declarative per-variable
+synchronization strategy IR, strategy builders/compiler, an SPMD backend that
+realizes strategies via sharding annotations + XLA collectives, a cluster
+runtime, and the "wrap single-device code, get distributed" UX.
+"""
+
+__version__ = "0.1.0"
+
+from autodist_tpu.const import ENV, IS_AUTODIST_CHIEF  # noqa: F401
+from autodist_tpu.resource_spec import ResourceSpec  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy imports keep `import autodist_tpu` light (no jax compile at import).
+    if name == "AutoDist":
+        from autodist_tpu.autodist import AutoDist
+
+        return AutoDist
+    raise AttributeError(f"module 'autodist_tpu' has no attribute {name!r}")

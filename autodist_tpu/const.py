@@ -1,0 +1,67 @@
+"""Constants and environment flags.
+
+TPU-native analog of the reference's ``autodist/const.py:32-89``: working
+directories, name-scope constants, and a typed ``ENV`` enum of environment
+variables used for cross-process (chief -> worker) configuration.
+"""
+import os
+from enum import Enum
+
+DEFAULT_WORKING_DIR = os.path.join(os.environ.get("TMPDIR", "/tmp"), "autodist_tpu")
+DEFAULT_SERIALIZATION_DIR = os.path.join(DEFAULT_WORKING_DIR, "strategies")
+DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
+DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
+DEFAULT_HLO_DUMP_DIR = os.path.join(DEFAULT_WORKING_DIR, "hlo")
+DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
+
+# Name used to prefix per-replica values in fetches/metrics (analog of the
+# reference's ``AUTODIST_REPLICA_PREFIX``, const.py:43-47).
+REPLICA_PREFIX = "autodist-replica"
+
+# Default coordinator port range for jax.distributed (reference used
+# 15000-16000 for tf.Server ports, const.py:38).
+DEFAULT_PORT_RANGE = (15000, 16000)
+DEFAULT_COORDINATOR_PORT = 15501
+
+# Default mesh axis names.  "replica" is the data-parallel axis (the only
+# axis the reference's strategies use); the others are forward-looking axes
+# for tensor/pipeline/sequence/expert parallelism (SURVEY.md section 2.8).
+AXIS_REPLICA = "replica"
+AXIS_MODEL = "model"
+AXIS_PIPELINE = "pipe"
+AXIS_SEQUENCE = "seq"
+AXIS_EXPERT = "expert"
+
+# Default bucket size (bytes) for gradient bucketing in the all-reduce
+# synchronizer -- the XLA-side analog of ScopedAllocator merging
+# (reference ``runner.py:41-45`` + ``all_reduce_strategy.py:61-66``).
+DEFAULT_BUCKET_BYTES = 32 * 1024 * 1024
+
+
+class ENV(Enum):
+    """Environment variables with typed accessors.
+
+    Mirrors reference ``autodist/const.py:55-89``: the chief configures worker
+    processes without RPC by setting these in the worker environment.
+    """
+
+    AUTODIST_WORKER = (lambda v: v or "",)
+    AUTODIST_STRATEGY_ID = (lambda v: v or "",)
+    AUTODIST_MIN_LOG_LEVEL = (lambda v: v or "INFO",)
+    AUTODIST_IS_TESTING = (lambda v: v == "True" or v == "1",)
+    AUTODIST_DEBUG_REMOTE = (lambda v: v == "True" or v == "1",)
+    AUTODIST_DUMP_HLO = (lambda v: v == "True" or v == "1",)
+    AUTODIST_PROCESS_ID = (lambda v: int(v) if v else 0,)
+    AUTODIST_NUM_PROCESSES = (lambda v: int(v) if v else 1,)
+    AUTODIST_COORDINATOR = (lambda v: v or "",)
+    SYS_DATA_PATH = (lambda v: v or "",)
+    SYS_RESOURCE_PATH = (lambda v: v or "",)
+
+    @property
+    def val(self):
+        """Return the typed value of this env var in the current process."""
+        (caster,) = self.value
+        return caster(os.environ.get(self.name))
+
+
+IS_AUTODIST_CHIEF = not ENV.AUTODIST_WORKER.val

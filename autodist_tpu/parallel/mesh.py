@@ -1,0 +1,78 @@
+"""Device-mesh construction from a ResourceSpec.
+
+The reference maps devices via TF device strings and a ClusterSpec
+(``autodist/cluster.py:70-82``); on TPU the analogous object is a
+``jax.sharding.Mesh`` over the slice's chips, with named axes.  The default
+mesh is 1-D over the data-parallel ``"replica"`` axis — the only axis the
+reference's strategy space uses (SURVEY.md section 2.8) — but the builder
+accepts arbitrary extra axes (model/pipe/seq/expert) for the forward-looking
+parallelism dimensions.
+"""
+import math
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from autodist_tpu.const import AXIS_REPLICA
+
+
+def _factorize(n, sizes):
+    """Resolve one -1 entry in `sizes` so the product equals n."""
+    sizes = list(sizes)
+    neg = [i for i, s in enumerate(sizes) if s == -1]
+    if len(neg) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    prod = math.prod(s for s in sizes if s != -1)
+    if neg:
+        if n % prod:
+            raise ValueError(f"Cannot infer axis: {n} devices not divisible by {prod}")
+        sizes[neg[0]] = n // prod
+    elif prod != n:
+        raise ValueError(f"Mesh axes {sizes} do not multiply to device count {n}")
+    return sizes
+
+
+def build_mesh(resource_spec=None, axes=None, devices=None):
+    """Build a ``jax.sharding.Mesh``.
+
+    Args:
+      resource_spec: optional ResourceSpec; its ``mesh:`` request (if any)
+        supplies the axes when `axes` is None; its accelerator count bounds
+        the device count.
+      axes: optional OrderedDict-like {axis_name: size}; size -1 = infer.
+        Defaults to ``{"replica": <all devices>}``.
+      devices: optional explicit list of jax devices.
+
+    The device order follows ``jax.devices()`` (process-major), so the
+    ``replica`` axis rides ICI within a host and DCN across hosts — the
+    layout that keeps the hot collectives on ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axes is None and resource_spec is not None and resource_spec.mesh_request:
+        axes = resource_spec.mesh_request
+    if resource_spec is not None:
+        n_spec = resource_spec.num_accelerators
+        if n_spec and n_spec < len(devices):
+            devices = devices[:n_spec]
+    if axes is None:
+        axes = {AXIS_REPLICA: len(devices)}
+    names = tuple(axes.keys())
+    sizes = _factorize(len(devices), list(axes.values()))
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, axis_names=names)
+
+
+def replica_axis(mesh):
+    """Name of the data-parallel axis (first axis by convention)."""
+    return AXIS_REPLICA if AXIS_REPLICA in mesh.axis_names else mesh.axis_names[0]
+
+
+def replicated_sharding(mesh):
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh, axis=None):
+    """Sharding for a batch: dim 0 split over the replica axis."""
+    return NamedSharding(mesh, P(axis or replica_axis(mesh)))

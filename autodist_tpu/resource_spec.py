@@ -1,0 +1,297 @@
+"""Resource specification for a TPU pod slice.
+
+TPU-native redesign of reference ``autodist/resource_spec.py`` (331 LoC).
+The reference parses a YAML of SSH-reachable GPU nodes; here a spec describes
+a TPU slice: hosts ("nodes"), chips per host, an optional ICI topology, and an
+optional explicit mesh request.  SSH configs are still parsed (reference
+``resource_spec.py:280-331``) because CPU-cluster emulation and remote launch
+use them, but the normal TPU launch path is ``jax.distributed.initialize``.
+
+YAML schema::
+
+    nodes:
+      - address: localhost        # host address
+        chips: [0, 1, 2, 3]       # TPU chip indices on this host
+        chief: true               # exactly one chief (defaults to first node)
+        ssh_config: conf          # optional, for remote launch
+        network_bandwidth: 100    # Gbps DCN bandwidth (default 1, with warning)
+      - address: 10.0.0.2
+        chips: [0, 1, 2, 3]
+    topology: "2x4"               # optional ICI topology string
+    mesh:                         # optional explicit mesh request
+      replica: 4
+      model: 2
+    ssh:
+      conf:
+        username: root
+        key_file: /root/.ssh/id_rsa
+        port: 22
+        python_venv: ''
+        shared_envs: {}
+
+``gpus:``/``cpus:`` keys are accepted as aliases of ``chips:`` so reference
+specs parse unchanged.
+"""
+import os
+from collections import OrderedDict, namedtuple
+from enum import Enum
+
+import yaml
+
+from autodist_tpu.utils import logging
+
+
+class ResourceSpecError(ValueError):
+    pass
+
+
+class DeviceType(Enum):
+    """Device categories in a spec (reference resource_spec.py DeviceType)."""
+
+    TPU = 0
+    CPU = 1
+    GPU = 2
+
+
+class DeviceSpec:
+    """One accelerator chip, named ``"<address>:<type>:<index>"``.
+
+    Analog of reference ``resource_spec.py:218-277`` whose canonical name is
+    ``"ip:GPU:0"``; ours is ``"host:TPU:0"``.
+    """
+
+    def __init__(self, address, device_index=0, device_type=DeviceType.TPU):
+        self.address = address
+        self.device_index = int(device_index)
+        self.device_type = device_type
+
+    def name_string(self):
+        return f"{self.address}:{self.device_type.name}:{self.device_index}"
+
+    @classmethod
+    def from_string(cls, name):
+        """Parse ``"host:TPU:0"`` / ``"host:GPU:1"`` / ``"host"`` (CPU:0)."""
+        parts = name.split(":")
+        if len(parts) == 1:
+            return cls(parts[0], 0, DeviceType.CPU)
+        if len(parts) == 3:
+            try:
+                dtype = DeviceType[parts[1].upper()]
+            except KeyError:
+                raise ResourceSpecError(f"Unknown device type in {name!r}")
+            return cls(parts[0], int(parts[2]), dtype)
+        raise ResourceSpecError(f"Cannot parse device string {name!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceSpec) and self.name_string() == other.name_string()
+
+    def __hash__(self):
+        return hash(self.name_string())
+
+    def __repr__(self):
+        return f"DeviceSpec({self.name_string()})"
+
+
+SSHConfig = namedtuple(
+    "SSHConfig", ["username", "port", "python_venv", "key_file", "pythonpath", "env"]
+)
+
+
+def _parse_ssh_group(conf):
+    return SSHConfig(
+        username=conf.get("username", ""),
+        port=int(conf.get("port", 22)),
+        python_venv=conf.get("python_venv", ""),
+        key_file=conf.get("key_file", ""),
+        pythonpath=conf.get("pythonpath", ""),
+        env=dict(conf.get("shared_envs", {}) or {}),
+    )
+
+
+class ResourceSpec:
+    """Parsed resource spec for a TPU slice (or CPU/GPU fallback cluster)."""
+
+    def __init__(self, resource_file=None, resource_info=None):
+        self._nodes = OrderedDict()  # address -> node dict
+        self._devices = OrderedDict()  # name string -> DeviceSpec
+        self._chief_address = None
+        self._ssh_configs = {}
+        self._bandwidths = {}
+        self._topology = None
+        self._mesh_request = None
+
+        if resource_file is not None:
+            if not os.path.exists(resource_file):
+                raise ResourceSpecError(f"Resource spec {resource_file} does not exist")
+            with open(resource_file) as f:
+                resource_info = yaml.safe_load(f)
+        if resource_info is None:
+            resource_info = self._local_resource_info()
+        self._from_resource_info(resource_info)
+        self._validate()
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def _local_resource_info():
+        """Auto-detect: one node, chips = local jax device count."""
+        import jax
+
+        n = jax.local_device_count()
+        return {"nodes": [{"address": "localhost", "chips": list(range(n)), "chief": True}]}
+
+    @classmethod
+    def from_num_chips(cls, n, address="localhost"):
+        return cls(resource_info={"nodes": [{"address": address, "chips": list(range(n)), "chief": True}]})
+
+    def _from_resource_info(self, info):
+        info = dict(info or {})
+        for group, conf in (info.get("ssh") or {}).items():
+            self._ssh_configs[group] = _parse_ssh_group(conf or {})
+        self._topology = info.get("topology")
+        self._mesh_request = info.get("mesh")
+        nodes = info.get("nodes") or []
+        if not nodes:
+            raise ResourceSpecError("Resource spec has no nodes")
+        for node in nodes:
+            self._parse_node(node, len(nodes))
+
+    def _parse_node(self, node, num_nodes):
+        address = str(node["address"])
+        if address in self._nodes:
+            raise ResourceSpecError(f"Duplicate node address {address}")
+        is_chief = bool(node.get("chief", False))
+        if is_chief:
+            if self._chief_address is not None:
+                raise ResourceSpecError("Only one node can be chief")
+            self._chief_address = address
+        # chips / tpus / gpus are aliases; cpus parse to CPU devices
+        chips = node.get("chips", node.get("tpus", node.get("gpus")))
+        dtype = DeviceType.GPU if ("gpus" in node and "chips" not in node and "tpus" not in node) else DeviceType.TPU
+        devices = []
+        if chips:
+            for idx in chips:
+                d = DeviceSpec(address, idx, dtype)
+                self._devices[d.name_string()] = d
+                devices.append(d)
+        for idx in node.get("cpus", []) or []:
+            d = DeviceSpec(address, idx, DeviceType.CPU)
+            self._devices[d.name_string()] = d
+            devices.append(d)
+        if not devices:
+            # A node with no listed accelerators contributes its CPU
+            d = DeviceSpec(address, 0, DeviceType.CPU)
+            self._devices[d.name_string()] = d
+            devices.append(d)
+        if "network_bandwidth" in node:
+            self._bandwidths[address] = float(node["network_bandwidth"])
+        else:
+            if num_nodes > 1:
+                logging.warning(
+                    "Network bandwidth for node %s not specified; defaulting to 1 Gbps", address
+                )
+            self._bandwidths[address] = 1.0
+        self._nodes[address] = {
+            "address": address,
+            "devices": devices,
+            "chief": is_chief,
+            "ssh_config": node.get("ssh_config"),
+        }
+
+    def _validate(self):
+        if self._chief_address is None:
+            if len(self._nodes) == 1:
+                self._chief_address = next(iter(self._nodes))
+                self._nodes[self._chief_address]["chief"] = True
+            else:
+                raise ResourceSpecError("Multi-node spec must mark exactly one node as chief")
+        # Loopback rule (reference resource_spec.py:185-208): localhost only
+        # valid in single-node specs.
+        local_names = {"localhost", "127.0.0.1"}
+        if len(self._nodes) > 1 and any(a in local_names for a in self._nodes):
+            raise ResourceSpecError("Loopback address not allowed in a multi-node spec")
+        # chips per node must be homogeneous for a TPU mesh
+        counts = {len(n["devices"]) for n in self._nodes.values()}
+        if len(counts) > 1:
+            logging.warning("Heterogeneous chip counts per node: %s", counts)
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def chief(self):
+        """Chief node address (reference resource_spec.py chief property)."""
+        return self._chief_address
+
+    @property
+    def nodes(self):
+        return list(self._nodes.keys())
+
+    @property
+    def node_addresses(self):
+        return list(self._nodes.keys())
+
+    @property
+    def devices(self):
+        """Iterable of (name_string, DeviceSpec), accelerators first."""
+        return self._devices.items()
+
+    @property
+    def tpu_devices(self):
+        return [(k, v) for k, v in self._devices.items() if v.device_type == DeviceType.TPU]
+
+    @property
+    def gpu_devices(self):
+        return [(k, v) for k, v in self._devices.items() if v.device_type == DeviceType.GPU]
+
+    @property
+    def cpu_devices(self):
+        return [(k, v) for k, v in self._devices.items() if v.device_type == DeviceType.CPU]
+
+    @property
+    def accelerator_devices(self):
+        return [(k, v) for k, v in self._devices.items() if v.device_type != DeviceType.CPU]
+
+    @property
+    def num_accelerators(self):
+        return len(self.accelerator_devices)
+
+    @property
+    def num_cpus(self):
+        return len(self.cpu_devices)
+
+    def node_devices(self, address):
+        return list(self._nodes[address]["devices"])
+
+    def network_bandwidth(self, address):
+        return self._bandwidths[address]
+
+    def ssh_config(self, address):
+        group = self._nodes[address].get("ssh_config")
+        if group is None:
+            return None
+        if group not in self._ssh_configs:
+            raise ResourceSpecError(f"Unknown ssh group {group!r} for node {address}")
+        return self._ssh_configs[group]
+
+    @property
+    def ssh_config_map(self):
+        return dict(self._ssh_configs)
+
+    @property
+    def topology(self):
+        return self._topology
+
+    @property
+    def mesh_request(self):
+        """Optional explicit {axis_name: size} mesh request from the YAML."""
+        return dict(self._mesh_request) if self._mesh_request else None
+
+    @property
+    def is_single_node(self):
+        return len(self._nodes) == 1
+
+    def __repr__(self):
+        return (
+            f"ResourceSpec(nodes={len(self._nodes)}, accelerators={self.num_accelerators}, "
+            f"chief={self._chief_address!r}, topology={self._topology!r})"
+        )

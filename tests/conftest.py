@@ -1,0 +1,41 @@
+"""Test configuration.
+
+Forces an 8-device virtual CPU platform (SURVEY.md section 4: the analog of
+the reference's two-local-tf.Server rig) BEFORE jax is imported anywhere, so
+multi-chip sharding is exercised without TPU hardware.  Also mirrors the
+reference's ``--run-integration`` gate (reference tests/conftest.py:4-16).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+
+# The image's sitecustomize may import jax at interpreter start (before this
+# file runs), in which case the env vars above are too late; force the
+# platform through the live config as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--run-integration",
+        action="store_true",
+        default=False,
+        help="run integration tests (slow, full end-to-end)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--run-integration"):
+        return
+    skip = pytest.mark.skip(reason="need --run-integration option to run")
+    for item in items:
+        if "integration" in item.keywords:
+            item.add_marker(skip)

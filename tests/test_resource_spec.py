@@ -1,0 +1,107 @@
+"""ResourceSpec tests (mirrors reference tests/test_resource_spec.py)."""
+import pytest
+
+from autodist_tpu.resource_spec import DeviceSpec, DeviceType, ResourceSpec, ResourceSpecError
+
+SINGLE = """
+nodes:
+  - address: localhost
+    chips: [0, 1, 2, 3]
+"""
+
+MULTI = """
+nodes:
+  - address: 10.0.0.1
+    chips: [0, 1, 2, 3]
+    chief: true
+    ssh_config: conf
+    network_bandwidth: 100
+  - address: 10.0.0.2
+    chips: [0, 1, 2, 3]
+    ssh_config: conf
+topology: "2x4"
+mesh:
+  replica: 4
+  model: 2
+ssh:
+  conf:
+    username: root
+    key_file: /root/.ssh/id_rsa
+    port: 2222
+"""
+
+GPU_COMPAT = """
+nodes:
+  - address: localhost
+    gpus: [0, 1]
+    cpus: [0]
+"""
+
+
+def _spec(tmp_path, text):
+    p = tmp_path / "spec.yml"
+    p.write_text(text)
+    return ResourceSpec(resource_file=str(p))
+
+
+def test_single_node(tmp_path):
+    r = _spec(tmp_path, SINGLE)
+    assert r.is_single_node
+    assert r.chief == "localhost"  # single node auto-chief
+    assert r.num_accelerators == 4
+    assert [k for k, _ in r.tpu_devices] == [f"localhost:TPU:{i}" for i in range(4)]
+
+
+def test_multi_node(tmp_path):
+    r = _spec(tmp_path, MULTI)
+    assert not r.is_single_node
+    assert r.chief == "10.0.0.1"
+    assert r.num_accelerators == 8
+    assert r.topology == "2x4"
+    assert r.mesh_request == {"replica": 4, "model": 2}
+    conf = r.ssh_config("10.0.0.1")
+    assert conf.username == "root" and conf.port == 2222
+
+
+def test_bandwidth_default_and_fix(tmp_path):
+    r = _spec(tmp_path, MULTI)
+    assert r.network_bandwidth("10.0.0.1") == 100.0
+    assert r.network_bandwidth("10.0.0.2") == 1.0  # default with warning
+
+
+def test_gpu_alias(tmp_path):
+    r = _spec(tmp_path, GPU_COMPAT)
+    assert len(r.gpu_devices) == 2
+    assert len(r.cpu_devices) == 1
+
+
+def test_multi_node_requires_chief(tmp_path):
+    bad = MULTI.replace("chief: true", "chief: false")
+    with pytest.raises(ResourceSpecError):
+        _spec(tmp_path, bad)
+
+
+def test_loopback_rejected_in_multi_node(tmp_path):
+    bad = MULTI.replace("10.0.0.2", "localhost")
+    with pytest.raises(ResourceSpecError):
+        _spec(tmp_path, bad)
+
+
+def test_missing_file():
+    with pytest.raises(ResourceSpecError):
+        ResourceSpec(resource_file="/nonexistent/spec.yml")
+
+
+def test_from_num_chips():
+    r = ResourceSpec.from_num_chips(8)
+    assert r.num_accelerators == 8 and r.is_single_node
+
+
+def test_device_spec_roundtrip():
+    d = DeviceSpec.from_string("host1:TPU:3")
+    assert d.address == "host1" and d.device_index == 3
+    assert d.device_type == DeviceType.TPU
+    assert d.name_string() == "host1:TPU:3"
+    assert DeviceSpec.from_string("host1") .device_type == DeviceType.CPU
+    with pytest.raises(ResourceSpecError):
+        DeviceSpec.from_string("a:b")
