@@ -12,11 +12,19 @@ __version__ = "0.1.0"
 from autodist_tpu.const import ENV, IS_AUTODIST_CHIEF  # noqa: F401
 from autodist_tpu.resource_spec import ResourceSpec  # noqa: F401
 
+_LAZY = {
+    "AutoDist": ("autodist_tpu.autodist", "AutoDist"),
+    "ModelItem": ("autodist_tpu.model_item", "ModelItem"),
+    "DistributedSession": ("autodist_tpu.runner", "DistributedSession"),
+    "embedding_lookup": ("autodist_tpu.ops.sparse", "embedding_lookup"),
+}
+
 
 def __getattr__(name):
-    # Lazy imports keep `import autodist_tpu` light (no jax compile at import).
-    if name == "AutoDist":
-        from autodist_tpu.autodist import AutoDist
+    # Lazy imports keep `import autodist_tpu` light (no jax work at import).
+    if name in _LAZY:
+        import importlib
 
-        return AutoDist
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
     raise AttributeError(f"module 'autodist_tpu' has no attribute {name!r}")

@@ -1,0 +1,116 @@
+"""AutoDist: the user entry point.
+
+Reference ``autodist/autodist.py:60-322``: one instance per process wraps a
+resource spec + strategy builder; ``scope()`` captures the model;
+``create_distributed_session()`` builds-or-loads the strategy (chief builds
+and serializes, workers deserialize by ``AUTODIST_STRATEGY_ID``), compiles
+it, transforms the graph and returns a wrapped session.
+
+TPU-native UX (no graph capture needed — models are functions)::
+
+    ad = AutoDist("resource_spec.yml", AllReduce())
+    sess = ad.distribute(loss_fn, params, optax.adam(1e-3))
+    for batch in data:
+        metrics = sess.run(batch)
+
+``loss_fn(params, batch[, rng]) -> loss`` is single-device code; the
+framework distributes it according to the strategy.
+"""
+from typing import Any, Callable, Optional, Sequence
+
+from autodist_tpu import const
+from autodist_tpu.const import ENV
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import Strategy, StrategyCompiler
+from autodist_tpu.utils import logging
+
+_DEFAULT_AUTODIST = {}
+
+
+def set_default_autodist(o):
+    """One AutoDist per process (reference autodist.py:43-57)."""
+    if _DEFAULT_AUTODIST and ENV.AUTODIST_IS_TESTING.val is False:
+        raise NotImplementedError("Only one AutoDist instance is supported per process")
+    _DEFAULT_AUTODIST["instance"] = o
+
+
+def get_default_autodist():
+    return _DEFAULT_AUTODIST.get("instance")
+
+
+class AutoDist:
+    def __init__(self, resource_spec_file=None, strategy_builder=None, *,
+                 resource_spec: Optional[ResourceSpec] = None):
+        set_default_autodist(self)
+        self._resource_spec = resource_spec or ResourceSpec(resource_spec_file)
+        if strategy_builder is None:
+            from autodist_tpu.strategy import PSLoadBalancing
+
+            strategy_builder = PSLoadBalancing()  # reference default, autodist.py:70
+        self._strategy_builder = strategy_builder
+        self._mesh = None
+
+    @property
+    def resource_spec(self):
+        return self._resource_spec
+
+    @property
+    def is_chief(self):
+        return const.IS_AUTODIST_CHIEF
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from autodist_tpu.parallel.mesh import build_mesh
+
+            self._mesh = build_mesh(self._resource_spec)
+        return self._mesh
+
+    # -- strategy lifecycle (reference autodist.py:100-118) ----------------
+
+    def _build_or_load_strategy(self, model_item) -> Strategy:
+        if self.is_chief:
+            strategy = self._strategy_builder.build(model_item, self._resource_spec)
+            strategy.serialize()
+            logging.info("Chief built strategy %s", strategy.id)
+        else:
+            sid = ENV.AUTODIST_STRATEGY_ID.val
+            if not sid:
+                raise RuntimeError("Worker process missing AUTODIST_STRATEGY_ID")
+            strategy = Strategy.deserialize(sid)
+            logging.info("Worker loaded strategy %s", strategy.id)
+        return strategy
+
+    def build_strategy(self, model_item) -> Strategy:
+        """Build (or load) + compile the strategy for a captured model."""
+        raw = self._build_or_load_strategy(model_item)
+        return StrategyCompiler(model_item, self._resource_spec).compile(raw)
+
+    # -- main entry --------------------------------------------------------
+
+    def distribute(
+        self,
+        loss_fn: Callable,
+        params: Any,
+        optimizer: Any,
+        *,
+        sparse_vars: Optional[Sequence[str]] = None,
+        has_aux: bool = False,
+        has_rng: bool = False,
+        rng=None,
+        name: str = "",
+        donate: bool = True,
+    ):
+        """Capture single-device code and return a distributed session."""
+        from autodist_tpu.kernel.graph_transformer import GraphTransformer
+        from autodist_tpu.runner import DistributedSession
+
+        item = ModelItem(loss_fn, params, optimizer, sparse_vars=sparse_vars,
+                         has_aux=has_aux, has_rng=has_rng, name=name)
+        strategy = self.build_strategy(item)
+        transformer = GraphTransformer(strategy, item, self.mesh)
+        return DistributedSession(transformer, rng=rng, donate=donate)
+
+    # parity alias with the reference's create_distributed_session
+    create_distributed_session = distribute

@@ -1,0 +1,42 @@
+"""Device-string resolution.
+
+Reference ``autodist/kernel/device/resolver.py:25-67`` maps AutoDist device
+strings (``"ip:GPU:0"``) to TF device strings (``"/job:worker/task:k/...:"``).
+Here the target namespace is mesh coordinates: a device string resolves to
+``"mesh:<flat_index>"`` — the linear index of that chip in the process-major
+global device order that :func:`autodist_tpu.parallel.mesh.build_mesh` uses.
+"""
+from autodist_tpu.resource_spec import DeviceSpec, DeviceType
+
+
+class DeviceResolver:
+    def __init__(self, resource_spec):
+        self._spec = resource_spec
+        # process-major ordering: nodes in spec order, chips in index order
+        self._flat = {}
+        i = 0
+        for name, _dev in resource_spec.accelerator_devices:
+            self._flat[name] = i
+            i += 1
+        if not self._flat:  # CPU-only cluster
+            for name, _dev in resource_spec.cpu_devices:
+                self._flat[name] = i
+                i += 1
+
+    def resolve(self, device_string):
+        """'host:TPU:0' -> 'mesh:<flat_index>'.  Already-resolved strings
+        pass through."""
+        if device_string.startswith("mesh:"):
+            return device_string
+        if device_string not in self._flat:
+            # tolerate bare addresses (PS destination = node's CPU in the
+            # reference); anchor at the node's first chip
+            d = DeviceSpec.from_string(device_string)
+            for name, dev in self._spec.devices:
+                if dev.address == d.address and name in self._flat:
+                    return f"mesh:{self._flat[name]}"
+            raise ValueError(f"Cannot resolve device {device_string!r}")
+        return f"mesh:{self._flat[device_string]}"
+
+    def flat_index(self, device_string):
+        return int(self.resolve(device_string).split(":", 1)[1])

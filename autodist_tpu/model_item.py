@@ -1,0 +1,175 @@
+"""ModelItem: the functional model IR.
+
+Replaces the reference's ``GraphItem`` (``autodist/graph_item.py:112-553``),
+which wraps a captured ``tf.Graph`` plus grad↔target pairs and variable
+``Info``.  In JAX the model is a pure function, so the IR is simply:
+
+- ``params``: a pytree of trainable arrays (named by tree path),
+- ``loss_fn(params, batch, rng) -> loss`` (or ``(loss, aux)``),
+- an optax ``optimizer`` (replaces the reference's monkey-patched optimizer
+  capture, ``graph_item.py:73-109`` / ``patch.py:80-88`` — functional
+  optimizers need no patching),
+- per-variable metadata (:class:`VariableInfo`) including which gradients are
+  sparse (the reference's ``IndexedSlices`` distinction that Parallax routing
+  depends on).
+
+Grad↔target pairs come for free: ``jax.grad`` returns a pytree isomorphic to
+``params``.
+"""
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.proto import modelitem_pb2
+
+
+def path_name(path) -> str:
+    """Render a jax tree path as a '/'-joined variable name."""
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        elif isinstance(p, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(p.key))
+        else:
+            parts.append(str(p))
+    return "/".join(parts) if parts else "param"
+
+
+@dataclasses.dataclass(frozen=True)
+class VariableInfo:
+    """Metadata for one trainable leaf (reference Info/VariableDef analog)."""
+
+    name: str
+    shape: tuple
+    dtype: Any
+    trainable: bool = True
+    sparse: bool = False
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def byte_size(self) -> int:
+        return self.size * np.dtype(self.dtype).itemsize
+
+
+class ModelItem:
+    """Captured model: params + loss + optimizer + variable metadata."""
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        params: Any,
+        optimizer: Any = None,
+        *,
+        sparse_vars: Optional[Sequence[str]] = None,
+        has_aux: bool = False,
+        has_rng: bool = False,
+        name: str = "",
+        batch_size_hint: int = 0,
+    ):
+        self.loss_fn = loss_fn
+        self.params = params
+        self.optimizer = optimizer
+        self.has_aux = has_aux
+        self.has_rng = has_rng
+        self.name = name
+        self.batch_size_hint = batch_size_hint
+        sparse_vars = set(sparse_vars or ())
+
+        leaves = jax.tree_util.tree_leaves_with_path(params)
+        self._var_infos = []
+        for path, leaf in leaves:
+            n = path_name(path)
+            self._var_infos.append(
+                VariableInfo(
+                    name=n,
+                    shape=tuple(leaf.shape),
+                    dtype=np.dtype(leaf.dtype),
+                    trainable=True,
+                    sparse=self._match_sparse(n, sparse_vars),
+                )
+            )
+        seen = set()
+        for v in self._var_infos:
+            if v.name in seen:
+                raise ValueError(
+                    f"Duplicate variable name {v.name!r}: distinct pytree paths "
+                    f"render to the same '/'-joined name; rename the colliding keys")
+            seen.add(v.name)
+        for pat in sparse_vars:
+            if not any(self._match_sparse(v.name, [pat]) for v in self._var_infos):
+                raise ValueError(f"sparse_vars entry {pat!r} matches no variable; have "
+                                 f"{[v.name for v in self._var_infos]}")
+
+    @staticmethod
+    def _match_sparse(name, patterns):
+        # Exact name, glob pattern, or whole trailing path segments — never a
+        # bare substring (so "emb" does not match "member").
+        import fnmatch
+
+        for pat in patterns:
+            if name == pat or fnmatch.fnmatchcase(name, pat):
+                return True
+            if name.endswith("/" + pat):
+                return True
+        return False
+
+    # -- variable metadata -------------------------------------------------
+
+    @property
+    def var_infos(self) -> Sequence[VariableInfo]:
+        return list(self._var_infos)
+
+    @property
+    def var_names(self):
+        return [v.name for v in self._var_infos]
+
+    def var_info(self, name) -> VariableInfo:
+        for v in self._var_infos:
+            if v.name == name:
+                return v
+        raise KeyError(name)
+
+    @property
+    def trainable_var_names(self):
+        return [v.name for v in self._var_infos if v.trainable]
+
+    # -- gradients ---------------------------------------------------------
+
+    def value_and_grad_fn(self):
+        """Return f(params, batch[, rng]) -> ((loss, aux), grads)."""
+        return jax.value_and_grad(self.loss_fn, has_aux=self.has_aux)
+
+    # -- serialization (modelitem.proto) -----------------------------------
+
+    def to_proto(self) -> modelitem_pb2.ModelItemDef:
+        d = modelitem_pb2.ModelItemDef()
+        for v in self._var_infos:
+            vd = d.variables.add()
+            vd.name = v.name
+            vd.shape[:] = list(v.shape)
+            vd.dtype = str(v.dtype)
+            vd.trainable = v.trainable
+            vd.sparse_gradient = v.sparse
+        if self.optimizer is not None:
+            d.optimizer_name = getattr(self.optimizer, "name", type(self.optimizer).__name__)
+        d.flagship_name = self.name
+        d.batch_size_hint = self.batch_size_hint
+        return d
+
+    def serialize(self) -> bytes:
+        return self.to_proto().SerializeToString()
+
+    def __repr__(self):
+        total = sum(v.size for v in self._var_infos)
+        return f"ModelItem(name={self.name!r}, vars={len(self._var_infos)}, params={total})"

@@ -1,0 +1,80 @@
+"""Sparse (embedding) gradient support.
+
+The reference threads ``tf.IndexedSlices`` through partitioner and
+synchronizers (``partitioner.py:_split_indexed_slices_v2``, PS sparse
+accumulators, the AllGather path in ``all_reduce_synchronizer.py:132-173``).
+JAX has no sparse-gradient type: the gradient of a gather is a dense
+scatter-add.  The TPU-native design moves the sparse *communication* into
+the lookup's backward pass instead:
+
+:func:`embedding_lookup` is a ``custom_vjp`` whose backward, when tracing
+inside the framework's SPMD step, all-gathers only the touched rows
+``(indices, row_grads)`` across the replica axis — O(batch x dim) on the
+wire instead of O(vocab x dim) — then scatter-adds locally into the dense
+gradient and divides by the replica count.  The resulting dense gradient is
+*already the global mean* on every device, so the graph transformer skips
+the dense collective for variables marked sparse ("pre-synchronized").
+
+Outside the SPMD step (no replica context), the lookup behaves exactly like
+``table[ids]`` with a local dense gradient.
+"""
+import contextlib
+import contextvars
+
+import jax
+import jax.numpy as jnp
+
+_REPLICA_AXIS = contextvars.ContextVar("autodist_tpu_replica_axis", default=None)
+
+
+@contextlib.contextmanager
+def replica_axis_context(axis_name):
+    """Set the mesh axis name that sparse backward passes synchronize over.
+    The graph transformer enters this while tracing the SPMD step."""
+    token = _REPLICA_AXIS.set(axis_name)
+    try:
+        yield
+    finally:
+        _REPLICA_AXIS.reset(token)
+
+
+def current_replica_axis():
+    return _REPLICA_AXIS.get()
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _make_lookup(tshape, tdtype):
+    @jax.custom_vjp
+    def lookup(table, ids):
+        return jnp.take(table, ids, axis=0)
+
+    def fwd(table, ids):
+        return jnp.take(table, ids, axis=0), ids
+
+    def bwd(ids, g):
+        axis_name = current_replica_axis()
+        flat_ids = ids.reshape(-1)
+        flat_g = g.reshape(-1, *tshape[1:]).astype(tdtype)
+        if axis_name is not None:
+            # sparse allgather: rows + indices travel, not the dense table
+            flat_ids = jax.lax.all_gather(flat_ids, axis_name, axis=0, tiled=True)
+            flat_g = jax.lax.all_gather(flat_g, axis_name, axis=0, tiled=True)
+        dense = jnp.zeros(tshape, tdtype).at[flat_ids].add(flat_g)
+        if axis_name is not None:
+            dense = dense / jax.lax.axis_size(axis_name)
+        return dense, None
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
+def embedding_lookup(table, ids):
+    """Gather rows of ``table`` by integer ``ids`` (any leading shape).
+
+    Use this for variables declared in ``sparse_vars``: its backward pass
+    performs the sparse synchronization (see module docstring).
+    """
+    return _make_lookup(tuple(table.shape), jnp.dtype(table.dtype).name)(table, ids)

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Regenerate protobuf python modules.  Run from the repo root:
+#   bash autodist_tpu/proto/gen.sh
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+protoc -I. --python_out=. \
+    autodist_tpu/proto/synchronizers.proto \
+    autodist_tpu/proto/strategy.proto \
+    autodist_tpu/proto/modelitem.proto
+echo "generated: autodist_tpu/proto/*_pb2.py"

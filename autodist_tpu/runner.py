@@ -1,0 +1,104 @@
+"""DistributedSession: the steady-state runtime.
+
+Reference ``autodist/runner.py`` (WrappedSession) + ``remapper.py``: the
+session remaps user feeds into per-replica placeholders (np.array_split on
+the polymorphic batch dim) and contracts fetches back to the master replica.
+TPU equivalent: a global batch array is sharded over the replica mesh axis
+(`jax.device_put` with a NamedSharding; on multi-host,
+``host_local_array_to_global_array``), the jitted SPMD step runs, and
+metrics come back replicated (fetch contraction = reading any shard).
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.kernel.partitioner import Placement
+from autodist_tpu.utils import logging
+
+
+class DistributedSession:
+    def __init__(self, transformer, rng=None, donate=True):
+        self._t = transformer
+        self._mesh = transformer.mesh
+        self._axis = transformer.axis
+        self.state = transformer.init_state(rng=rng)
+        self._step = transformer.make_train_step(donate=donate)
+        self._batch_sharding = NamedSharding(self._mesh, P(self._axis))
+        self._multi_host = jax.process_count() > 1
+
+    # -- feeds (reference remapper._remap_feed analog) ---------------------
+
+    def _shard_batch(self, batch):
+        # each process feeds its host-local slice; it must split across the
+        # devices this process contributes to the replica axis
+        denom = (jax.local_device_count() if self._multi_host
+                 else self._t.num_replicas)
+
+        def put(x):
+            x = np.asarray(x) if not isinstance(x, jax.Array) else x
+            if x.ndim == 0 or x.shape[0] % denom != 0:
+                raise ValueError(
+                    f"Batch leading dimension must be divisible by the "
+                    f"{'local device count' if self._multi_host else 'replica count'} "
+                    f"({denom}); got shape {x.shape}. Pad or trim the batch "
+                    f"(the reference's np.array_split uneven feed has no "
+                    f"SPMD equivalent).")
+            if self._multi_host:
+                from jax.experimental import multihost_utils
+
+                return multihost_utils.host_local_array_to_global_array(
+                    x, self._mesh, P(self._axis))
+            return jax.device_put(x, self._batch_sharding)
+
+        return jax.tree.map(put, batch)
+
+    # -- steady-state step (reference WrappedSession.run) ------------------
+
+    def run(self, batch, trace_dir=None):
+        """One training step on a global batch; returns metrics dict."""
+        gbatch = self._shard_batch(batch)
+        if trace_dir:
+            os.makedirs(trace_dir, exist_ok=True)
+            with jax.profiler.trace(trace_dir):
+                self.state, metrics = self._step(self.state, gbatch)
+                jax.block_until_ready(metrics)
+        else:
+            self.state, metrics = self._step(self.state, gbatch)
+        return metrics
+
+    def run_steps(self, batches, log_every=0):
+        metrics = None
+        for i, b in enumerate(batches):
+            metrics = self.run(b)
+            if log_every and (i + 1) % log_every == 0:
+                logging.info("step %d: loss=%s", i + 1, float(metrics["loss"]))
+        return metrics
+
+    # -- fetches (reference remapper._remap_fetch analog) ------------------
+
+    def params(self):
+        """Full, unpadded parameter pytree (replicated layout), as the
+        original single-device program would see it."""
+        t = self._t
+
+        def fetch(storage_leaf, plan):
+            if plan.placement == Placement.REPLICATED:
+                return storage_leaf
+            if plan.placement == Placement.SHARDED:
+                dim = plan.shape[plan.partition_axis]
+                return jax.lax.slice_in_dim(
+                    storage_leaf, 0, dim, axis=plan.partition_axis)
+            if plan.placement == Placement.DIVERGENT:
+                return jnp.mean(storage_leaf, axis=0)
+            raise ValueError(plan.placement)
+
+        plans_tree = t.treedef.unflatten([t.plans[n] for n in t.names])
+        fn = jax.jit(lambda s: jax.tree.map(fetch, s, plans_tree))
+        return jax.device_get(fn(self.state["params"]))
+
+    @property
+    def step(self):
+        return int(self.state["step"])

@@ -1,0 +1,12 @@
+"""Strategy builders (reference ``autodist/strategy/``)."""
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder, StrategyCompiler  # noqa: F401
+from autodist_tpu.strategy.ps_strategy import PS  # noqa: F401
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing  # noqa: F401
+from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS  # noqa: F401
+from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS  # noqa: F401
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce  # noqa: F401
+from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR  # noqa: F401
+from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import (  # noqa: F401
+    RandomAxisPartitionAR,
+)
+from autodist_tpu.strategy.parallax_strategy import Parallax  # noqa: F401

@@ -1,0 +1,175 @@
+"""Strategy wrapper, builder ABC and compiler.
+
+Analog of reference ``autodist/strategy/base.py``: the :class:`Strategy`
+wraps the protobuf message, serializes to a shared path so worker processes
+can load the chief-built plan by id (``base.py:78-99``); the
+:class:`StrategyCompiler` prunes non-trainable node configs and resolves
+device strings to mesh coordinates (``base.py:120-168``).
+"""
+import os
+import time
+from abc import ABC, abstractmethod
+
+from autodist_tpu.const import DEFAULT_SERIALIZATION_DIR
+from autodist_tpu.kernel.device.resolver import DeviceResolver
+from autodist_tpu.proto import strategy_pb2, synchronizers_pb2
+from autodist_tpu.utils import logging
+
+_COUNTER = [0]
+
+
+class Strategy:
+    """Wrapper around the ``Strategy`` proto message."""
+
+    def __init__(self, strategy_pb=None):
+        self._pb = strategy_pb or strategy_pb2.Strategy()
+        if not self._pb.id:
+            _COUNTER[0] += 1
+            self._pb.id = time.strftime("%Y%m%d%H%M%S") + f"-{os.getpid()}-{_COUNTER[0]}"
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def id(self):
+        return self._pb.id
+
+    @property
+    def proto(self):
+        return self._pb
+
+    @property
+    def node_config(self):
+        return self._pb.node_config
+
+    @property
+    def graph_config(self):
+        return self._pb.graph_config
+
+    def node_for(self, var_name):
+        for n in self._pb.node_config:
+            if n.var_name == var_name:
+                return n
+        return None
+
+    # -- serialization -----------------------------------------------------
+
+    @staticmethod
+    def _path(strategy_id):
+        return os.path.join(DEFAULT_SERIALIZATION_DIR, strategy_id)
+
+    def serialize(self, path=None):
+        path = path or self._path(self._pb.id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._pb.path = path
+        with open(path, "wb") as f:
+            f.write(self._pb.SerializeToString())
+        logging.debug("Serialized strategy %s to %s", self._pb.id, path)
+        return path
+
+    @classmethod
+    def deserialize(cls, strategy_id=None, path=None):
+        path = path or cls._path(strategy_id)
+        pb = strategy_pb2.Strategy()
+        with open(path, "rb") as f:
+            pb.ParseFromString(f.read())
+        return cls(pb)
+
+    def copy(self):
+        pb = strategy_pb2.Strategy()
+        pb.CopyFrom(self._pb)
+        pb.id = ""
+        s = Strategy.__new__(Strategy)
+        s._pb = pb
+        _COUNTER[0] += 1
+        pb.id = time.strftime("%Y%m%d%H%M%S") + f"-{os.getpid()}-{_COUNTER[0]}"
+        return s
+
+    def __str__(self):
+        return f"Strategy(id={self._pb.id}, nodes={len(self._pb.node_config)})"
+
+
+class StrategyBuilder(ABC):
+    """Maps (ModelItem, ResourceSpec) -> Strategy (reference base.py:102-117)."""
+
+    @abstractmethod
+    def build(self, model_item, resource_spec) -> Strategy:
+        raise NotImplementedError
+
+    # -- shared helpers ----------------------------------------------------
+
+    @staticmethod
+    def make_graph_config(strategy, resource_spec):
+        """Fill replicas (every accelerator) + default 1-D replica mesh."""
+        replicas = [k for k, _ in resource_spec.accelerator_devices]
+        if not replicas:
+            replicas = [k for k, _ in resource_spec.cpu_devices]
+        strategy.graph_config.replicas[:] = replicas
+        mesh_req = resource_spec.mesh_request
+        if mesh_req:
+            from autodist_tpu.parallel.mesh import _factorize
+
+            strategy.graph_config.mesh.axis_names[:] = list(mesh_req.keys())
+            strategy.graph_config.mesh.axis_sizes[:] = _factorize(
+                len(replicas), list(mesh_req.values())
+            )
+        else:
+            strategy.graph_config.mesh.axis_names[:] = ["replica"]
+            strategy.graph_config.mesh.axis_sizes[:] = [len(replicas)]
+
+
+_COMPRESSOR_ALIASES = {
+    # reference names (synchronizers.proto Compressor) -> TPU-native codecs
+    "NoneCompressor": synchronizers_pb2.AllReduceSynchronizer.NoneCompressor,
+    "HorovodCompressor": synchronizers_pb2.AllReduceSynchronizer.BF16Compressor,
+    "HorovodCompressorEF": synchronizers_pb2.AllReduceSynchronizer.BF16CompressorEF,
+    "BF16Compressor": synchronizers_pb2.AllReduceSynchronizer.BF16Compressor,
+    "BF16CompressorEF": synchronizers_pb2.AllReduceSynchronizer.BF16CompressorEF,
+    "Int8Compressor": synchronizers_pb2.AllReduceSynchronizer.Int8Compressor,
+    "Int8CompressorEF": synchronizers_pb2.AllReduceSynchronizer.Int8CompressorEF,
+}
+
+
+def resolve_compressor(name_or_value):
+    if isinstance(name_or_value, int):
+        return name_or_value
+    try:
+        return _COMPRESSOR_ALIASES[name_or_value]
+    except KeyError:
+        raise ValueError(
+            f"Unknown compressor {name_or_value!r}; valid: {sorted(_COMPRESSOR_ALIASES)}"
+        )
+
+
+class StrategyCompiler:
+    """Resolve + prune a strategy against the concrete cluster.
+
+    Reference ``base.py:120-168``: ``_prune_nodes`` drops configs for
+    variables without an update op (here: not present/trainable in the
+    ModelItem) and device strings resolve via :class:`DeviceResolver`.
+    """
+
+    def __init__(self, model_item=None, resource_spec=None):
+        self._model_item = model_item
+        self._resource_spec = resource_spec
+
+    def compile(self, strategy: Strategy) -> Strategy:
+        s = strategy.copy()
+        self._prune_nodes(s)
+        if self._resource_spec is not None:
+            resolver = DeviceResolver(self._resource_spec)
+            resolved = [resolver.resolve(r) for r in s.graph_config.replicas]
+            s.graph_config.replicas[:] = resolved
+        return s
+
+    def _prune_nodes(self, s):
+        if self._model_item is None:
+            return
+        trainable = set(self._model_item.trainable_var_names)
+        kept = [n for n in s.node_config if n.var_name in trainable]
+        dropped = [n.var_name for n in s.node_config if n.var_name not in trainable]
+        if dropped:
+            logging.debug("Pruned %d node configs without trainable vars: %s",
+                          len(dropped), dropped[:5])
+        del s.node_config[:]
+        for n in kept:
+            s.node_config.add().CopyFrom(n)

@@ -1,0 +1,43 @@
+"""Parallax hybrid: dense gradients -> AllReduce, sparse -> load-balanced PS.
+
+Reference ``autodist/strategy/parallax_strategy.py:24-71``, mirroring the
+Parallax paper (arXiv 1808.02621): dense tensors ride collectives; sparse
+(embedding-row) gradients go to byte-size-balanced parameter servers without
+a proxy (the gather path already materializes what it needs).
+"""
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import Strategy
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing, byte_size_load_fn
+
+
+class Parallax(AllReduce):
+    def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor",
+                 local_proxy_variable=False, sync=True, staleness=0):
+        super().__init__(chunk_size, all_reduce_spec, compressor)
+        self._local_replication = local_proxy_variable
+        self._sync = sync
+        self._staleness = staleness
+
+    def build(self, model_item, resource_spec):
+        s = Strategy()
+        self.make_graph_config(s.proto, resource_spec)
+        anchors = PSLoadBalancing._anchors(self, resource_spec)
+        loads = {a: 0.0 for a in anchors}
+        idx = 0
+        for v in model_item.var_infos:
+            if not v.trainable:
+                continue
+            n = s.node_config.add()
+            if v.sparse:
+                n.var_name = v.name
+                n.sparse = True
+                dest = min(loads, key=loads.get)
+                loads[dest] += byte_size_load_fn(v)
+                n.PSSynchronizer.reduction_destination = dest
+                n.PSSynchronizer.local_replication = self._local_replication
+                n.PSSynchronizer.sync = self._sync
+                n.PSSynchronizer.staleness = self._staleness
+            else:
+                self._fill_node(n, v, idx // self.chunk_size)
+                idx += 1
+        return s

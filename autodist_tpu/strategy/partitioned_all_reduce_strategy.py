@@ -1,0 +1,49 @@
+"""PartitionedAR: partition each variable along dim0, then all-reduce shards.
+
+Reference ``autodist/strategy/partitioned_all_reduce_strategy.py:26-131``:
+min-divisor split along dim0, each shard gets its own AllReduce config —
+for bandwidth-bound giant tensors, shard reductions can overlap.
+"""
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import Strategy
+from autodist_tpu.strategy.partitioned_ps_strategy import get_num_shards
+
+
+class PartitionedAR(AllReduce):
+    def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor",
+                 max_shards=None):
+        super().__init__(chunk_size, all_reduce_spec, compressor)
+        self._max_shards = max_shards
+
+    def _shards_for(self, v, num_devices):
+        cap = self._max_shards or num_devices
+        dim0 = v.shape[0] if v.shape else None
+        # sparse grads must keep dim0 whole per shard index semantics
+        return get_num_shards(dim0, cap), 0
+
+    def build(self, model_item, resource_spec):
+        s = Strategy()
+        self.make_graph_config(s.proto, resource_spec)
+        num_devices = max(1, resource_spec.num_accelerators)
+        idx = 0
+        for v in model_item.var_infos:
+            if not v.trainable:
+                continue
+            n = s.node_config.add()
+            k, axis = self._shards_for(v, num_devices)
+            if k <= 1 or v.sparse:
+                self._fill_node(n, v, idx // self.chunk_size)
+                idx += 1
+                continue
+            n.var_name = v.name
+            n.sparse = v.sparse
+            part = [1] * len(v.shape)
+            part[axis] = k
+            n.partition[:] = part
+            for i in range(k):
+                p = n.part_config.add()
+                shard_view = type("ShardView", (), {
+                    "name": f"{v.name}/part_{i}", "sparse": v.sparse})
+                self._fill_node(p, shard_view, idx // self.chunk_size)
+                idx += 1
+        return s

@@ -1,0 +1,36 @@
+"""PS strategy: every variable synchronized via sharded-state PS.
+
+Reference ``autodist/strategy/ps_strategy.py:21-76``: all variables go to one
+parameter server (the chief's CPU); replicas are every accelerator.  On TPU
+the "server" is the shard-owner set of the weight-update-sharded state; the
+``reduction_destination`` anchors shard 0 on the chief's first chip.
+"""
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+
+
+class PS(StrategyBuilder):
+    def __init__(self, local_proxy_variable=False, sync=True, staleness=0):
+        self._local_replication = local_proxy_variable
+        self._sync = sync
+        self._staleness = staleness
+        if staleness > 0:
+            assert sync, "staleness > 0 is the stale-sync mode and requires sync=True"
+
+    def build(self, model_item, resource_spec):
+        s = Strategy()
+        self.make_graph_config(s.proto, resource_spec)
+        # PS destination: chief node's first device (reference: first CPU)
+        chief = resource_spec.chief
+        anchor = next((k for k, d in resource_spec.accelerator_devices
+                       if d.address == chief), chief)
+        for v in model_item.var_infos:
+            if not v.trainable:
+                continue
+            n = s.node_config.add()
+            n.var_name = v.name
+            n.sparse = v.sparse
+            n.PSSynchronizer.reduction_destination = anchor
+            n.PSSynchronizer.local_replication = self._local_replication
+            n.PSSynchronizer.sync = self._sync
+            n.PSSynchronizer.staleness = self._staleness
+        return s

@@ -1,0 +1,34 @@
+"""RandomAxisPartitionAR: partition along a random eligible axis.
+
+Reference ``random_axis_partition_all_reduce_strategy.py:117-141``:
+``get_num_shards_and_axis`` picks a random axis among dims > 1 (dim0 forced
+for sparse gradients), shard count = min divisor of that dim.  Used by
+strategy search to explore the partition-axis dimension.
+"""
+import random
+
+from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+from autodist_tpu.strategy.partitioned_ps_strategy import get_num_shards
+
+
+def get_num_shards_and_axis(shape, max_shards, rng, sparse=False):
+    if not shape:
+        return 1, 0
+    if sparse:
+        return get_num_shards(shape[0], max_shards), 0
+    eligible = [i for i, d in enumerate(shape) if d > 1]
+    if not eligible:
+        return 1, 0
+    axis = rng.choice(eligible)
+    return get_num_shards(shape[axis], max_shards), axis
+
+
+class RandomAxisPartitionAR(PartitionedAR):
+    def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor",
+                 max_shards=None, seed=10000):
+        super().__init__(chunk_size, all_reduce_spec, compressor, max_shards)
+        self._rng = random.Random(seed)
+
+    def _shards_for(self, v, num_devices):
+        cap = self._max_shards or num_devices
+        return get_num_shards_and_axis(v.shape, cap, self._rng, v.sparse)

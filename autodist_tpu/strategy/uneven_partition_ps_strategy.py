@@ -1,0 +1,24 @@
+"""UnevenPartitionedPS: uneven shard counts (smallest NON-divisor).
+
+Reference ``autodist/strategy/uneven_partition_ps_strategy.py:126-135``: the
+shard count is the smallest integer > 1 that does NOT divide dim0, producing
+deliberately uneven splits (exercises the uneven-partition machinery; on TPU
+this is realized by pad-to-even sharding + masking in the partitioner).
+"""
+from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+
+
+def get_uneven_num_shards(dim0, max_shards):
+    if dim0 is None or dim0 <= 2:
+        return 1
+    for k in range(2, min(dim0, max_shards) + 1):
+        if dim0 % k != 0:
+            return k
+    return 1
+
+
+class UnevenPartitionedPS(PartitionedPS):
+    def _num_shards(self, v, num_anchors):
+        cap = self._max_shards or num_anchors
+        dim0 = v.shape[0] if v.shape else None
+        return get_uneven_num_shards(dim0, cap)

@@ -1,0 +1,46 @@
+"""Linear regression, the reference's first example
+(``/root/reference/examples/linear_regression.py``) rebuilt TPU-native:
+single-device loss fn + strategy builder -> distributed session.
+
+Run: python examples/linear_regression.py [strategy]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu import strategy as S
+
+TRUE_W, TRUE_B, N, EPOCHS = 3.0, 2.0, 1024, 200
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "AllReduce"
+    builder = getattr(S, name)()
+    ad = AutoDist(resource_spec=ResourceSpec(), strategy_builder=builder)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(N).astype(np.float32)
+    y = (x * TRUE_W + TRUE_B + rng.randn(N)).astype(np.float32)
+
+    def loss_fn(p, batch):
+        pred = batch["x"] * p["W"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    sess = ad.distribute(loss_fn, {"W": jnp.asarray(5.0), "b": jnp.asarray(0.0)},
+                         optax.sgd(0.05))
+    for epoch in range(EPOCHS):
+        m = sess.run({"x": x, "y": y})
+    p = sess.params()
+    print(f"strategy={name} loss={float(m['loss']):.4f} "
+          f"W={float(p['W']):.3f} (true {TRUE_W}) b={float(p['b']):.3f} (true {TRUE_B})")
+
+
+if __name__ == "__main__":
+    main()
