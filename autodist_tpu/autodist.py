@@ -98,6 +98,7 @@ class AutoDist:
         sparse_vars: Optional[Sequence[str]] = None,
         has_aux: bool = False,
         has_rng: bool = False,
+        mutable_state: Any = None,
         rng=None,
         name: str = "",
         donate: bool = True,
@@ -107,7 +108,8 @@ class AutoDist:
         from autodist_tpu.runner import DistributedSession
 
         item = ModelItem(loss_fn, params, optimizer, sparse_vars=sparse_vars,
-                         has_aux=has_aux, has_rng=has_rng, name=name)
+                         has_aux=has_aux, has_rng=has_rng,
+                         mutable_state=mutable_state, name=name)
         strategy = self.build_strategy(item)
         transformer = GraphTransformer(strategy, item, self.mesh)
         return DistributedSession(transformer, rng=rng, donate=donate)

@@ -1,0 +1,173 @@
+"""Cluster and process management.
+
+Reference layer (``autodist/cluster.py`` 374 LoC + ``coordinator.py`` +
+``utils/server_starter.py``): the chief SSH-launches a ``tf.Server`` on every
+node and re-executes the user script on every worker.  On TPU there are no
+parameter servers to start — every host runs the same SPMD program — so the
+layer reduces to:
+
+1. :class:`Cluster` — maps a ResourceSpec to the ``jax.distributed``
+   process group (coordinator address = chief:port, process ids in spec
+   node order) and initializes it.
+2. :class:`Coordinator` — chief-side launcher for clusters where hosts are
+   reachable by SSH (the reference's deployment model): re-executes the
+   user's own script on every worker with the env contract
+   ``AUTODIST_WORKER / AUTODIST_STRATEGY_ID / AUTODIST_PROCESS_ID /
+   AUTODIST_COORDINATOR`` (reference ``coordinator.py:46-90``), and
+   fail-fast monitors that kill the chief if any worker dies
+   (``coordinator.py:98-110``).
+
+On managed TPU pods (GKE/queued resources) the runtime launches every host
+itself; then only :meth:`Cluster.initialize` runs (workers detect their role
+from the env) and the Coordinator is unused.
+"""
+import os
+import shlex
+import subprocess
+import sys
+import threading
+
+from autodist_tpu.const import DEFAULT_COORDINATOR_PORT, ENV
+from autodist_tpu.utils import logging
+
+
+class Cluster:
+    """jax.distributed process-group bookkeeping for a ResourceSpec."""
+
+    def __init__(self, resource_spec, coordinator_port=DEFAULT_COORDINATOR_PORT):
+        self._spec = resource_spec
+        self._port = coordinator_port
+        self._procs = []
+        self._monitor_threads = []
+        self._terminating = False
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def coordinator_address(self):
+        addr = ENV.AUTODIST_COORDINATOR.val
+        return addr or f"{self._spec.chief}:{self._port}"
+
+    @property
+    def num_processes(self):
+        return len(self._spec.node_addresses)
+
+    @property
+    def process_id(self):
+        """This host's rank: spec node order, chief first by convention."""
+        worker = ENV.AUTODIST_WORKER.val
+        if not worker:
+            return 0
+        order = self._rank_order()
+        if worker not in order:
+            raise ValueError(f"AUTODIST_WORKER={worker!r} not in resource spec nodes")
+        return order.index(worker)
+
+    def _rank_order(self):
+        nodes = list(self._spec.node_addresses)
+        chief = self._spec.chief
+        return [chief] + [n for n in nodes if n != chief]
+
+    @property
+    def is_chief(self):
+        return self.process_id == 0
+
+    def initialize(self):
+        """Join the jax.distributed process group (no-op single node)."""
+        import jax
+
+        if self.num_processes <= 1:
+            return
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+        logging.info("jax.distributed initialized: rank %d/%d via %s",
+                     self.process_id, self.num_processes, self.coordinator_address)
+
+    # -- launch (SSH deployment model) -------------------------------------
+
+    def worker_env(self, worker_address, strategy_id):
+        """Env contract the chief hands to each worker (reference
+        coordinator.py:69-79)."""
+        rank = self._rank_order().index(worker_address)
+        env = {
+            "AUTODIST_WORKER": worker_address,
+            "AUTODIST_STRATEGY_ID": strategy_id or "",
+            "AUTODIST_PROCESS_ID": str(rank),
+            "AUTODIST_NUM_PROCESSES": str(self.num_processes),
+            "AUTODIST_COORDINATOR": self.coordinator_address,
+            "AUTODIST_MIN_LOG_LEVEL": ENV.AUTODIST_MIN_LOG_LEVEL.val,
+        }
+        ssh = self._spec.ssh_config(worker_address)
+        if ssh is not None:
+            env.update(ssh.env)
+        return env
+
+    def remote_command(self, worker_address, argv, env):
+        """Build the ssh command line re-executing `argv` on the worker
+        (reference cluster.py:316-345, via the openssh client instead of
+        paramiko)."""
+        ssh = self._spec.ssh_config(worker_address)
+        envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in sorted(env.items()))
+        py = sys.executable
+        if ssh is not None and ssh.python_venv:
+            py = f"{ssh.python_venv}/bin/python"
+        remote = f"{envs} {py} -u " + " ".join(shlex.quote(a) for a in argv)
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-tt"]
+        if ssh is not None:
+            if ssh.key_file:
+                cmd += ["-i", ssh.key_file]
+            if ssh.port:
+                cmd += ["-p", str(ssh.port)]
+            target = f"{ssh.username}@{worker_address}" if ssh.username else worker_address
+        else:
+            target = worker_address
+        cmd += [target, f"bash -c {shlex.quote(remote)}"]
+        return cmd
+
+    def launch_workers(self, strategy_id, argv=None):
+        """Chief only: re-execute the user script on every non-chief node."""
+        if not self.is_chief:
+            return
+        argv = argv or [os.path.abspath(sys.argv[0])] + sys.argv[1:]
+        for addr in self._rank_order()[1:]:
+            env = self.worker_env(addr, strategy_id)
+            cmd = self.remote_command(addr, argv, env)
+            logging.info("Launching worker on %s", addr)
+            proc = subprocess.Popen(cmd, start_new_session=True)
+            self._procs.append((addr, proc))
+            t = threading.Thread(target=self._monitor, args=(addr, proc), daemon=True)
+            t.start()
+            self._monitor_threads.append(t)
+
+    def _monitor(self, addr, proc):
+        """Fail fast: a dead worker kills the chief (reference
+        coordinator.py:98-110 uses os._exit(1)).  Intentional shutdown via
+        :meth:`terminate` must not count as a failure."""
+        code = proc.wait()
+        if code != 0 and not self._terminating:
+            logging.error("Worker %s exited with %d; terminating chief", addr, code)
+            os._exit(1)
+
+    def terminate(self):
+        self._terminating = True
+        for addr, proc in self._procs:
+            if proc.poll() is None:
+                proc.terminate()
+        self._procs = []
+
+
+class Coordinator:
+    """Chief-side orchestration: serialize strategy, launch workers, join
+    the process group (reference Coordinator + Cluster.start combined)."""
+
+    def __init__(self, resource_spec, coordinator_port=DEFAULT_COORDINATOR_PORT):
+        self.cluster = Cluster(resource_spec, coordinator_port)
+
+    def setup(self, strategy):
+        if self.cluster.num_processes > 1 and self.cluster.is_chief:
+            self.cluster.launch_workers(strategy.id)
+        self.cluster.initialize()
+        return self.cluster

@@ -159,24 +159,26 @@ class GraphTransformer:
                                     is_leaf=lambda x: isinstance(x, P))
         opt_state = jax.jit(opt.init, out_shardings=opt_sharding)(update0)
 
-        comp = {}
-        for key, base in ar_sync.init_compressor_states(self.buckets).items():
-            if isinstance(base, tuple):
-                comp[key] = ()
-            else:
-                # one residual per device: stack along the replica axis
-                comp[key] = jax.device_put(
-                    jnp.broadcast_to(base[None], (self.num_replicas,) + base.shape),
-                    NamedSharding(self.mesh, P(self.axis)))
+        comp = self.init_comp_states()
 
         rep = NamedSharding(self.mesh, P())
+
+        def fresh(tree):
+            # device_put aliases arrays that already live on-device with the
+            # right sharding; the step donates its state, so an aliased
+            # user-held array would be deleted out from under them.  A jit
+            # copy never aliases its inputs (and handles typed PRNG keys).
+            return jax.jit(lambda t: jax.tree.map(jnp.copy, t),
+                           out_shardings=rep)(tree)
+
         state = {
             "params": storage,
             "opt_state": opt_state,
             "comp": comp,
+            "mutable": (fresh(self.model_item.mutable_state)
+                        if self.model_item.mutable_state is not None else None),
             "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
-            "rng": jax.device_put(
-                rng if rng is not None else jax.random.PRNGKey(0), rep),
+            "rng": fresh(rng if rng is not None else jax.random.PRNGKey(0)),
         }
         return state
 
@@ -205,7 +207,7 @@ class GraphTransformer:
             x = jnp.pad(x, widths)
         return x
 
-    def _spmd_step(self, storage, opt_state, comp, step, rng, batch):
+    def _spmd_step(self, storage, opt_state, comp, mutable, step, rng, batch):
         axis = self.axis
         R = self.num_replicas
         my = jax.lax.axis_index(axis)
@@ -217,18 +219,38 @@ class GraphTransformer:
         full = self.treedef.unflatten(full_leaves)
 
         # 2. local gradients (sparse lookups sync inside their backward)
-        vag = jax.value_and_grad(self.model_item.loss_fn,
-                                 has_aux=self.model_item.has_aux)
+        item = self.model_item
+        has_mutable = item.mutable_state is not None
+
+        def loss_wrapper(p, *rest):
+            if has_mutable:
+                out = item.loss_fn(p, mutable, *rest)
+                if item.has_aux:
+                    loss_, (new_mut, aux_) = out
+                else:
+                    loss_, new_mut = out
+                    aux_ = {}
+                return loss_, (new_mut, aux_)
+            if item.has_aux:
+                return item.loss_fn(p, *rest)
+            return item.loss_fn(p, *rest), {}
+
+        vag = jax.value_and_grad(loss_wrapper, has_aux=True)
         args = (full, batch)
-        if self.model_item.has_rng:
+        if item.has_rng:
             step_rng = jax.random.fold_in(jax.random.fold_in(rng, step), my)
             args = args + (step_rng,)
         with replica_axis_context(axis):
-            if self.model_item.has_aux:
-                (loss, aux), grads = vag(*args)
+            if has_mutable:
+                (loss, (new_mutable, aux)), grads = vag(*args)
+                # cross-replica average of float statistics (e.g. BN stats)
+                new_mutable = jax.tree.map(
+                    lambda x: jax.lax.pmean(x, axis)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    new_mutable)
             else:
-                loss, grads = vag(*args)
-                aux = {}
+                (loss, aux), grads = vag(*args)
+                new_mutable = None
 
         g_leaves = self.treedef.flatten_up_to(grads)
         g_by_name = dict(zip(self.names, g_leaves))
@@ -310,7 +332,109 @@ class GraphTransformer:
             metrics[k] = jax.lax.pmean(v, axis)
 
         return (self.treedef.unflatten(new_storage), opt_new, comp_new,
-                step + 1, rng, metrics)
+                new_mutable, step + 1, rng, metrics)
+
+    def init_comp_states(self):
+        """Fresh per-device compressor residuals (zeroed)."""
+        comp = {}
+        for key, base in ar_sync.init_compressor_states(self.buckets).items():
+            if isinstance(base, tuple):
+                comp[key] = ()
+            else:
+                # one residual per device: stack along the replica axis
+                comp[key] = jax.device_put(
+                    jnp.broadcast_to(base[None], (self.num_replicas,) + base.shape),
+                    NamedSharding(self.mesh, P(self.axis)))
+        return comp
+
+    # -- canonical (single-device) forms for checkpointing -----------------
+
+    def _canon_leaf(self, leaf, plan):
+        """update-space array -> original param shape (global arrays)."""
+        if plan.placement == Placement.SHARDED:
+            dim = plan.shape[plan.partition_axis]
+            if leaf.shape[plan.partition_axis] != dim:
+                leaf = jax.lax.slice_in_dim(leaf, 0, dim, axis=plan.partition_axis)
+            return leaf
+        if plan.placement == Placement.DIVERGENT:
+            return jnp.mean(leaf, axis=0)
+        if plan.sync == SyncKind.PS:
+            n = int(np.prod(plan.shape)) if plan.shape else 1
+            return jnp.reshape(leaf[:n], plan.shape)
+        return leaf
+
+    def _uncanon_leaf(self, leaf, plan):
+        """original param shape -> update-space array (inverse of above)."""
+        R = self.num_replicas
+        if plan.placement == Placement.SHARDED:
+            pad = plan.padded_dim - leaf.shape[plan.partition_axis]
+            if pad:
+                widths = [(0, 0)] * leaf.ndim
+                widths[plan.partition_axis] = (0, pad)
+                leaf = jnp.pad(leaf, widths)
+            return leaf
+        if plan.placement == Placement.DIVERGENT:
+            return jnp.broadcast_to(leaf[None], (R,) + leaf.shape)
+        if plan.sync == SyncKind.PS:
+            n = leaf.size
+            npad = -(-n // R) * R
+            return jnp.zeros((npad,), leaf.dtype).at[:n].set(leaf.ravel())
+        return leaf
+
+    def _plans_boxed_tree(self):
+        return self.treedef.unflatten([_SpecBox(self.plans[n]) for n in self.names])
+
+    def canonicalize_opt_state(self, opt_state):
+        """Sharded optimizer state -> single-device-shaped state (the
+        reference Saver's 'original variable names/shapes' contract,
+        ``checkpoint/saver.py:50-58``)."""
+        boxed = self._plans_boxed_tree()
+        fn = jax.jit(lambda s: optax.tree_map_params(
+            self.model_item.optimizer,
+            lambda leaf, box: self._canon_leaf(leaf, box.spec),
+            s, boxed,
+            transform_non_params=lambda leaf: leaf,
+            is_leaf=lambda x: isinstance(x, _SpecBox)))
+        return fn(opt_state)
+
+    def uncanonicalize_opt_state(self, canonical):
+        boxed = self._plans_boxed_tree()
+        opt_spec = self._opt_spec_tree(jax.eval_shape(lambda s: s, canonical))
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), opt_spec,
+                                 is_leaf=lambda x: isinstance(x, P))
+        fn = jax.jit(lambda s: optax.tree_map_params(
+            self.model_item.optimizer,
+            lambda leaf, box: self._uncanon_leaf(leaf, box.spec),
+            s, boxed,
+            transform_non_params=lambda leaf: leaf,
+            is_leaf=lambda x: isinstance(x, _SpecBox)),
+            out_shardings=shardings)
+        return fn(canonical)
+
+    def canonicalize_params(self, storage):
+        """Storage tree -> original-shape param tree."""
+        plans_tree = self.treedef.unflatten([self.plans[n] for n in self.names])
+
+        def fetch(leaf, plan):
+            if plan.placement == Placement.REPLICATED:
+                return leaf
+            return self._canon_leaf(leaf, plan)
+
+        return jax.jit(lambda s: jax.tree.map(fetch, s, plans_tree))(storage)
+
+    def uncanonicalize_params(self, params):
+        plans_tree = self.treedef.unflatten([self.plans[n] for n in self.names])
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self.params_spec_tree("storage"),
+            is_leaf=lambda x: isinstance(x, P))
+
+        def to_storage(leaf, plan):
+            if plan.placement == Placement.REPLICATED:
+                return leaf
+            return self._uncanon_leaf(leaf, plan)
+
+        return jax.jit(lambda p: jax.tree.map(to_storage, p, plans_tree),
+                       out_shardings=shardings)(params)
 
     # -- public: build the jitted step ------------------------------------
 
@@ -321,23 +445,18 @@ class GraphTransformer:
         def step_fn(state, batch):
             opt_spec = self._opt_spec_tree(
                 jax.eval_shape(lambda s: s, state["opt_state"]))
-            in_specs = (
-                {"params": p_spec, "opt_state": opt_spec, "comp": comp_spec,
-                 "step": P(), "rng": P()},
-                P(self.axis),
-            )
-            out_specs = (
-                {"params": p_spec, "opt_state": opt_spec, "comp": comp_spec,
-                 "step": P(), "rng": P()},
-                P(),
-            )
+            state_spec = {"params": p_spec, "opt_state": opt_spec,
+                          "comp": comp_spec, "mutable": P(),
+                          "step": P(), "rng": P()}
+            in_specs = (state_spec, P(self.axis))
+            out_specs = (state_spec, P())
 
             def body(state_, batch_):
-                ns, no, nc, nstep, nrng, metrics = self._spmd_step(
+                ns, no, nc, nm, nstep, nrng, metrics = self._spmd_step(
                     state_["params"], state_["opt_state"], state_["comp"],
-                    state_["step"], state_["rng"], batch_)
+                    state_["mutable"], state_["step"], state_["rng"], batch_)
                 return ({"params": ns, "opt_state": no, "comp": nc,
-                         "step": nstep, "rng": nrng}, metrics)
+                         "mutable": nm, "step": nstep, "rng": nrng}, metrics)
 
             return jax.shard_map(
                 body, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,

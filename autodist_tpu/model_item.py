@@ -74,14 +74,22 @@ class ModelItem:
         sparse_vars: Optional[Sequence[str]] = None,
         has_aux: bool = False,
         has_rng: bool = False,
+        mutable_state: Any = None,
         name: str = "",
         batch_size_hint: int = 0,
     ):
+        """``loss_fn(params, batch[, rng]) -> loss`` (or ``(loss, aux)`` with
+        has_aux).  With ``mutable_state`` (non-trainable collections, e.g.
+        flax batch_stats — the reference's MUTABLE_STATE_OPS concept,
+        ``op_info.py``): ``loss_fn(params, state, batch[, rng]) ->
+        (loss, new_state)`` (or ``(loss, (new_state, aux))``); float leaves
+        of the new state are cross-replica averaged every step."""
         self.loss_fn = loss_fn
         self.params = params
         self.optimizer = optimizer
         self.has_aux = has_aux
         self.has_rng = has_rng
+        self.mutable_state = mutable_state
         self.name = name
         self.batch_size_hint = batch_size_hint
         sparse_vars = set(sparse_vars or ())

@@ -1,0 +1,143 @@
+"""BERT for masked-LM pretraining — the flagship model.
+
+Parity: the reference benchmark pretrains BERT
+(``/root/reference/examples/benchmark/bert.py`` with vendored modeling in
+``examples/benchmark/utils/``).  TPU-native choices: bf16 activations with
+f32 params, fused QKV projection (one MXU matmul), token embedding through
+:func:`autodist_tpu.ops.sparse.embedding_lookup` so embedding gradients ride
+the sparse all-gather path (the Parallax routing case).
+"""
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.ops.sparse import embedding_lookup
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+
+BERT_BASE = BertConfig()
+BERT_LARGE = BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                        intermediate_size=4096)
+BERT_TINY = BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                       num_heads=2, intermediate_size=512, max_position=128)
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic):
+        c = self.config
+        head_dim = c.hidden_size // c.num_heads
+        # fused QKV: one big matmul keeps the MXU busy
+        qkv = nn.Dense(3 * c.hidden_size, dtype=c.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, S = x.shape[0], x.shape[1]
+        shape = (B, S, c.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
+        y = jax.nn.dot_product_attention(q, k, v, bias=bias)
+        y = y.reshape(B, S, c.hidden_size)
+        return nn.Dense(c.hidden_size, dtype=c.dtype, name="out")(y)
+
+
+class TransformerLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic):
+        c = self.config
+        y = SelfAttention(c, name="attention")(x, mask, deterministic)
+        y = nn.Dropout(c.dropout_rate)(y, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x + y)
+        y = nn.Dense(c.intermediate_size, dtype=c.dtype, name="mlp_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_out")(y)
+        y = nn.Dropout(c.dropout_rate)(y, deterministic=deterministic)
+        return nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x + y)
+
+
+class Bert(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic=True):
+        c = self.config
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), jnp.bool_)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((B, S), jnp.int32)
+        word_emb = self.param("word_embeddings", nn.initializers.normal(0.02),
+                              (c.vocab_size, c.hidden_size), jnp.float32)
+        x = embedding_lookup(word_emb, input_ids)
+        pos_emb = self.param("position_embeddings", nn.initializers.normal(0.02),
+                             (c.max_position, c.hidden_size), jnp.float32)
+        type_emb = self.param("type_embeddings", nn.initializers.normal(0.02),
+                              (c.type_vocab_size, c.hidden_size), jnp.float32)
+        x = x + pos_emb[None, :S] + jnp.take(type_emb, token_type_ids, axis=0)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_emb")(x.astype(c.dtype))
+        x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
+        for i in range(c.num_layers):
+            x = TransformerLayer(c, name=f"layer_{i}")(x, attention_mask,
+                                                       deterministic)
+        return x, word_emb
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + next-sentence heads (reference bert pretraining objective)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic=True):
+        c = self.config
+        x, word_emb = Bert(c, name="bert")(input_ids, token_type_ids,
+                                           attention_mask, deterministic)
+        # MLM head: transform + tied output embedding
+        h = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlm_transform")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=c.dtype, name="mlm_ln")(h)
+        mlm_logits = (h.astype(jnp.float32) @ word_emb.T
+                      + self.param("mlm_bias", nn.initializers.zeros,
+                                   (c.vocab_size,), jnp.float32))
+        # NSP head on [CLS]
+        pooled = jnp.tanh(nn.Dense(c.hidden_size, dtype=c.dtype,
+                                   name="pooler")(x[:, 0]))
+        nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp")(
+            pooled.astype(jnp.float32))
+        return mlm_logits, nsp_logits
+
+
+def pretraining_loss(mlm_logits, nsp_logits, batch):
+    """Masked-LM cross entropy (over masked positions) + NSP loss."""
+    labels = batch["labels"]           # (B, S), -100 = unmasked
+    mask = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    logp = jax.nn.log_softmax(mlm_logits, axis=-1)
+    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    mlm_loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    nsp_loss = 0.0
+    if "next_sentence_label" in batch:
+        nlogp = jax.nn.log_softmax(nsp_logits, axis=-1)
+        nsp_loss = -jnp.mean(
+            jnp.take_along_axis(nlogp, batch["next_sentence_label"][:, None],
+                                axis=-1))
+    return mlm_loss + nsp_loss

@@ -1,0 +1,58 @@
+"""DenseNet-121 (reference benchmark model, imagenet.py DenseNet121)."""
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+    norm: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        y = self.norm()(x)
+        y = nn.relu(y)
+        y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.growth_rate, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class DenseNet(nn.Module):
+    block_sizes: Sequence[int] = (6, 12, 24, 16)
+    growth_rate: int = 32
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_layers in enumerate(self.block_sizes):
+            for _ in range(n_layers):
+                x = DenseLayer(self.growth_rate, norm=norm, dtype=self.dtype)(x)
+            if i != len(self.block_sizes) - 1:
+                x = norm()(x)
+                x = nn.relu(x)
+                x = nn.Conv(x.shape[-1] // 2, (1, 1), use_bias=False, dtype=self.dtype)(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = norm()(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+DenseNet121 = partial(DenseNet, block_sizes=(6, 12, 24, 16))
+DenseNet169 = partial(DenseNet, block_sizes=(6, 12, 32, 32))

@@ -1,0 +1,133 @@
+"""InceptionV3 (reference benchmark model, imagenet.py InceptionV3).
+
+Compact faithful InceptionV3: stem + inception blocks A/B/C with grid
+reductions, BN everywhere, 299x299 inputs (224 also works).
+"""
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: Any = "SAME"
+    norm: Any = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, self.strides, padding=self.padding,
+                    use_bias=False, dtype=self.dtype)(x)
+        x = self.norm()(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    norm: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, norm=self.norm, dtype=self.dtype)
+        b1 = c(64, (1, 1))(x)
+        b2 = c(64, (5, 5))(c(48, (1, 1))(x))
+        b3 = c(96, (3, 3))(c(96, (3, 3))(c(64, (1, 1))(x)))
+        b4 = c(self.pool_features, (1, 1))(nn.avg_pool(x, (3, 3), (1, 1), "SAME"))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    norm: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, norm=self.norm, dtype=self.dtype)
+        b1 = c(384, (3, 3), (2, 2), "VALID")(x)
+        b2 = c(96, (3, 3), (2, 2), "VALID")(c(96, (3, 3))(c(64, (1, 1))(x)))
+        b3 = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    channels_7x7: int
+    norm: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, norm=self.norm, dtype=self.dtype)
+        cc = self.channels_7x7
+        b1 = c(192, (1, 1))(x)
+        b2 = c(192, (7, 1))(c(cc, (1, 7))(c(cc, (1, 1))(x)))
+        b3 = c(192, (1, 7))(c(cc, (7, 1))(c(cc, (1, 7))(c(cc, (7, 1))(c(cc, (1, 1))(x)))))
+        b4 = c(192, (1, 1))(nn.avg_pool(x, (3, 3), (1, 1), "SAME"))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    norm: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, norm=self.norm, dtype=self.dtype)
+        b1 = c(320, (3, 3), (2, 2), "VALID")(c(192, (1, 1))(x))
+        b2 = c(192, (3, 3), (2, 2), "VALID")(
+            c(192, (7, 1))(c(192, (1, 7))(c(192, (1, 1))(x))))
+        b3 = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    norm: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, norm=self.norm, dtype=self.dtype)
+        b1 = c(320, (1, 1))(x)
+        b2m = c(384, (1, 1))(x)
+        b2 = jnp.concatenate([c(384, (1, 3))(b2m), c(384, (3, 1))(b2m)], axis=-1)
+        b3m = c(384, (3, 3))(c(448, (1, 1))(x))
+        b3 = jnp.concatenate([c(384, (1, 3))(b3m), c(384, (3, 1))(b3m)], axis=-1)
+        b4 = c(192, (1, 1))(nn.avg_pool(x, (3, 3), (1, 1), "SAME"))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       epsilon=1e-3, dtype=self.dtype)
+        c = partial(ConvBN, norm=norm, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = c(32, (3, 3), (2, 2), "VALID")(x)
+        x = c(32, (3, 3), (1, 1), "VALID")(x)
+        x = c(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        x = c(80, (1, 1), (1, 1), "VALID")(x)
+        x = c(192, (3, 3), (1, 1), "VALID")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), "VALID")
+        x = InceptionA(32, norm=norm, dtype=self.dtype)(x)
+        x = InceptionA(64, norm=norm, dtype=self.dtype)(x)
+        x = InceptionA(64, norm=norm, dtype=self.dtype)(x)
+        x = ReductionA(norm=norm, dtype=self.dtype)(x)
+        x = InceptionB(128, norm=norm, dtype=self.dtype)(x)
+        x = InceptionB(160, norm=norm, dtype=self.dtype)(x)
+        x = InceptionB(160, norm=norm, dtype=self.dtype)(x)
+        x = InceptionB(192, norm=norm, dtype=self.dtype)(x)
+        x = ReductionB(norm=norm, dtype=self.dtype)(x)
+        x = InceptionC(norm=norm, dtype=self.dtype)(x)
+        x = InceptionC(norm=norm, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,51 @@
+"""LSTM language model (reference ``examples/lm1b`` parity).
+
+The lm1b example trains an LSTM LM with a big sharded embedding table under
+the PS strategy (``lm1b_train.py:23,62``); here the table goes through the
+sparse lookup so PartitionedPS shards it.  The recurrence is a
+``lax.scan``-based LSTM via flax's optimized cell — compiler-friendly (no
+Python loops in the graph).
+"""
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.ops.sparse import embedding_lookup
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    vocab_size: int = 10000
+    embed_dim: int = 512
+    hidden_dim: int = 1024
+    num_layers: int = 2
+    dtype: Any = jnp.float32
+
+
+class LSTMLM(nn.Module):
+    config: LMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        c = self.config
+        emb = self.param("embedding", nn.initializers.normal(0.05),
+                         (c.vocab_size, c.embed_dim), jnp.float32)
+        x = embedding_lookup(emb, tokens).astype(c.dtype)
+        for i in range(c.num_layers):
+            cell = nn.OptimizedLSTMCell(c.hidden_dim, dtype=c.dtype,
+                                        name=f"lstm_{i}")
+            B = x.shape[0]
+            carry = cell.initialize_carry(jax.random.PRNGKey(0), (B, x.shape[-1]))
+            scan = nn.RNN(cell, name=f"rnn_{i}")
+            x = scan(x)
+        logits = nn.Dense(c.vocab_size, dtype=jnp.float32, name="softmax")(x)
+        return logits
+
+
+def lm_loss(logits, targets):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -1,0 +1,51 @@
+"""Neural Collaborative Filtering (reference ``examples/benchmark/ncf.py``).
+
+NeuMF = GMF + MLP towers over user/item embeddings; both embedding tables go
+through the sparse lookup (the dense-vs-sparse stress model in
+BASELINE.json configs).
+"""
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from autodist_tpu.ops.sparse import embedding_lookup
+
+
+@dataclasses.dataclass(frozen=True)
+class NCFConfig:
+    num_users: int = 138_000
+    num_items: int = 27_000
+    mf_dim: int = 64
+    mlp_dims: Sequence[int] = (256, 128, 64)
+    dtype: Any = jnp.float32
+
+
+class NeuMF(nn.Module):
+    config: NCFConfig
+
+    @nn.compact
+    def __call__(self, user_ids, item_ids):
+        c = self.config
+        init = nn.initializers.normal(0.01)
+        mf_user = self.param("mf_user_embedding", init, (c.num_users, c.mf_dim))
+        mf_item = self.param("mf_item_embedding", init, (c.num_items, c.mf_dim))
+        mlp_user = self.param("mlp_user_embedding", init,
+                              (c.num_users, c.mlp_dims[0] // 2))
+        mlp_item = self.param("mlp_item_embedding", init,
+                              (c.num_items, c.mlp_dims[0] // 2))
+        gmf = embedding_lookup(mf_user, user_ids) * embedding_lookup(mf_item, item_ids)
+        x = jnp.concatenate([embedding_lookup(mlp_user, user_ids),
+                             embedding_lookup(mlp_item, item_ids)], axis=-1)
+        for d in c.mlp_dims:
+            x = nn.relu(nn.Dense(d, dtype=c.dtype)(x))
+        x = jnp.concatenate([gmf, x], axis=-1)
+        return nn.Dense(1, dtype=jnp.float32, name="prediction")(x)[..., 0]
+
+
+def ncf_loss(logits, labels):
+    """Binary cross entropy on implicit-feedback labels."""
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits))))

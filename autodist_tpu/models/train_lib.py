@@ -1,0 +1,99 @@
+"""Glue: wrap a flax model into the (loss_fn, params, ...) capture that
+``AutoDist.distribute`` expects — the analog of the reference benchmark
+harness's model-to-train-loop wiring (``examples/benchmark/imagenet.py``).
+"""
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+
+def classifier_capture(model, input_shape, rng=None, with_batch_stats=True):
+    """Init a flax image classifier; returns (loss_fn, params, mutable_state).
+
+    ``loss_fn`` follows the framework convention for models with mutable
+    state: ``loss_fn(params, state, batch) -> (loss, new_state)``.
+    """
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1,) + tuple(input_shape)), train=False)
+    params = variables["params"]
+    state = {k: v for k, v in variables.items() if k != "params"}
+
+    if state and with_batch_stats:
+        def loss_fn(p, s, batch):
+            logits, new_s = model.apply(
+                {"params": p, **s}, batch["image"], train=True,
+                mutable=list(s.keys()))
+            return softmax_cross_entropy(logits, batch["label"]), new_s
+
+        return loss_fn, params, state
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["image"], train=True)
+        return softmax_cross_entropy(logits, batch["label"])
+
+    return loss_fn, params, None
+
+
+def bert_capture(config, seq_len, rng=None):
+    """Init BertForPreTraining; returns (loss_fn, params, sparse_vars).
+
+    ``loss_fn(params, batch, rng)`` — dropout needs the per-device rng the
+    framework threads with ``has_rng=True``.
+    """
+    from autodist_tpu.models.bert import BertForPreTraining, pretraining_loss
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = BertForPreTraining(config)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(rng, dummy, deterministic=True)["params"]
+
+    def loss_fn(p, batch, step_rng):
+        mlm, nsp = model.apply(
+            {"params": p}, batch["input_ids"],
+            token_type_ids=batch.get("token_type_ids"),
+            attention_mask=batch.get("attention_mask"),
+            deterministic=False, rngs={"dropout": step_rng})
+        return pretraining_loss(mlm, nsp, batch)
+
+    return loss_fn, params, ["bert/word_embeddings"]
+
+
+def lm_capture(config, seq_len, rng=None):
+    from autodist_tpu.models.lm import LSTMLM, lm_loss
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = LSTMLM(config)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(rng, dummy)["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["tokens"])
+        return lm_loss(logits, batch["targets"])
+
+    return loss_fn, params, ["embedding"]
+
+
+def ncf_capture(config, rng=None):
+    from autodist_tpu.models.ncf import NeuMF, ncf_loss
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = NeuMF(config)
+    dummy = jnp.zeros((1,), jnp.int32)
+    params = model.init(rng, dummy, dummy)["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["user"], batch["item"])
+        return ncf_loss(logits, batch["label"])
+
+    sparse = [n for n in ("mf_user_embedding", "mf_item_embedding",
+                          "mlp_user_embedding", "mlp_item_embedding")]
+    return loss_fn, params, sparse
+
+
+def sgd_momentum(lr=0.1, momentum=0.9):
+    return optax.sgd(lr, momentum=momentum)

@@ -1,0 +1,39 @@
+"""VGG-16, parity with the reference benchmark harness
+(``/root/reference/examples/benchmark/imagenet.py`` VGG16 config).
+
+VGG's giant fc layers are the reference's PS-collapse stress case
+(BASELINE.md row 4); here they are the showcase for PartitionedPS/ZeRO
+storage sharding.
+"""
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFG16 = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M"]
+
+
+class VGG(nn.Module):
+    cfg: Sequence = tuple(_CFG16)
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME", dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def VGG16(num_classes=1000, dtype=jnp.bfloat16):
+    return VGG(cfg=tuple(_CFG16), num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,108 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+First-class long-context support (absent from the reference — SURVEY.md
+section 5 — but required of this framework): the sequence dimension is
+sharded over a mesh axis; each device holds a query block and streams
+key/value blocks around the ring with ``ppermute`` while accumulating a
+numerically-stable online softmax (flash-attention style running max /
+denominator).  Peak memory is O(S/R) per device and the K/V transfers ride
+ICI neighbor links, overlapping with the block matmuls (XLA schedules the
+ppermute concurrently with compute).
+
+Also provides :func:`all_to_all_attention` ("Ulysses"-style): for models
+with many heads, an ``all_to_all`` re-shards sequence -> heads so each
+device computes full-sequence attention for a head subset — fewer, larger
+MXU matmuls at the cost of two all_to_alls.
+
+All functions run inside ``shard_map`` with the sequence axis sharded.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _online_block(q, k_blk, v_blk, bias_blk, m, l, o, scale):
+    """One flash-style block update.  q:(B,Sq,H,D) k/v:(B,Sk,H,D),
+    m/l:(B,H,Sq), o:(B,Sq,H,D)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    if bias_blk is not None:
+        s = s + bias_blk
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_blk)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False):
+    """Blockwise ring attention.
+
+    Args:
+      q, k, v: local blocks (B, S_local, H, D) — the sequence dim is sharded
+        over `axis_name` (device i holds positions [i*S_local, (i+1)*S_local)).
+      causal: apply a causal mask over *global* positions.
+
+    Returns the local attention output block (B, S_local, H, D).
+    """
+    R = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(D).astype(q.dtype)
+    q_pos = idx * Sq + jnp.arange(Sq)
+
+    m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+    perm = [(i, (i + 1) % R) for i in range(R)]
+
+    def body(carry, step):
+        k_blk, v_blk, m, l, o = carry
+        # device `idx` holds block (idx - step) mod R at this step
+        blk = jnp.mod(idx - step, R)
+        bias = None
+        if causal:
+            k_pos = blk * Sq + jnp.arange(Sq)
+            mask = q_pos[:, None] >= k_pos[None, :]          # (Sq, Sk)
+            bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
+        m, l, o = _online_block(q.astype(jnp.float32), k_blk.astype(jnp.float32),
+                                v_blk.astype(jnp.float32), bias, m, l, o, scale)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, o), None
+
+    (k, v, m, l, o), _ = jax.lax.scan(body, (k, v, m0, l0, o0),
+                                      jnp.arange(R))
+    # rows with no visible keys (fully masked) have l == 0; output 0 there
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = o / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def all_to_all_attention(q, k, v, axis_name, causal=False):
+    """Ulysses-style sequence parallelism: all_to_all swaps the sharded dim
+    from sequence to heads, each device runs full-sequence attention on its
+    head subset, then the inverse all_to_all restores sequence sharding.
+    Requires num_heads % axis_size == 0."""
+    R = jax.lax.axis_size(axis_name)
+    B, Sl, H, D = q.shape
+    if H % R != 0:
+        raise ValueError(f"num_heads {H} must divide by axis size {R}")
+
+    def seq_to_heads(x):
+        # (B, Sl, H, D) -> (B, Sl*R, H/R, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    S = qg.shape[1]
+    bias = None
+    if causal:
+        pos = jnp.arange(S)
+        bias = jnp.where(pos[:, None] >= pos[None, :], 0.0, -jnp.inf)[None, None]
+    out = jax.nn.dot_product_attention(qg, kg, vg, bias=bias)
+    return heads_to_seq(out)

@@ -11,11 +11,9 @@ metrics come back replicated (fetch contraction = reading any shard).
 import os
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from autodist_tpu.kernel.partitioner import Placement
 from autodist_tpu.utils import logging
 
 
@@ -82,22 +80,11 @@ class DistributedSession:
     def params(self):
         """Full, unpadded parameter pytree (replicated layout), as the
         original single-device program would see it."""
-        t = self._t
+        return jax.device_get(self._t.canonicalize_params(self.state["params"]))
 
-        def fetch(storage_leaf, plan):
-            if plan.placement == Placement.REPLICATED:
-                return storage_leaf
-            if plan.placement == Placement.SHARDED:
-                dim = plan.shape[plan.partition_axis]
-                return jax.lax.slice_in_dim(
-                    storage_leaf, 0, dim, axis=plan.partition_axis)
-            if plan.placement == Placement.DIVERGENT:
-                return jnp.mean(storage_leaf, axis=0)
-            raise ValueError(plan.placement)
-
-        plans_tree = t.treedef.unflatten([t.plans[n] for n in t.names])
-        fn = jax.jit(lambda s: jax.tree.map(fetch, s, plans_tree))
-        return jax.device_get(fn(self.state["params"]))
+    def mutable_state(self):
+        """Current non-trainable state (e.g. batch stats), host-fetched."""
+        return jax.device_get(self.state["mutable"])
 
     @property
     def step(self):

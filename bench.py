@@ -1,0 +1,70 @@
+"""Benchmark: ResNet-50 training throughput per chip (the BASELINE.json
+north-star metric), run on real hardware by the driver.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline note: the reference publishes no ResNet-50 single-accelerator
+number; the closest published row is ResNet-101 @1x T4 = ~62 images/sec
+(BASELINE.md, figure1 row 2).  vs_baseline uses that 62 img/s conservatively
+(ResNet-101 is ~1.7x the FLOPs of ResNet-50, so this understates the gap).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_IMAGES_PER_SEC = 62.0  # ResNet-101 @ 1x T4, docs/usage/figure1.png
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.models import ResNet50
+    from autodist_tpu.models import train_lib
+
+    n_chips = jax.device_count()
+    batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
+    B = batch_per_chip * n_chips
+
+    model = ResNet50(num_classes=1000)  # bf16 compute (default dtype)
+    loss_fn, params, state = train_lib.classifier_capture(model, (224, 224, 3))
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
+                  strategy_builder=AllReduce())
+    sess = ad.distribute(loss_fn, params, train_lib.sgd_momentum(0.1),
+                         mutable_state=state)
+
+    r = np.random.RandomState(0)
+    batch = {"image": r.randn(B, 224, 224, 3).astype(np.float32),
+             "label": r.randint(0, 1000, B)}
+
+    for _ in range(3):  # warmup + compile
+        m = sess.run(batch)
+    jax.block_until_ready(m["loss"])
+
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = sess.run(batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = steps * B / dt
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

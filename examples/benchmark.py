@@ -1,0 +1,131 @@
+"""Benchmark harness — parity with the reference's
+``examples/benchmark/{imagenet.py,bert.py,ncf.py}``: pick a model family and
+a strategy by flag, train on synthetic data, report examples/sec.
+
+  python examples/benchmark.py --model resnet50 --autodist_strategy AllReduce
+  python examples/benchmark.py --model bert_base --autodist_strategy Parallax
+  python examples/benchmark.py --model vgg16 --autodist_strategy PartitionedPS
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def build(model_name, seq_len, image_size):
+    from autodist_tpu.models import (
+        BERT_BASE, BERT_LARGE, DenseNet121, InceptionV3, LMConfig, NCFConfig,
+        ResNet50, ResNet101, VGG16,
+    )
+    from autodist_tpu.models import train_lib
+
+    r = np.random.RandomState(0)
+    if model_name in ("resnet50", "resnet101", "vgg16", "densenet121", "inception_v3"):
+        model = {"resnet50": ResNet50, "resnet101": ResNet101, "vgg16": VGG16,
+                 "densenet121": DenseNet121, "inception_v3": InceptionV3}[model_name]()
+        loss_fn, params, state = train_lib.classifier_capture(
+            model, (image_size, image_size, 3))
+
+        def batch_fn(B):
+            return {"image": r.randn(B, image_size, image_size, 3).astype(np.float32),
+                    "label": r.randint(0, 1000, B)}
+
+        return dict(loss_fn=loss_fn, params=params, mutable_state=state,
+                    sparse_vars=None, has_rng=False,
+                    optimizer=train_lib.sgd_momentum(0.1), batch_fn=batch_fn)
+    if model_name in ("bert_base", "bert_large"):
+        cfg = BERT_BASE if model_name == "bert_base" else BERT_LARGE
+        loss_fn, params, sparse = train_lib.bert_capture(cfg, seq_len)
+
+        def batch_fn(B):
+            return {
+                "input_ids": r.randint(0, cfg.vocab_size, (B, seq_len)).astype(np.int32),
+                "labels": np.where(r.rand(B, seq_len) < 0.15,
+                                   r.randint(0, cfg.vocab_size, (B, seq_len)),
+                                   -100).astype(np.int32),
+                "next_sentence_label": r.randint(0, 2, (B,)).astype(np.int32),
+            }
+
+        return dict(loss_fn=loss_fn, params=params, mutable_state=None,
+                    sparse_vars=sparse, has_rng=True,
+                    optimizer=optax.adamw(1e-4), batch_fn=batch_fn)
+    if model_name == "ncf":
+        from autodist_tpu.models import train_lib as tl
+
+        cfg = NCFConfig()
+        loss_fn, params, sparse = tl.ncf_capture(cfg)
+
+        def batch_fn(B):
+            return {"user": r.randint(0, cfg.num_users, (B,)).astype(np.int32),
+                    "item": r.randint(0, cfg.num_items, (B,)).astype(np.int32),
+                    "label": (r.rand(B) < 0.5).astype(np.float32)}
+
+        return dict(loss_fn=loss_fn, params=params, mutable_state=None,
+                    sparse_vars=sparse, has_rng=False,
+                    optimizer=optax.adam(1e-3), batch_fn=batch_fn)
+    if model_name == "lm1b":
+        from autodist_tpu.models import train_lib as tl
+
+        cfg = LMConfig(vocab_size=793470 // 8, embed_dim=512, hidden_dim=2048)
+        loss_fn, params, sparse = tl.lm_capture(cfg, seq_len)
+
+        def batch_fn(B):
+            return {"tokens": r.randint(0, cfg.vocab_size, (B, seq_len)).astype(np.int32),
+                    "targets": r.randint(0, cfg.vocab_size, (B, seq_len)).astype(np.int32)}
+
+        return dict(loss_fn=loss_fn, params=params, mutable_state=None,
+                    sparse_vars=sparse, has_rng=False,
+                    optimizer=optax.adagrad(0.2), batch_fn=batch_fn)
+    raise SystemExit(f"unknown model {model_name}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--autodist_strategy", default="AllReduce",
+                    help="PS | PSLoadBalancing | PartitionedPS | UnevenPartitionedPS | "
+                         "AllReduce | PartitionedAR | RandomAxisPartitionAR | Parallax")
+    ap.add_argument("--batch_per_chip", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--seq_len", type=int, default=128)
+    ap.add_argument("--image_size", type=int, default=224)
+    args = ap.parse_args()
+
+    from autodist_tpu import strategy as S
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    n_chips = jax.device_count()
+    B = args.batch_per_chip * n_chips
+    cap = build(args.model, args.seq_len, args.image_size)
+    builder = getattr(S, args.autodist_strategy)()
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
+                  strategy_builder=builder)
+    sess = ad.distribute(cap["loss_fn"], cap["params"], cap["optimizer"],
+                         sparse_vars=cap["sparse_vars"], has_rng=cap["has_rng"],
+                         mutable_state=cap["mutable_state"])
+    batch = cap["batch_fn"](B)
+    for _ in range(args.warmup):
+        m = sess.run(batch)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        m = sess.run(batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    eps = args.steps * B / dt
+    print(f"model={args.model} strategy={args.autodist_strategy} chips={n_chips} "
+          f"global_batch={B} examples/sec={eps:.1f} per_chip={eps / n_chips:.1f} "
+          f"loss={float(m['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Cluster layer tests: rank assignment, env contract, ssh command build."""
+import sys
+
+import pytest
+
+from autodist_tpu.cluster import Cluster
+from autodist_tpu.resource_spec import ResourceSpec
+
+SPEC = ResourceSpec(resource_info={
+    "nodes": [
+        {"address": "10.0.0.2", "chips": [0, 1], "ssh_config": "conf"},
+        {"address": "10.0.0.1", "chips": [0, 1], "chief": True, "ssh_config": "conf"},
+    ],
+    "ssh": {"conf": {"username": "root", "key_file": "/k", "port": 2222,
+                     "python_venv": "/venv",
+                     "shared_envs": {"LD_LIBRARY_PATH": "/lib"}}},
+})
+
+
+def test_rank_order_chief_first():
+    c = Cluster(SPEC)
+    assert c.num_processes == 2
+    assert c.process_id == 0  # this process has no AUTODIST_WORKER set
+    assert c.is_chief
+    assert c.coordinator_address == "10.0.0.1:15501"
+
+
+def test_worker_rank(monkeypatch):
+    monkeypatch.setenv("AUTODIST_WORKER", "10.0.0.2")
+    c = Cluster(SPEC)
+    assert c.process_id == 1
+    assert not c.is_chief
+    monkeypatch.setenv("AUTODIST_WORKER", "10.9.9.9")
+    with pytest.raises(ValueError):
+        Cluster(SPEC).process_id
+
+
+def test_worker_env_contract():
+    c = Cluster(SPEC)
+    env = c.worker_env("10.0.0.2", "strat-1")
+    assert env["AUTODIST_WORKER"] == "10.0.0.2"
+    assert env["AUTODIST_STRATEGY_ID"] == "strat-1"
+    assert env["AUTODIST_PROCESS_ID"] == "1"
+    assert env["AUTODIST_NUM_PROCESSES"] == "2"
+    assert env["AUTODIST_COORDINATOR"] == "10.0.0.1:15501"
+    assert env["LD_LIBRARY_PATH"] == "/lib"  # ssh shared_envs forwarded
+
+
+def test_remote_command_build():
+    c = Cluster(SPEC)
+    env = c.worker_env("10.0.0.2", "s1")
+    cmd = c.remote_command("10.0.0.2", ["/abs/train.py", "--flag"], env)
+    assert cmd[0] == "ssh"
+    assert "-i" in cmd and "/k" in cmd
+    assert "-p" in cmd and "2222" in cmd
+    assert "root@10.0.0.2" in cmd
+    joined = cmd[-1]
+    assert "/venv/bin/python" in joined
+    assert "/abs/train.py" in joined
+    assert "AUTODIST_WORKER=10.0.0.2" in joined
+
+
+def test_single_node_initialize_noop():
+    spec = ResourceSpec.from_num_chips(8)
+    c = Cluster(spec)
+    c.initialize()  # must not call jax.distributed.initialize
+    assert c.num_processes == 1

@@ -1,0 +1,37 @@
+"""Regression: user-held on-device arrays must survive step donation.
+
+Found on real hardware: ``device_put`` aliases arrays already on device, so
+the donated train step deleted the user's ``mutable_state``/``rng`` buffers
+and a second session built from the same pytrees crashed with
+"Array has been deleted".
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+
+SPEC = ResourceSpec.from_num_chips(8)
+
+
+def test_two_sessions_share_input_pytrees():
+    params = {"w": jnp.ones((4,))}           # on-device committed arrays
+    state = {"ema": jnp.zeros((4,))}
+    rng = jax.random.PRNGKey(0)
+
+    def loss_fn(p, s, batch):
+        return jnp.mean(batch @ p["w"]), {"ema": 0.9 * s["ema"]}
+
+    b = np.ones((8, 4), np.float32)
+    for _ in range(2):  # second construction reuses the same input pytrees
+        ad = AutoDist(resource_spec=SPEC)
+        sess = ad.distribute(loss_fn, params, optax.sgd(0.1),
+                             mutable_state=state, rng=rng)
+        sess.run(b)
+        sess.run(b)
+    # the originals are still alive and readable
+    assert float(jnp.sum(params["w"])) == 4.0
+    assert float(jnp.sum(state["ema"])) == 0.0
+    np.testing.assert_array_equal(np.asarray(rng), np.asarray(jax.random.PRNGKey(0)))

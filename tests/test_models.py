@@ -1,0 +1,113 @@
+"""Model zoo smoke + integration tests (tiny shapes, 8-device CPU mesh).
+
+Mirrors the reference's integration cases: c1/c5 (Keras classifier), c2
+(sparse embeddings + Adam), c6 (LSTM), plus the benchmark families."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce, Parallax, PartitionedPS, PSLoadBalancing
+from autodist_tpu.models import (
+    BERT_TINY, DenseNet121, InceptionV3, LMConfig, NCFConfig, NeuMF,
+    ResNet18, ResNet50, VGG16,
+)
+from autodist_tpu.models import train_lib
+
+SPEC = ResourceSpec.from_num_chips(8)
+
+
+def _img_batch(n=8, hw=32, classes=10):
+    r = np.random.RandomState(0)
+    return {"image": r.randn(n, hw, hw, 3).astype(np.float32),
+            "label": r.randint(0, classes, n)}
+
+
+def test_resnet18_trains_with_batch_stats():
+    model = ResNet18(num_classes=10, num_filters=8, dtype=jnp.float32)
+    loss_fn, params, state = train_lib.classifier_capture(model, (32, 32, 3))
+    assert "batch_stats" in state
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=AllReduce())
+    sess = ad.distribute(loss_fn, params, optax.sgd(0.1), mutable_state=state)
+    losses = [float(sess.run(_img_batch())["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0]
+    bn = sess.mutable_state()["batch_stats"]
+    assert np.any(bn["bn_init"]["mean"] != 0)  # stats updated + synced
+
+
+@pytest.mark.parametrize("model_fn,kwargs", [
+    (ResNet50, dict(num_classes=10, num_filters=4, dtype=jnp.float32)),
+    (DenseNet121, dict(num_classes=10, growth_rate=4, dtype=jnp.float32)),
+])
+def test_deep_cnn_one_step(model_fn, kwargs):
+    model = model_fn(**kwargs)
+    loss_fn, params, state = train_lib.classifier_capture(model, (32, 32, 3))
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=PSLoadBalancing())
+    sess = ad.distribute(loss_fn, params, optax.sgd(0.01), mutable_state=state)
+    m = sess.run(_img_batch())
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_vgg16_partitioned_fc():
+    """VGG's giant fc layers under PartitionedPS (the reference's stress case)."""
+    model = VGG16(num_classes=10, dtype=jnp.float32)
+    loss_fn, params, state = train_lib.classifier_capture(model, (32, 32, 3))
+    assert state == {} or state is None  # VGG has no batch stats
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=PartitionedPS(max_shards=8))
+    sess = ad.distribute(loss_fn, params, optax.sgd(0.01))
+    m = sess.run(_img_batch())
+    assert np.isfinite(float(m["loss"]))
+
+
+@pytest.mark.integration
+def test_inception_v3_one_step():
+    model = InceptionV3(num_classes=10, dtype=jnp.float32)
+    loss_fn, params, state = train_lib.classifier_capture(model, (96, 96, 3))
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=AllReduce())
+    sess = ad.distribute(loss_fn, params, optax.sgd(0.01), mutable_state=state)
+    r = np.random.RandomState(0)
+    m = sess.run({"image": r.randn(8, 96, 96, 3).astype(np.float32),
+                  "label": r.randint(0, 10, 8)})
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_bert_tiny_pretraining():
+    loss_fn, params, sparse = train_lib.bert_capture(BERT_TINY, seq_len=32)
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=Parallax())
+    sess = ad.distribute(loss_fn, params, optax.adamw(1e-3),
+                         sparse_vars=sparse, has_rng=True)
+    r = np.random.RandomState(0)
+    b = {"input_ids": r.randint(0, 1024, (16, 32)).astype(np.int32),
+         "labels": np.where(r.rand(16, 32) < 0.15,
+                            r.randint(0, 1024, (16, 32)), -100).astype(np.int32),
+         "next_sentence_label": r.randint(0, 2, (16,)).astype(np.int32)}
+    losses = [float(sess.run(b)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_lstm_lm_partitioned_embedding():
+    cfg = LMConfig(vocab_size=200, embed_dim=16, hidden_dim=32, num_layers=1)
+    loss_fn, params, sparse = train_lib.lm_capture(cfg, seq_len=16)
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=PartitionedPS(max_shards=8))
+    sess = ad.distribute(loss_fn, params, optax.adam(1e-2), sparse_vars=sparse)
+    r = np.random.RandomState(0)
+    b = {"tokens": r.randint(0, 200, (16, 16)).astype(np.int32),
+         "targets": r.randint(0, 200, (16, 16)).astype(np.int32)}
+    losses = [float(sess.run(b)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_ncf():
+    cfg = NCFConfig(num_users=100, num_items=50, mf_dim=8, mlp_dims=(16, 8))
+    loss_fn, params, sparse = train_lib.ncf_capture(cfg)
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=Parallax())
+    sess = ad.distribute(loss_fn, params, optax.adam(1e-2), sparse_vars=sparse)
+    r = np.random.RandomState(0)
+    b = {"user": r.randint(0, 100, (32,)).astype(np.int32),
+         "item": r.randint(0, 50, (32,)).astype(np.int32),
+         "label": (r.rand(32) < 0.5).astype(np.float32)}
+    losses = [float(sess.run(b)["loss"]) for _ in range(8)]
+    assert losses[-1] < losses[0]

@@ -1,0 +1,80 @@
+"""Ring attention / Ulysses sequence-parallel correctness vs full attention."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from autodist_tpu.parallel.mesh import build_mesh
+from autodist_tpu.parallel.ring_attention import all_to_all_attention, ring_attention
+
+
+def _qkv(B=2, S=64, H=4, D=8, seed=0):
+    r = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(r.randn(B, S, H, D), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _reference(q, k, v, causal):
+    bias = None
+    if causal:
+        S = q.shape[1]
+        pos = jnp.arange(S)
+        bias = jnp.where(pos[:, None] >= pos[None, :], 0.0, -jnp.inf)[None, None]
+    return jax.nn.dot_product_attention(q, k, v, bias=bias)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    mesh = build_mesh()
+    q, k, v = _qkv()
+    want = _reference(q, k, v, causal)
+
+    got = jax.jit(jax.shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, "replica", causal=causal),
+        mesh=mesh,
+        in_specs=(jax.P(None, "replica"),) * 3,
+        out_specs=jax.P(None, "replica"),
+        check_vma=False,
+    ))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(causal):
+    mesh = build_mesh()
+    q, k, v = _qkv(H=8)
+    want = _reference(q, k, v, causal)
+
+    got = jax.jit(jax.shard_map(
+        lambda q_, k_, v_: all_to_all_attention(q_, k_, v_, "replica", causal=causal),
+        mesh=mesh,
+        in_specs=(jax.P(None, "replica"),) * 3,
+        out_specs=jax.P(None, "replica"),
+        check_vma=False,
+    ))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = build_mesh()
+    q, k, v = _qkv(H=4)  # 4 heads, 8 devices
+    with pytest.raises(ValueError):
+        jax.jit(jax.shard_map(
+            lambda q_, k_, v_: all_to_all_attention(q_, k_, v_, "replica"),
+            mesh=mesh, in_specs=(jax.P(None, "replica"),) * 3,
+            out_specs=jax.P(None, "replica"), check_vma=False,
+        ))(q, k, v)
+
+
+def test_ring_attention_long_sequence_memory_shape():
+    """Each device only ever materializes S/R-sized blocks."""
+    mesh = build_mesh()
+    q, k, v = _qkv(S=128)
+    out = jax.jit(jax.shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, "replica", causal=True),
+        mesh=mesh, in_specs=(jax.P(None, "replica"),) * 3,
+        out_specs=jax.P(None, "replica"), check_vma=False,
+    ))(q, k, v)
+    assert out.shape == q.shape
+    want = _reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
