@@ -1,0 +1,191 @@
+"""Host input pipeline over the native C++ loader.
+
+The framework's IO layer (native where the reference's was: the reference
+rode TensorFlow's C++ input stack).  ``native/autodist_io.cpp`` provides an
+mmap'd packed-record dataset and a multi-threaded shuffled batch assembler
+with a prefetch ring; this module wraps it with ctypes and shapes batches
+into numpy/device arrays.  Training overlap: while the TPU runs step N, the
+C++ threads assemble batch N+1..N+prefetch.
+
+Build on first use: ``make -C native`` (a cached .so under the repo).
+Falls back to a pure-numpy loader when no compiler is available.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libautodist_io.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_native():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True)
+            except Exception as e:
+                logging.warning("native IO build failed (%s); using numpy fallback", e)
+                _lib = False
+                return _lib
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.adio_open.restype = ctypes.c_void_p
+        lib.adio_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.adio_num_records.restype = ctypes.c_uint64
+        lib.adio_num_records.argtypes = [ctypes.c_void_p]
+        lib.adio_close.argtypes = [ctypes.c_void_p]
+        lib.adio_read_batch.restype = ctypes.c_int
+        lib.adio_read_batch.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint64),
+                                        ctypes.c_uint64, ctypes.c_void_p]
+        lib.adio_loader_new.restype = ctypes.c_void_p
+        lib.adio_loader_new.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                        ctypes.c_uint64, ctypes.c_int,
+                                        ctypes.c_uint64, ctypes.c_uint64]
+        lib.adio_loader_next.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.adio_loader_next.argtypes = [ctypes.c_void_p]
+        lib.adio_loader_release.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(ctypes.c_uint8)]
+        lib.adio_loader_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def write_records(path, array):
+    """Pack a (N, ...) array into the loader's record file format."""
+    arr = np.ascontiguousarray(array)
+    arr.tofile(path)
+    return arr[0].nbytes
+
+
+class RecordDataset:
+    """mmap'd packed fixed-size-record dataset (native when available)."""
+
+    def __init__(self, path, record_shape, dtype):
+        self.record_shape = tuple(record_shape)
+        self.dtype = np.dtype(dtype)
+        self.record_bytes = int(np.prod(self.record_shape)) * self.dtype.itemsize
+        self._path = path
+        self._active_loaders = 0
+        lib = _load_native()
+        if lib:
+            self._ds = lib.adio_open(path.encode(), self.record_bytes)
+            if not self._ds:
+                raise OSError(f"adio_open failed for {path}")
+            self._n = int(lib.adio_num_records(self._ds))
+            self._mm = None
+        else:
+            self._ds = None
+            self._mm = np.memmap(path, dtype=self.dtype, mode="r").reshape(
+                (-1,) + self.record_shape)
+            self._n = self._mm.shape[0]
+
+    def __len__(self):
+        return self._n
+
+    def read_batch(self, indices):
+        indices = np.asarray(indices, np.uint64)
+        out = np.empty((len(indices),) + self.record_shape, self.dtype)
+        if self._ds:
+            lib = _load_native()
+            rc = lib.adio_read_batch(
+                self._ds, indices.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                len(indices), out.ctypes.data_as(ctypes.c_void_p))
+            if rc != 0:
+                raise IndexError(f"adio_read_batch rc={rc}")
+        else:
+            out[:] = self._mm[indices.astype(np.int64)]
+        return out
+
+    def close(self):
+        if self._active_loaders:
+            raise RuntimeError(
+                f"{self._active_loaders} BatchLoader(s) still use this dataset; "
+                f"close them first (worker threads read the mmap)")
+        if self._ds:
+            _load_native().adio_close(self._ds)
+            self._ds = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class BatchLoader:
+    """Iterator of shuffled batches assembled by C++ worker threads."""
+
+    def __init__(self, dataset, batch_size, *, shuffle=True, seed=0,
+                 threads=2, prefetch=2):
+        self._ds = dataset
+        self._batch = batch_size
+        lib = _load_native()
+        self._native = bool(lib) and dataset._ds
+        if not shuffle:
+            # multiple workers publish out of order; sequential reads need
+            # a single worker for deterministic batch order
+            threads = 1
+        if self._native:
+            self._ld = lib.adio_loader_new(dataset._ds, batch_size, threads,
+                                           1 if shuffle else 0, seed, prefetch)
+            if not self._ld:
+                raise OSError("adio_loader_new failed")
+            dataset._active_loaders += 1
+        else:
+            self._rng = np.random.RandomState(seed)
+            self._shuffle = shuffle
+            self._perm = np.arange(len(dataset))
+            if shuffle:
+                self._rng.shuffle(self._perm)
+            self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._native:
+            lib = _load_native()
+            buf = lib.adio_loader_next(self._ld)
+            if not buf:
+                raise StopIteration
+            n = self._batch * self._ds.record_bytes
+            out = np.ctypeslib.as_array(buf, shape=(n,)).view(self._ds.dtype)
+            out = out.reshape((self._batch,) + self._ds.record_shape).copy()
+            lib.adio_loader_release(self._ld, buf)
+            return out
+        # fallback path: true epoch permutation, reshuffled per epoch
+        idx = np.empty(self._batch, np.int64)
+        for i in range(self._batch):
+            if self._cursor >= len(self._perm):
+                if self._shuffle:
+                    self._rng.shuffle(self._perm)
+                self._cursor = 0
+            idx[i] = self._perm[self._cursor]
+            self._cursor += 1
+        return self._ds.read_batch(idx)
+
+    def close(self):
+        if self._native and self._ld:
+            _load_native().adio_loader_free(self._ld)
+            self._ld = None
+            self._ds._active_loaders -= 1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

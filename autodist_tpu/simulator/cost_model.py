@@ -1,0 +1,134 @@
+"""Strategy cost simulator.
+
+The reference ships an empty ``autodist/simulator/`` plus the AutoSync
+dataset format (NeurIPS 2020) of measured (graph_item, resource_spec,
+strategy, runtime) tuples; the learned cost model itself is out-of-repo
+(``simulator/dataset/README.md``).  Here we provide a working *analytic*
+cost model for TPU meshes — enough to rank strategies per model — plus the
+dataset-record plumbing so measured runs can be exported in AutoSync spirit.
+
+Model (per step, seconds):
+  compute    ~ 3 * flops_per_example * batch / (chips * peak_flops * mxu_eff)
+               (fwd 1x + bwd 2x)
+  allreduce  ~ 2 * (R-1)/R * bytes / ici_bw        (ring over the slice)
+  ps         ~ reduce-scatter + all-gather = same wire volume as allreduce,
+               but param all-gather adds param_bytes * (R-1)/R each step
+  sharded    ~ adds param all-gather on use (forward) as well
+  sparse     ~ all-gather of touched rows only: batch * row_bytes * R factor
+"""
+import dataclasses
+import json
+
+from autodist_tpu.kernel.partitioner import Placement, SyncKind, build_var_plans
+
+# v5e-class defaults; override per ResourceSpec bandwidths when present.
+DEFAULT_PEAK_FLOPS = 394e12        # bf16 FLOPs/s per chip (v5e ~394 TFLOPs)
+DEFAULT_MXU_EFF = 0.45
+DEFAULT_ICI_GBPS = 1600.0          # per-chip ICI bi-dir, Gbit/s
+DEFAULT_DCN_GBPS = 100.0
+
+
+@dataclasses.dataclass
+class CostEstimate:
+    compute_s: float
+    comm_s: float
+    breakdown: dict
+
+    @property
+    def total_s(self):
+        # collectives overlap with compute only partially; assume the larger
+        # dominates with 30% overlap credit
+        lo, hi = sorted((self.compute_s, self.comm_s))
+        return hi + 0.7 * lo
+
+    def to_json(self):
+        return {"compute_s": self.compute_s, "comm_s": self.comm_s,
+                "total_s": self.total_s, **self.breakdown}
+
+
+def _ring_time(bytes_, n, bw_bytes_per_s):
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * bytes_ / bw_bytes_per_s
+
+
+def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
+             batch_per_chip=32, peak_flops=DEFAULT_PEAK_FLOPS,
+             mxu_eff=DEFAULT_MXU_EFF, ici_gbps=DEFAULT_ICI_GBPS,
+             dcn_gbps=DEFAULT_DCN_GBPS, avg_sparse_rows=None):
+    """Estimate per-step cost of `strategy` for `model_item` on the spec."""
+    R = max(1, resource_spec.num_accelerators)
+    multi_node = not resource_spec.is_single_node
+    bw = (min(ici_gbps, dcn_gbps) if multi_node else ici_gbps) * 1e9 / 8
+    plans = build_var_plans(strategy, model_item, R)
+
+    compute_s = 0.0
+    if flops_per_example:
+        compute_s = 3.0 * flops_per_example * batch_per_chip / (peak_flops * mxu_eff)
+
+    ar_bytes = ps_bytes = gather_bytes = sparse_bytes = 0
+    for v in model_item.var_infos:
+        plan = plans.get(v.name)
+        if plan is None:
+            continue
+        nbytes = v.byte_size
+        if plan.sparse:
+            rows = avg_sparse_rows or batch_per_chip
+            row_bytes = nbytes / max(1, v.shape[0] if v.shape else 1)
+            sparse_bytes += rows * row_bytes * R  # all-gather of touched rows
+            continue
+        if plan.placement == Placement.SHARDED:
+            ps_bytes += nbytes        # reduce-scatter grads
+            gather_bytes += nbytes    # all-gather params at use
+        elif plan.sync == SyncKind.PS:
+            if plan.placement == Placement.DIVERGENT:
+                ar_bytes += nbytes / plan.sync_period  # amortized averaging
+            else:
+                ps_bytes += nbytes
+                gather_bytes += nbytes
+        else:
+            comp_factor = {0: 1.0, 1: 0.5, 2: 0.5, 3: 0.25, 4: 0.25}.get(
+                plan.compressor, 1.0)
+            ar_bytes += nbytes * comp_factor
+
+    comm_s = (_ring_time(ar_bytes, R, bw)
+              + _ring_time(ps_bytes, R, bw)
+              + _ring_time(gather_bytes, R, bw)
+              + sparse_bytes / bw)
+    return CostEstimate(compute_s, comm_s, {
+        "ar_bytes": ar_bytes, "ps_bytes": ps_bytes,
+        "gather_bytes": gather_bytes, "sparse_bytes": sparse_bytes,
+        "num_replicas": R})
+
+
+def rank_strategies(builders, model_item, resource_spec, **kw):
+    """Rank candidate builders by estimated step time (cheapest first)."""
+    scored = []
+    for b in builders:
+        s = b.build(model_item, resource_spec)
+        est = estimate(s, model_item, resource_spec, **kw)
+        scored.append((est.total_s, type(b).__name__, b, est, s))
+    scored.sort(key=lambda t: t[0])
+    return scored
+
+
+@dataclasses.dataclass
+class RuntimeRecord:
+    """AutoSync-style measured tuple: (model, resource, strategy, runtime)."""
+
+    model_def: bytes          # ModelItemDef proto
+    strategy_pb: bytes        # Strategy proto
+    resource_yaml: str
+    step_time_s: float
+
+    def dump(self, path):
+        import base64
+
+        with open(path, "w") as f:
+            json.dump({
+                "model_def": base64.b64encode(self.model_def).decode(),
+                "strategy": base64.b64encode(self.strategy_pb).decode(),
+                "resource": self.resource_yaml,
+                "step_time_s": self.step_time_s,
+            }, f)
+        return path

@@ -1,0 +1,50 @@
+"""AutoStrategy: pick the best builder via the cost simulator.
+
+The reference's "automatic strategy optimization" pipeline (AutoSync) lives
+outside its repo (``docs/design/rationale.rst``); this in-repo version
+closes the loop analytically: enumerate the builder space, rank with the
+cost model, build with the winner.
+"""
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+from autodist_tpu.utils import logging
+
+
+def default_candidates():
+    from autodist_tpu.strategy import (
+        PS, AllReduce, Parallax, PartitionedAR, PartitionedPS,
+        PSLoadBalancing, UnevenPartitionedPS,
+    )
+
+    return [
+        AllReduce(),
+        AllReduce(compressor="BF16Compressor"),
+        PS(),
+        PSLoadBalancing(),
+        PartitionedPS(),
+        UnevenPartitionedPS(),
+        PartitionedAR(),
+        Parallax(),
+        Parallax(compressor="BF16Compressor"),
+    ]
+
+
+class AutoStrategy(StrategyBuilder):
+    def __init__(self, candidates=None, flops_per_example=0.0, batch_per_chip=32):
+        self._candidates = candidates
+        self._flops = flops_per_example
+        self._batch = batch_per_chip
+        self.last_ranking = None
+
+    def build(self, model_item, resource_spec) -> Strategy:
+        from autodist_tpu.simulator.cost_model import rank_strategies
+
+        cands = self._candidates or default_candidates()
+        ranking = rank_strategies(cands, model_item, resource_spec,
+                                  flops_per_example=self._flops,
+                                  batch_per_chip=self._batch)
+        self.last_ranking = [(name, cost) for cost, name, *_ in ranking]
+        cost, name, _builder, _est, strategy = ranking[0]
+        logging.info("AutoStrategy picked %s (est %.2fms/step); ranking: %s",
+                     name, cost * 1e3,
+                     [(n, round(c * 1e3, 3)) for n, c in self.last_ranking])
+        return strategy

@@ -1,0 +1,33 @@
+"""Program-evolution dumps.
+
+Reference ``utils/visualization_util.py``: TensorBoard graph snapshots at
+each transform stage.  TPU equivalent: dump the StableHLO / optimized HLO of
+the compiled step per strategy pass into ``DEFAULT_HLO_DUMP_DIR`` (enabled
+by ``AUTODIST_DUMP_HLO=True``), plus ``jax.profiler`` trace helpers.
+"""
+import os
+
+from autodist_tpu.const import DEFAULT_HLO_DUMP_DIR, ENV
+from autodist_tpu.utils import logging
+
+
+def dump_hlo(fn_or_lowered, name, *args, **kwargs):
+    """Write the lowered StableHLO (and compiled HLO when available) of a
+    jitted function applied to `args`.  No-op unless AUTODIST_DUMP_HLO."""
+    if not ENV.AUTODIST_DUMP_HLO.val:
+        return None
+    os.makedirs(DEFAULT_HLO_DUMP_DIR, exist_ok=True)
+    lowered = (fn_or_lowered if hasattr(fn_or_lowered, "as_text")
+               else fn_or_lowered.lower(*args, **kwargs))
+    path = os.path.join(DEFAULT_HLO_DUMP_DIR, f"{name}.stablehlo.txt")
+    with open(path, "w") as f:
+        f.write(lowered.as_text())
+    try:
+        compiled = lowered.compile()
+        opt = os.path.join(DEFAULT_HLO_DUMP_DIR, f"{name}.optimized_hlo.txt")
+        with open(opt, "w") as f:
+            f.write(compiled.as_text())
+    except Exception as e:  # compile may be deferred/unavailable
+        logging.debug("optimized HLO unavailable for %s: %s", name, e)
+    logging.info("Dumped HLO for %s to %s", name, path)
+    return path

@@ -1,0 +1,57 @@
+"""Native C++ IO layer tests: record round-trip, shuffled epochs, prefetch."""
+import numpy as np
+import pytest
+
+from autodist_tpu.data.loader import BatchLoader, RecordDataset, write_records
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    data = np.arange(100 * 4, dtype=np.float32).reshape(100, 4)
+    path = str(tmp_path / "records.bin")
+    write_records(path, data)
+    ds = RecordDataset(path, (4,), np.float32)
+    yield ds, data
+    ds.close()
+
+
+def test_native_lib_built(dataset):
+    ds, _ = dataset
+    assert ds._ds, "native loader should be available in this image"
+
+
+def test_len_and_read_batch(dataset):
+    ds, data = dataset
+    assert len(ds) == 100
+    got = ds.read_batch([0, 99, 50])
+    np.testing.assert_array_equal(got, data[[0, 99, 50]])
+
+
+def test_read_batch_out_of_range(dataset):
+    ds, _ = dataset
+    with pytest.raises(IndexError):
+        ds.read_batch([100])
+
+
+def test_batch_loader_covers_epoch(dataset):
+    ds, data = dataset
+    ld = BatchLoader(ds, batch_size=10, shuffle=True, seed=1, threads=2)
+    seen = set()
+    for _ in range(10):  # one epoch worth
+        b = next(ld)
+        assert b.shape == (10, 4)
+        seen.update(int(r[0] // 4) for r in b)  # first element encodes row
+    ld.close()
+    # shuffled epoch permutation must cover (nearly) all rows
+    assert len(seen) > 90
+
+
+def test_batch_loader_deterministic_records(dataset):
+    ds, data = dataset
+    ld = BatchLoader(ds, batch_size=8, shuffle=False, seed=0, threads=1)
+    b = next(ld)
+    ld.close()
+    # every returned record must be a real dataset row
+    rows = {tuple(r) for r in data}
+    for r in b:
+        assert tuple(r) in rows
