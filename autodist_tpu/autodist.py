@@ -85,6 +85,11 @@ class AutoDist:
     def build_strategy(self, model_item) -> Strategy:
         """Build (or load) + compile the strategy for a captured model."""
         raw = self._build_or_load_strategy(model_item)
+        # all hosts must realize the identical program; check BEFORE compiling
+        # so a mismatch fails with a clear message (utils/consistency)
+        from autodist_tpu.utils.consistency import verify_agreement
+
+        verify_agreement(raw.proto.SerializeToString(), "strategy")
         return StrategyCompiler(model_item, self._resource_spec).compile(raw)
 
     # -- main entry --------------------------------------------------------
@@ -99,6 +104,7 @@ class AutoDist:
         has_aux: bool = False,
         has_rng: bool = False,
         mutable_state: Any = None,
+        eval_fn: Callable = None,
         rng=None,
         name: str = "",
         donate: bool = True,
@@ -109,10 +115,24 @@ class AutoDist:
 
         item = ModelItem(loss_fn, params, optimizer, sparse_vars=sparse_vars,
                          has_aux=has_aux, has_rng=has_rng,
-                         mutable_state=mutable_state, name=name)
+                         mutable_state=mutable_state, eval_fn=eval_fn, name=name)
         strategy = self.build_strategy(item)
         transformer = GraphTransformer(strategy, item, self.mesh)
         return DistributedSession(transformer, rng=rng, donate=donate)
 
     # parity alias with the reference's create_distributed_session
     create_distributed_session = distribute
+
+    def function(self, loss_fn, params, optimizer, **kwargs):
+        """Reference ``autodist.function`` UX (``autodist.py:201-289``):
+        returns a plain callable ``step(batch) -> metrics`` that builds the
+        distributed session lazily on first call and reuses it after."""
+        box = {}
+
+        def step(batch):
+            if "sess" not in box:
+                box["sess"] = self.distribute(loss_fn, params, optimizer, **kwargs)
+            return box["sess"].run(batch)
+
+        step.session = lambda: box.get("sess")
+        return step

@@ -91,6 +91,10 @@ class Int8Compressor(Compressor):
     Phase 1 (reduce-scatter shape): all_to_all int8 chunks + f32 scales;
     each device dequantizes its chunk from every peer and sums.
     Phase 2: requantize the reduced chunk, all_gather int8 + scales.
+
+    On TPU, the quantize and dequant-sum stages run as fused Pallas VMEM
+    kernels (``ops/pallas/quantize.py``) when buffers are large enough to
+    tile; elsewhere (or for small buffers) the jnp path lowers fine.
     """
 
     name = "int8"
@@ -102,20 +106,41 @@ class Int8Compressor(Compressor):
         # pad so chunks split evenly into blocks
         chunk = -(-n // n_dev)
         chunk = -(-chunk // self.BLOCK) * self.BLOCK
+        # Pallas fast path on TPU: worth it once a chunk spans at least one
+        # (ROWS x BLOCK) tile grid; then pad the chunk up so the kernels tile
+        from autodist_tpu.ops.pallas.quantize import BLOCK as PBLOCK, ROWS
+
+        tile_elems = ROWS * PBLOCK
+        use_pallas = (jax.default_backend() == "tpu" and chunk >= tile_elems)
+        if use_pallas:
+            chunk = -(-chunk // tile_elems) * tile_elems
         padded = jnp.zeros((chunk * n_dev,), buf.dtype).at[:n].set(buf)
         # (n_dev, chunk): row i is the chunk destined for device i
         chunks = padded.reshape(n_dev, chunk)
-        q, scale = _quantize_int8(chunks.reshape(-1), self.BLOCK)
+        if use_pallas:
+            from autodist_tpu.ops.pallas.quantize import dequant_sum, quantize_int8
+
+            q, scale = quantize_int8(padded.reshape(-1, self.BLOCK))
+        else:
+            q, scale = _quantize_int8(chunks.reshape(-1), self.BLOCK)
         q = q.reshape(n_dev, chunk // self.BLOCK, self.BLOCK)
         scale = scale.reshape(n_dev, chunk // self.BLOCK, 1)
         # exchange: device d receives row d from every peer
         q_rx = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
         s_rx = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0, tiled=True)
         # dequant + sum over peers -> reduced local chunk
-        deq = (q_rx.astype(jnp.float32) * s_rx).reshape(n_dev, chunk)
-        local = jnp.sum(deq, axis=0) / n_dev
+        if use_pallas:
+            local = dequant_sum(q_rx, s_rx).reshape(-1) / n_dev
+        else:
+            deq = (q_rx.astype(jnp.float32) * s_rx).reshape(n_dev, chunk)
+            local = jnp.sum(deq, axis=0) / n_dev
         # phase 2: requantize reduced chunk, gather
-        q2, s2 = _quantize_int8(local, self.BLOCK)
+        if use_pallas:
+            from autodist_tpu.ops.pallas.quantize import quantize_int8 as _pq
+
+            q2, s2 = _pq(local.reshape(-1, self.BLOCK))
+        else:
+            q2, s2 = _quantize_int8(local, self.BLOCK)
         q2g = jax.lax.all_gather(q2.reshape(-1), axis_name, axis=0, tiled=True)
         s2g = jax.lax.all_gather(s2, axis_name, axis=0, tiled=True)
         out = _dequantize_int8(q2g.reshape(-1, self.BLOCK), s2g)

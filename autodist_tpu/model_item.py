@@ -75,6 +75,7 @@ class ModelItem:
         has_aux: bool = False,
         has_rng: bool = False,
         mutable_state: Any = None,
+        eval_fn: Optional[Callable] = None,
         name: str = "",
         batch_size_hint: int = 0,
     ):
@@ -90,6 +91,7 @@ class ModelItem:
         self.has_aux = has_aux
         self.has_rng = has_rng
         self.mutable_state = mutable_state
+        self.eval_fn = eval_fn
         self.name = name
         self.batch_size_hint = batch_size_hint
         sparse_vars = set(sparse_vars or ())

@@ -1,0 +1,83 @@
+"""Pallas TPU kernels for gradient quantization.
+
+The Int8 compressor's hot ops (block abs-max + quantize, and dequant-sum of
+received peer chunks) as single-VMEM-pass Pallas kernels — one HBM read,
+fused reduce + scale + round + cast, instead of XLA's multi-op lowering.
+Used by :mod:`autodist_tpu.kernel.synchronization.compressor` on TPU; on
+other platforms the kernels run in interpreter mode (tests) or callers fall
+back to the jnp path.
+
+Kernel playbook: /opt/skills/guides/pallas_guide.md (tiling: f32 (8,128),
+int8 (32,128); VPU elementwise; grid over row-chunks).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK = 256       # quantization block (elements per scale)
+ROWS = 128        # rows (blocks) per grid step; int8 tile-friendly
+
+
+def _on_tpu():
+    return jax.default_backend() == "tpu"
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:]
+    s = jnp.max(jnp.abs(x), axis=1, keepdims=True) / 127.0
+    s = jnp.where(s == 0, 1.0, s)
+    q_ref[:] = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    s_ref[:] = s
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_int8(x_blocks, interpret=False):
+    """Block quantize: (N, BLOCK) f32 -> ((N, BLOCK) int8, (N, 1) f32).
+    N must be a multiple of ROWS (pad upstream)."""
+    n = x_blocks.shape[0]
+    grid = (n // ROWS,)
+    return pl.pallas_call(
+        _quant_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((ROWS, BLOCK), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((ROWS, BLOCK), lambda i: (i, 0)),
+                   pl.BlockSpec((ROWS, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, BLOCK), jnp.int8),
+                   jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+        interpret=interpret,
+    )(x_blocks)
+
+
+def _dequant_sum_kernel(q_ref, s_ref, out_ref):
+    # q: (D, ROWS, BLOCK) int8 from D peers; s: (D, ROWS, 1); out: (ROWS, BLOCK)
+    q = q_ref[:].astype(jnp.float32)
+    out_ref[:] = jnp.sum(q * s_ref[:], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dequant_sum(q, s, interpret=False):
+    """Fused dequantize + reduce over peers: ((D,N,BLOCK) int8, (D,N,1) f32)
+    -> (N, BLOCK) f32 sum."""
+    d, n, _ = q.shape
+    grid = (n // ROWS,)
+    return pl.pallas_call(
+        _dequant_sum_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((d, ROWS, BLOCK), lambda i: (0, i, 0)),
+                  pl.BlockSpec((d, ROWS, 1), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((ROWS, BLOCK), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, BLOCK), jnp.float32),
+        interpret=interpret,
+    )(q, s)
+
+
+def pad_to_blocks(flat, rows_multiple=ROWS, block=BLOCK):
+    """Pad a flat f32 vector and reshape to (N, BLOCK) with N % rows == 0."""
+    n = flat.shape[0]
+    per_chunk = rows_multiple * block
+    npad = -(-n // per_chunk) * per_chunk
+    if npad != n:
+        flat = jnp.zeros((npad,), flat.dtype).at[:n].set(flat)
+    return flat.reshape(-1, block)

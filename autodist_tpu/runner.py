@@ -26,6 +26,7 @@ class DistributedSession:
         self._step = transformer.make_train_step(donate=donate)
         self._batch_sharding = NamedSharding(self._mesh, P(self._axis))
         self._multi_host = jax.process_count() > 1
+        self._eval_cache = {}
 
     # -- feeds (reference remapper._remap_feed analog) ---------------------
 
@@ -81,6 +82,43 @@ class DistributedSession:
         """Full, unpadded parameter pytree (replicated layout), as the
         original single-device program would see it."""
         return jax.device_get(self._t.canonicalize_params(self.state["params"]))
+
+    def predict(self, batch, apply_fn=None):
+        """Forward-only evaluation on a global batch (reference remapper
+        fetch contraction: per-replica outputs concatenate back into the
+        global-batch order).
+
+        ``apply_fn(params, batch) -> outputs`` — or, when the session was
+        built with ``mutable_state``, ``apply_fn(params, state, batch)``.
+        Defaults to the ModelItem's ``eval_fn``.  Pass a *stable* function
+        reference (not a fresh lambda per call): each distinct function
+        compiles its own jitted program (cache capped at 8).
+        """
+        apply_fn = apply_fn or self._t.model_item.eval_fn
+        if apply_fn is None:
+            raise ValueError("No eval_fn: pass apply_fn or distribute(eval_fn=...)")
+        key = id(apply_fn)
+        has_mutable = self.state["mutable"] is not None
+        if key not in self._eval_cache:
+            if len(self._eval_cache) >= 8:
+                self._eval_cache.pop(next(iter(self._eval_cache)))
+            t = self._t
+
+            def eval_step(storage, mutable, b):
+                params = t.canonicalize_params(storage)
+                if has_mutable:
+                    return apply_fn(params, mutable, b)
+                return apply_fn(params, b)
+
+            self._eval_cache[key] = jax.jit(eval_step)
+        out = self._eval_cache[key](self.state["params"], self.state["mutable"],
+                                    self._shard_batch(batch))
+        if self._multi_host:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.global_array_to_host_local_array(
+                out, self._mesh, jax.tree.map(lambda _: P(self._axis), out))
+        return jax.device_get(out)
 
     def mutable_state(self):
         """Current non-trainable state (e.g. batch stats), host-fetched."""

@@ -47,9 +47,17 @@ class CostEstimate:
 
 
 def _ring_time(bytes_, n, bw_bytes_per_s):
+    """Full allreduce (reduce-scatter + all-gather) ring cost."""
     if n <= 1:
         return 0.0
     return 2.0 * (n - 1) / n * bytes_ / bw_bytes_per_s
+
+
+def _gather_time(bytes_, n, bw_bytes_per_s):
+    """Single all-gather (or reduce-scatter) phase: half the ring cost."""
+    if n <= 1:
+        return 0.0
+    return (n - 1) / n * bytes_ / bw_bytes_per_s
 
 
 def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
@@ -78,8 +86,8 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
             sparse_bytes += rows * row_bytes * R  # all-gather of touched rows
             continue
         if plan.placement == Placement.SHARDED:
-            ps_bytes += nbytes        # reduce-scatter grads
-            gather_bytes += nbytes    # all-gather params at use
+            ps_bytes += nbytes        # reduce-scatter grads (one phase)
+            gather_bytes += nbytes    # all-gather params at use (one phase)
         elif plan.sync == SyncKind.PS:
             if plan.placement == Placement.DIVERGENT:
                 ar_bytes += nbytes / plan.sync_period  # amortized averaging
@@ -92,8 +100,8 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
             ar_bytes += nbytes * comp_factor
 
     comm_s = (_ring_time(ar_bytes, R, bw)
-              + _ring_time(ps_bytes, R, bw)
-              + _ring_time(gather_bytes, R, bw)
+              + _gather_time(ps_bytes, R, bw)      # reduce-scatter of grads
+              + _gather_time(gather_bytes, R, bw)  # all-gather of params
               + sparse_bytes / bw)
     return CostEstimate(compute_s, comm_s, {
         "ar_bytes": ar_bytes, "ps_bytes": ps_bytes,

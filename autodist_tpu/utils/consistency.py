@@ -1,0 +1,42 @@
+"""Cross-host consistency checking.
+
+The reference achieves cross-process agreement *by construction* (sorted
+node lists, md5 instance keys — SURVEY.md section 5 "race detection") and
+never verifies it.  SPMD is stricter: every host must build the identical
+program, so we *check*: hash the serialized strategy (and optionally the
+model structure) and compare across hosts before compiling.  A mismatch
+fails fast with which hosts disagree, instead of a cryptic XLA collective
+mismatch at runtime.
+"""
+import hashlib
+
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+
+def digest(data: bytes) -> int:
+    """Stable 63-bit digest of a bytes payload."""
+    return int.from_bytes(hashlib.sha256(data).digest()[:8], "big") >> 1
+
+
+def verify_agreement(payload: bytes, what="strategy"):
+    """Assert all hosts hold byte-identical `payload`.  No-op single-host."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return True
+    from jax.experimental import multihost_utils
+
+    mine = digest(payload)
+    all_digests = multihost_utils.process_allgather(np.int64(mine))
+    if not np.all(all_digests == all_digests[0]):
+        bad = [i for i, d in enumerate(np.asarray(all_digests))
+               if d != all_digests[0]]
+        raise RuntimeError(
+            f"Cross-host {what} mismatch: processes {bad} disagree with "
+            f"process 0. Every host must build the identical {what} "
+            f"(check AUTODIST_STRATEGY_ID and non-deterministic builders).")
+    logging.debug("Cross-host %s agreement verified (%d processes)",
+                  what, len(np.asarray(all_digests)))
+    return True

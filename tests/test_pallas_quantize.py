@@ -1,0 +1,43 @@
+"""Pallas quantization kernels vs jnp reference (interpreter mode on CPU)."""
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.ops.pallas.quantize import (
+    BLOCK, ROWS, dequant_sum, pad_to_blocks, quantize_int8,
+)
+
+
+def test_quantize_matches_reference():
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(ROWS * 2, BLOCK).astype(np.float32)) * 5.0
+    q, s = quantize_int8(x, interpret=True)
+    assert q.dtype == jnp.int8 and s.shape == (ROWS * 2, 1)
+    # reference
+    sref = np.max(np.abs(np.asarray(x)), axis=1, keepdims=True) / 127.0
+    np.testing.assert_allclose(np.asarray(s), sref, rtol=1e-6)
+    deq = np.asarray(q, np.float32) * np.asarray(s)
+    np.testing.assert_allclose(deq, np.asarray(x), atol=np.max(sref) * 0.51)
+
+
+def test_quantize_zero_block_safe():
+    x = jnp.zeros((ROWS, BLOCK), jnp.float32)
+    q, s = quantize_int8(x, interpret=True)
+    assert np.all(np.asarray(q) == 0)
+    assert np.all(np.asarray(s) == 1.0)  # guarded against /0
+
+
+def test_dequant_sum_matches_reference():
+    r = np.random.RandomState(1)
+    D = 4
+    q = jnp.asarray(r.randint(-127, 128, (D, ROWS, BLOCK)).astype(np.int8))
+    s = jnp.asarray(np.abs(r.randn(D, ROWS, 1)).astype(np.float32))
+    got = dequant_sum(q, s, interpret=True)
+    ref = np.sum(np.asarray(q, np.float32) * np.asarray(s), axis=0)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-3)
+
+
+def test_pad_to_blocks():
+    x = jnp.arange(BLOCK * 3 + 7, dtype=jnp.float32)
+    b = pad_to_blocks(x)
+    assert b.shape[0] % ROWS == 0 and b.shape[1] == BLOCK
+    np.testing.assert_array_equal(np.asarray(b.ravel()[: x.shape[0]]), np.asarray(x))
