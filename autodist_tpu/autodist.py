@@ -108,11 +108,20 @@ class AutoDist:
         rng=None,
         name: str = "",
         donate: bool = True,
+        remat: bool = False,
     ):
-        """Capture single-device code and return a distributed session."""
+        """Capture single-device code and return a distributed session.
+
+        ``remat=True`` wraps the loss in ``jax.checkpoint`` — trade FLOPs
+        for HBM by rematerializing activations in the backward pass.
+        """
         from autodist_tpu.kernel.graph_transformer import GraphTransformer
         from autodist_tpu.runner import DistributedSession
 
+        if remat:
+            import jax
+
+            loss_fn = jax.checkpoint(loss_fn)
         item = ModelItem(loss_fn, params, optimizer, sparse_vars=sparse_vars,
                          has_aux=has_aux, has_rng=has_rng,
                          mutable_state=mutable_state, eval_fn=eval_fn, name=name)

@@ -255,12 +255,12 @@ class GraphTransformer:
         g_leaves = self.treedef.flatten_up_to(grads)
         g_by_name = dict(zip(self.names, g_leaves))
 
-        # 3. bucketed allreduce for dense AR vars
-        comp_local = {k: (v[0] if not isinstance(v, tuple) else v)
-                      for k, v in comp.items()}
+        # 3. bucketed allreduce for dense AR vars (compressor state arrives
+        # stacked per device; unwrap the local copy, rewrap after)
+        comp_local = {k: jax.tree.map(lambda a: a[0], v) for k, v in comp.items()}
         synced, comp_new_local = ar_sync.sync_bucketed(
             g_by_name, self.buckets, comp_local, axis)
-        comp_new = {k: (v if isinstance(v, tuple) else v[None])
+        comp_new = {k: jax.tree.map(lambda a: a[None], v)
                     for k, v in comp_new_local.items()}
 
         # 4. update-space params/grads per variable
@@ -335,16 +335,16 @@ class GraphTransformer:
                 new_mutable, step + 1, rng, metrics)
 
     def init_comp_states(self):
-        """Fresh per-device compressor residuals (zeroed)."""
+        """Fresh per-device compressor state (a pytree per bucket; every
+        leaf is stacked along the replica axis, one copy per device)."""
+        sharding = NamedSharding(self.mesh, P(self.axis))
         comp = {}
         for key, base in ar_sync.init_compressor_states(self.buckets).items():
-            if isinstance(base, tuple):
-                comp[key] = ()
-            else:
-                # one residual per device: stack along the replica axis
-                comp[key] = jax.device_put(
-                    jnp.broadcast_to(base[None], (self.num_replicas,) + base.shape),
-                    NamedSharding(self.mesh, P(self.axis)))
+            comp[key] = jax.tree.map(
+                lambda b: jax.device_put(
+                    jnp.broadcast_to(b[None], (self.num_replicas,) + b.shape),
+                    sharding),
+                base)
         return comp
 
     # -- canonical (single-device) forms for checkpointing -----------------

@@ -170,12 +170,71 @@ class Int8CompressorEF(Int8Compressor):
         return reduced, residual
 
 
+class PowerSGDCompressor(Compressor):
+    """Low-rank gradient compression with error feedback (PowerSGD, Vogels
+    et al., arXiv 1905.13727).  The reference carries this compressor fully
+    commented out (``compressor.py:208-284``); here it works.
+
+    The flat bucket is viewed as a matrix M (rows x cols); one subspace
+    iteration approximates mean(M) ≈ P @ Q^T with P:(rows,r), Q:(cols,r):
+    P = orth(psum(M Q)); Q = psum(M^T P) / R.  Wire cost per step is
+    r*(rows+cols) instead of rows*cols.  State per bucket (per device):
+    the warm-started Q and the error-feedback residual.
+    """
+
+    name = "powersgd"
+    stateful = True
+    RANK = 4
+
+    @staticmethod
+    def _dims(size):
+        import math
+
+        rows = 1 << max(1, int(math.ceil(math.log2(math.sqrt(size)))))
+        cols = -(-size // rows)
+        return rows, cols
+
+    @classmethod
+    def _rank(cls, size):
+        # reduced QR returns (rows, min(rows, r)) columns; keep the carried
+        # Q shape stable by never asking for more rank than the matrix has
+        rows, cols = cls._dims(size)
+        return max(1, min(cls.RANK, rows, cols))
+
+    def init_state(self, size):
+        import numpy as np
+
+        rows, cols = self._dims(size)
+        r = self._rank(size)
+        rng = np.random.RandomState(size % (2 ** 31))
+        return {
+            "Q": jnp.asarray(rng.randn(cols, r) / np.sqrt(cols), jnp.float32),
+            "residual": jnp.zeros((size,), jnp.float32),
+        }
+
+    def all_reduce(self, buf, state, axis_name):
+        R = jax.lax.axis_size(axis_name)
+        n = buf.shape[0]
+        rows, cols = self._dims(n)
+        corrected = buf + state["residual"]
+        M = jnp.zeros((rows * cols,), buf.dtype).at[:n].set(corrected)
+        M = M.reshape(rows, cols)
+        P = M @ state["Q"]                                   # (rows, r)
+        P = jax.lax.psum(P, axis_name)
+        P, _ = jnp.linalg.qr(P)                              # orthonormalize
+        Q = jax.lax.psum(M.T @ P, axis_name) / R             # (cols, r)
+        approx = P @ Q.T                                     # ~ mean(M)
+        residual = (M - approx).reshape(-1)[:n]
+        return approx.reshape(-1)[:n], {"Q": Q, "residual": residual}
+
+
 _REGISTRY = {
     _C.NoneCompressor: NoneCompressor,
     _C.BF16Compressor: BF16Compressor,
     _C.BF16CompressorEF: BF16CompressorEF,
     _C.Int8Compressor: Int8Compressor,
     _C.Int8CompressorEF: Int8CompressorEF,
+    _C.PowerSGDCompressor: PowerSGDCompressor,
 }
 
 

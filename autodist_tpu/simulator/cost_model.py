@@ -95,8 +95,18 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                 ps_bytes += nbytes
                 gather_bytes += nbytes
         else:
-            comp_factor = {0: 1.0, 1: 0.5, 2: 0.5, 3: 0.25, 4: 0.25}.get(
-                plan.compressor, 1.0)
+            if plan.compressor == 5:  # PowerSGD: wire = r*(rows+cols) floats
+                from autodist_tpu.kernel.synchronization.compressor import (
+                    PowerSGDCompressor,
+                )
+
+                size = max(1, v.size)
+                rows, cols = PowerSGDCompressor._dims(size)
+                r = PowerSGDCompressor._rank(size)
+                comp_factor = min(1.0, r * (rows + cols) / size)
+            else:
+                comp_factor = {0: 1.0, 1: 0.5, 2: 0.5, 3: 0.25, 4: 0.25}.get(
+                    plan.compressor, 1.0)
             ar_bytes += nbytes * comp_factor
 
     comm_s = (_ring_time(ar_bytes, R, bw)

@@ -126,6 +126,7 @@ _COMPRESSOR_ALIASES = {
     "BF16CompressorEF": synchronizers_pb2.AllReduceSynchronizer.BF16CompressorEF,
     "Int8Compressor": synchronizers_pb2.AllReduceSynchronizer.Int8Compressor,
     "Int8CompressorEF": synchronizers_pb2.AllReduceSynchronizer.Int8CompressorEF,
+    "PowerSGDCompressor": synchronizers_pb2.AllReduceSynchronizer.PowerSGDCompressor,
 }
 
 

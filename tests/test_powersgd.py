@@ -1,0 +1,65 @@
+"""PowerSGD compressor: low-rank fidelity + error-feedback convergence."""
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce
+
+SPEC = ResourceSpec.from_num_chips(8)
+
+
+def test_rank1_gradient_captured_exactly():
+    """A rank-1 gradient fits inside the rank-4 approximation: training
+    should match uncompressed SGD closely."""
+    ad = AutoDist(resource_spec=SPEC,
+                  strategy_builder=AllReduce(compressor="PowerSGDCompressor"))
+    p = {"w": jnp.zeros((64, 32))}
+    loss = lambda p_, b: jnp.mean((b @ p_["w"]).sum(1))
+    sess = ad.distribute(loss, p, optax.sgd(0.01))
+    b = np.random.RandomState(0).randn(16, 64).astype(np.float32)
+    for _ in range(20):
+        sess.run(b)
+    got = sess.params()["w"]
+    exp = -0.01 * 20 * np.outer(b.mean(0), np.ones(32))  # true SGD trajectory
+    rel = np.abs(got - exp).max() / np.abs(exp).max()
+    assert rel < 0.05, rel
+
+
+def test_error_feedback_recovers_full_rank():
+    """A full-rank gradient can't fit in rank 4 per step, but EF residuals
+    must deliver it over time: the accumulated update converges to the
+    uncompressed trajectory."""
+    ad = AutoDist(resource_spec=SPEC,
+                  strategy_builder=AllReduce(compressor="PowerSGDCompressor"))
+    r = np.random.RandomState(1)
+    target = r.randn(32, 16).astype(np.float32)  # full-rank constant gradient
+
+    # loss with constant gradient -target (so w -> lr*steps*target)
+    loss = lambda p_, b: -jnp.sum(p_["w"] * jnp.asarray(target)) + 0.0 * jnp.sum(b)
+    sess = ad.distribute(loss, {"w": jnp.zeros((32, 16))}, optax.sgd(0.1))
+    b = np.zeros((8, 1), np.float32)
+    for _ in range(200):
+        sess.run(b)
+    got = sess.params()["w"]
+    exp = 0.1 * 200 * target
+    rel = np.abs(got - exp).max() / np.abs(exp).max()
+    assert rel < 0.1, rel  # EF closes the low-rank gap over steps
+
+
+def test_state_roundtrip_through_steps():
+    """Pytree compressor state (Q + residual) survives the step loop."""
+    ad = AutoDist(resource_spec=SPEC,
+                  strategy_builder=AllReduce(compressor="PowerSGDCompressor"))
+    sess = ad.distribute(lambda p_, b: jnp.mean(b @ p_["w"]),
+                         {"w": jnp.zeros((16, 4))}, optax.sgd(0.1))
+    b = np.ones((8, 16), np.float32)
+    sess.run(b)
+    comp = sess.state["comp"]
+    (key,) = comp.keys()
+    assert set(comp[key].keys()) == {"Q", "residual"}
+    q0 = np.asarray(comp[key]["Q"])
+    sess.run(b)
+    q1 = np.asarray(sess.state["comp"][key]["Q"])
+    assert q0.shape == q1.shape  # warm-started, carried across steps
