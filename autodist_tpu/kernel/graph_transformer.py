@@ -74,6 +74,15 @@ class GraphTransformer:
         shapes = {v.name: v.shape for v in model_item.var_infos}
         dtypes = {v.name: v.dtype for v in model_item.var_infos}
         self.buckets = ar_sync.plan_buckets(self.plans, shapes, dtypes)
+        # fused-PS groups (static): dtype -> ordered names of dense
+        # replicated PS vars whose reduce-scatter/all-gather are merged
+        self.ps_groups = {}
+        for name in self.names:
+            plan = self.plans[name]
+            if (plan.sync == part.SyncKind.PS
+                    and plan.placement == Placement.REPLICATED
+                    and not plan.sparse):
+                self.ps_groups.setdefault(str(np.dtype(plan.dtype)), []).append(name)
         logging.info(
             "Transform plan: %d vars, %d AR buckets, placements=%s",
             len(self.names), len(self.buckets),
@@ -263,7 +272,36 @@ class GraphTransformer:
         comp_new = {k: jax.tree.map(lambda a: a[None], v)
                     for k, v in comp_new_local.items()}
 
-        # 4. update-space params/grads per variable
+        # 4a. fused reduce-scatter for the dense PS family: every PS var's
+        # flat padding reshapes to (R, shard); concatenating along dim 1
+        # lets ONE psum_scatter per dtype deliver every device exactly its
+        # row — its shard of every variable — instead of a collective per
+        # variable (hundreds, for transformer-sized models).
+        def _ps_shard_len(plan):
+            n = int(np.prod(plan.shape)) if plan.shape else 1
+            return (-(-n // R) * R) // R
+
+        ps_fused = self.ps_groups
+        ps_grad_shards = {}
+        for dtype, names_d in ps_fused.items():
+            mats = []
+            for name in names_d:
+                plan = self.plans[name]
+                g = g_by_name[name]
+                ss = _ps_shard_len(plan)
+                flatg = jnp.zeros((ss * R,), g.dtype).at[:g.size].set(g.ravel())
+                mats.append(flatg.reshape(R, ss))
+            bucket = jnp.concatenate(mats, axis=1) if len(mats) > 1 else mats[0]
+            red = jax.lax.psum_scatter(bucket, axis, scatter_dimension=0,
+                                       tiled=True) / R        # (1, S) -> (S,)
+            red = red.reshape(-1)
+            off = 0
+            for name in names_d:
+                ss = _ps_shard_len(self.plans[name])
+                ps_grad_shards[name] = jax.lax.dynamic_slice_in_dim(red, off, ss)
+                off += ss
+
+        # 4b. update-space params/grads per variable
         u_params, u_grads = [], []
         for name, plan, s_leaf in zip(self.names, plans, s_leaves):
             g = g_by_name[name]
@@ -287,15 +325,15 @@ class GraphTransformer:
                 u_grads.append(g[None])
             elif plan.sync == SyncKind.PS:
                 n = int(np.prod(plan.shape)) if plan.shape else 1
-                npad = -(-n // R) * R
-                ss = npad // R
+                ss = _ps_shard_len(plan)
+                npad = ss * R
                 flatp = jnp.zeros((npad,), s_leaf.dtype).at[:n].set(s_leaf.ravel())
-                flatg = jnp.zeros((npad,), g.dtype).at[:n].set(g.ravel())
                 u_params.append(jax.lax.dynamic_slice_in_dim(flatp, my * ss, ss))
                 if plan.sparse:
+                    flatg = jnp.zeros((npad,), g.dtype).at[:n].set(g.ravel())
                     ug = jax.lax.dynamic_slice_in_dim(flatg, my * ss, ss)
                 else:
-                    ug = jax.lax.psum_scatter(flatg, axis, tiled=True) / R
+                    ug = ps_grad_shards[name]
                 u_grads.append(ug)
             else:  # REPLICATED + AllReduce
                 u_params.append(s_leaf)
@@ -310,7 +348,26 @@ class GraphTransformer:
         new_u = optax.apply_updates(u_params_t, updates)
         new_u_leaves = self.treedef.flatten_up_to(new_u)
 
-        # 6. write back to storage
+        # 6a. fused all-gather of updated PS shards (mirror of 4a): one
+        # all_gather per dtype rebuilds every PS variable's full value.
+        new_by_name = dict(zip(self.names, new_u_leaves))
+        ps_full = {}
+        for dtype, names_d in ps_fused.items():
+            cat = (jnp.concatenate([new_by_name[n] for n in names_d])
+                   if len(names_d) > 1 else new_by_name[names_d[0]])
+            S = cat.shape[0]
+            gathered = jax.lax.all_gather(cat, axis, axis=0, tiled=True)
+            gathered = gathered.reshape(R, S)
+            off = 0
+            for name in names_d:
+                plan = self.plans[name]
+                ss = _ps_shard_len(plan)
+                n = int(np.prod(plan.shape)) if plan.shape else 1
+                cols = jax.lax.dynamic_slice_in_dim(gathered, off, ss, axis=1)
+                ps_full[name] = jnp.reshape(cols.reshape(-1)[:n], plan.shape)
+                off += ss
+
+        # 6b. write back to storage
         new_storage = []
         for name, plan, nu, s_leaf in zip(self.names, plans, new_u_leaves, s_leaves):
             if plan.placement == Placement.SHARDED:
@@ -321,9 +378,12 @@ class GraphTransformer:
                 avg = jax.lax.pmean(nu, axis)
                 new_storage.append(jnp.where(do_avg, avg, nu))
             elif plan.sync == SyncKind.PS:
-                n = int(np.prod(plan.shape)) if plan.shape else 1
-                flat = jax.lax.all_gather(nu, axis, axis=0, tiled=True)
-                new_storage.append(jnp.reshape(flat[:n], plan.shape))
+                if name in ps_full:
+                    new_storage.append(ps_full[name])
+                else:  # sparse PS var: gather its own shard ring
+                    n = int(np.prod(plan.shape)) if plan.shape else 1
+                    flat = jax.lax.all_gather(nu, axis, axis=0, tiled=True)
+                    new_storage.append(jnp.reshape(flat[:n], plan.shape))
             else:
                 new_storage.append(nu)
 

@@ -1,0 +1,111 @@
+"""Worker script for the multi-process distributed integration test.
+
+Each process runs the full chief/worker AutoDist flow over
+``jax.distributed`` with 2 virtual CPU devices per process: the chief builds
+and serializes the strategy; the worker discovers the serialized strategy id
+(the test-harness stand-in for the coordinator's env handoff), loads it, and
+both train in lockstep feeding host-local batch halves.
+
+argv: process_id num_processes coordinator_port strategy_name out_dir
+"""
+import json
+import os
+import sys
+import time
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+strategy_name = sys.argv[4]
+out_dir = sys.argv[5]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["AUTODIST_IS_TESTING"] = "True"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nproc, process_id=pid)
+# force backend init NOW: the cross-process topology exchange needs every
+# process to join before any of them can use the backend, and the worker is
+# about to block waiting for the chief's strategy file
+assert jax.device_count() == 2 * nproc
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu.const import DEFAULT_SERIALIZATION_DIR  # noqa: E402
+from autodist_tpu import strategy as S  # noqa: E402
+from autodist_tpu.autodist import AutoDist  # noqa: E402
+from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
+
+R = 2 * nproc  # global replica count
+
+if pid != 0:
+    # worker role: wait for the chief's serialized strategy (the test's
+    # stand-in for AUTODIST_STRATEGY_ID env injection by the coordinator)
+    marker = os.path.join(out_dir, "strategy_id")
+    deadline = time.time() + 60
+    while not os.path.exists(marker):
+        if time.time() > deadline:
+            raise TimeoutError("chief never published a strategy id")
+        time.sleep(0.05)
+    with open(marker) as f:
+        os.environ["AUTODIST_WORKER"] = "worker"
+        os.environ["AUTODIST_STRATEGY_ID"] = f.read().strip()
+
+# reload role constants after env changes
+import importlib  # noqa: E402
+import autodist_tpu.const as const  # noqa: E402
+
+importlib.reload(const)
+import autodist_tpu.autodist as admod  # noqa: E402
+
+importlib.reload(admod)
+
+spec = ResourceSpec.from_num_chips(R)
+builder = getattr(S, strategy_name)()
+ad = admod.AutoDist(resource_spec=spec, strategy_builder=builder)
+
+
+def loss_fn(p, batch):
+    return jnp.mean((batch @ p["w"]) ** 2)
+
+
+params = {"w": jnp.asarray(np.linspace(1, 2, 6, dtype=np.float32))}
+
+if pid == 0:
+    # publish the id as the coordinator would (serialize happens in build)
+    orig_build = ad._build_or_load_strategy
+
+    def publishing_build(item):
+        s = orig_build(item)
+        with open(os.path.join(out_dir, "strategy_id.tmp"), "w") as f:
+            f.write(s.id)
+        os.replace(os.path.join(out_dir, "strategy_id.tmp"),
+                   os.path.join(out_dir, "strategy_id"))
+        return s
+
+    ad._build_or_load_strategy = publishing_build
+
+sess = ad.distribute(loss_fn, params, optax.sgd(0.1))
+
+# global batch is seeded and identical across processes; each feeds its slice
+full = np.random.RandomState(0).randn(4 * R, 6).astype(np.float32)
+local = full[pid * (len(full) // nproc):(pid + 1) * (len(full) // nproc)]
+for _ in range(3):
+    metrics = sess.run(local)
+
+result = {
+    "pid": pid,
+    "loss": float(metrics["loss"]),
+    "w": np.asarray(sess.params()["w"]).tolist(),
+    "strategy": strategy_name,
+}
+with open(os.path.join(out_dir, f"result_{pid}.json"), "w") as f:
+    json.dump(result, f)
+print("OK", pid, result["loss"])
