@@ -109,6 +109,8 @@ class AutoDist:
         name: str = "",
         donate: bool = True,
         remat: bool = False,
+        data_axes=None,
+        batch_spec=None,
     ):
         """Capture single-device code and return a distributed session.
 
@@ -126,7 +128,8 @@ class AutoDist:
                          has_aux=has_aux, has_rng=has_rng,
                          mutable_state=mutable_state, eval_fn=eval_fn, name=name)
         strategy = self.build_strategy(item)
-        transformer = GraphTransformer(strategy, item, self.mesh)
+        transformer = GraphTransformer(strategy, item, self.mesh,
+                                       data_axes=data_axes, batch_spec=batch_spec)
         return DistributedSession(transformer, rng=rng, donate=donate)
 
     # parity alias with the reference's create_distributed_session

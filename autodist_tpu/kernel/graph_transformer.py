@@ -54,12 +54,43 @@ def _unbox(tree):
 class GraphTransformer:
     """Builds ``init_state`` and the jitted distributed ``train_step``."""
 
-    def __init__(self, strategy, model_item, mesh):
+    def __init__(self, strategy, model_item, mesh, data_axes=None,
+                 batch_spec=None):
+        """`data_axes`: mesh axes forming the data-parallel device set
+        (default: ALL mesh axes — a pure-DP 1-D mesh, or replica x seq for
+        sequence parallelism where gradients still synchronize over every
+        device).  `batch_spec`: PartitionSpec prefix for batches; default
+        shards dim 0 over the first data axis (and, when a "seq" axis
+        exists, callers shard dim 1 over it via an explicit spec).
+        """
         self.strategy = strategy
         self.model_item = model_item
         self.mesh = mesh
-        self.axis = replica_axis(mesh)
-        self.num_replicas = mesh.shape[self.axis]
+        axes = tuple(data_axes) if data_axes else tuple(mesh.axis_names)
+        # self.axis: the axis (name or tuple) every gradient collective uses
+        self.axis = axes if len(axes) > 1 else axes[0]
+        self.data_axes = axes
+        self.num_replicas = int(np.prod([mesh.shape[a] for a in axes]))
+        from autodist_tpu.const import AXIS_SEQUENCE
+
+        has_seq = AXIS_SEQUENCE in mesh.axis_names and len(axes) > 1
+        if batch_spec is None:
+            if has_seq:
+                first = tuple(a for a in axes if a != AXIS_SEQUENCE)
+                batch_spec = P(first if len(first) > 1 else first[0], AXIS_SEQUENCE)
+            else:
+                batch_spec = P(axes[0])
+        self.batch_spec = batch_spec
+        # sequence parallelism is active only when the batch's sequence dim
+        # (dim >= 1) is actually sharded over the seq axis — a mesh merely
+        # CONTAINING an axis named "seq" (or using it for dim-0 data
+        # parallelism) must not trigger ring attention / position offsets
+        self.seq_axis = None
+        for entry in tuple(batch_spec)[1:]:
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if AXIS_SEQUENCE in names:
+                self.seq_axis = AXIS_SEQUENCE
+                break
 
         leaves = jax.tree_util.tree_leaves_with_path(model_item.params)
         self.names = [path_name(p) for p, _ in leaves]
@@ -217,9 +248,11 @@ class GraphTransformer:
         return x
 
     def _spmd_step(self, storage, opt_state, comp, mutable, step, rng, batch):
+        from autodist_tpu.parallel.collectives import axis_index
+
         axis = self.axis
         R = self.num_replicas
-        my = jax.lax.axis_index(axis)
+        my = axis_index(axis)
         plans = [self.plans[n] for n in self.names]
 
         # 1. materialize full params
@@ -249,7 +282,9 @@ class GraphTransformer:
         if item.has_rng:
             step_rng = jax.random.fold_in(jax.random.fold_in(rng, step), my)
             args = args + (step_rng,)
-        with replica_axis_context(axis):
+        from autodist_tpu.parallel.context import seq_axis_context
+
+        with replica_axis_context(axis), seq_axis_context(self.seq_axis):
             if has_mutable:
                 (loss, (new_mutable, aux)), grads = vag(*args)
                 # cross-replica average of float statistics (e.g. BN stats)
@@ -508,7 +543,11 @@ class GraphTransformer:
             state_spec = {"params": p_spec, "opt_state": opt_spec,
                           "comp": comp_spec, "mutable": P(),
                           "step": P(), "rng": P()}
-            in_specs = (state_spec, P(self.axis))
+            # per-leaf batch specs: lower-rank leaves (e.g. (B,) labels)
+            # shard only their leading dims
+            bspec = tuple(self.batch_spec)
+            batch_specs = jax.tree.map(lambda x: P(*bspec[:x.ndim]), batch)
+            in_specs = (state_spec, batch_specs)
             out_specs = (state_spec, P())
 
             def body(state_, batch_):

@@ -21,6 +21,8 @@ train state (the reference kept EF state as graph variables).
 import jax
 import jax.numpy as jnp
 
+from autodist_tpu.parallel.collectives import axis_size as _axis_size
+
 from autodist_tpu.proto import synchronizers_pb2
 
 _C = synchronizers_pb2.AllReduceSynchronizer
@@ -52,7 +54,7 @@ class BF16Compressor(Compressor):
     def all_reduce(self, buf, state, axis_name):
         wire = buf.astype(jnp.bfloat16)
         reduced = jax.lax.psum(wire, axis_name).astype(jnp.float32)
-        return reduced / jax.lax.axis_size(axis_name), state
+        return reduced / _axis_size(axis_name), state
 
 
 class BF16CompressorEF(BF16Compressor):
@@ -69,7 +71,7 @@ class BF16CompressorEF(BF16Compressor):
         wire = corrected.astype(jnp.bfloat16)
         residual = corrected - wire.astype(jnp.float32)
         reduced = jax.lax.psum(wire, axis_name).astype(jnp.float32)
-        return reduced / jax.lax.axis_size(axis_name), residual
+        return reduced / _axis_size(axis_name), residual
 
 
 def _quantize_int8(x, block):
@@ -101,7 +103,7 @@ class Int8Compressor(Compressor):
     BLOCK = 256
 
     def all_reduce(self, buf, state, axis_name):
-        n_dev = jax.lax.axis_size(axis_name)
+        n_dev = _axis_size(axis_name)
         n = buf.shape[0]
         # pad so chunks split evenly into blocks
         chunk = -(-n // n_dev)
@@ -213,7 +215,7 @@ class PowerSGDCompressor(Compressor):
         }
 
     def all_reduce(self, buf, state, axis_name):
-        R = jax.lax.axis_size(axis_name)
+        R = _axis_size(axis_name)
         n = buf.shape[0]
         rows, cols = self._dims(n)
         corrected = buf + state["residual"]

@@ -42,6 +42,9 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask, deterministic):
+        from autodist_tpu.parallel.context import current_seq_axis
+        from autodist_tpu.parallel.ring_attention import ring_attention
+
         c = self.config
         head_dim = c.hidden_size // c.num_heads
         # fused QKV: one big matmul keeps the MXU busy
@@ -50,8 +53,15 @@ class SelfAttention(nn.Module):
         B, S = x.shape[0], x.shape[1]
         shape = (B, S, c.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
-        bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
-        y = jax.nn.dot_product_attention(q, k, v, bias=bias)
+        seq_axis = current_seq_axis()
+        if seq_axis is not None:
+            # sequence-parallel: x holds this device's sequence block; K/V
+            # stream around the ring (full-mask attention; padding masks
+            # would need a gathered mask — use full blocks under SP)
+            y = ring_attention(q, k, v, seq_axis)
+        else:
+            bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
+            y = jax.nn.dot_product_attention(q, k, v, bias=bias)
         y = y.reshape(B, S, c.hidden_size)
         return nn.Dense(c.hidden_size, dtype=c.dtype, name="out")(y)
 
@@ -78,20 +88,35 @@ class Bert(nn.Module):
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  deterministic=True):
+        from autodist_tpu.parallel.context import current_seq_axis
+
         c = self.config
         B, S = input_ids.shape
+        if current_seq_axis() is not None and attention_mask is not None:
+            raise NotImplementedError(
+                "padding attention_mask is not supported under sequence "
+                "parallelism (K/V blocks ring-stream without a gathered "
+                "mask); feed full-length blocks instead")
         if attention_mask is None:
             attention_mask = jnp.ones((B, S), jnp.bool_)
         if token_type_ids is None:
             token_type_ids = jnp.zeros((B, S), jnp.int32)
+        # sync=False: the table is TIED to the MLM output projection, whose
+        # dense gradient the engine must synchronize — see embedding_lookup
         word_emb = self.param("word_embeddings", nn.initializers.normal(0.02),
                               (c.vocab_size, c.hidden_size), jnp.float32)
-        x = embedding_lookup(word_emb, input_ids)
+        x = embedding_lookup(word_emb, input_ids, sync=False)
         pos_emb = self.param("position_embeddings", nn.initializers.normal(0.02),
                              (c.max_position, c.hidden_size), jnp.float32)
         type_emb = self.param("type_embeddings", nn.initializers.normal(0.02),
                               (c.type_vocab_size, c.hidden_size), jnp.float32)
-        x = x + pos_emb[None, :S] + jnp.take(type_emb, token_type_ids, axis=0)
+        # under sequence parallelism S is the LOCAL block; positions offset
+        # to this device's global block start
+        from autodist_tpu.parallel.context import global_position_offset
+
+        pos0 = global_position_offset(S)
+        pos = jax.lax.dynamic_slice_in_dim(pos_emb, pos0, S)
+        x = x + pos[None] + jnp.take(type_emb, token_type_ids, axis=0)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_emb")(x.astype(c.dtype))
         x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
         for i in range(c.num_layers):
@@ -118,9 +143,18 @@ class BertForPreTraining(nn.Module):
         mlm_logits = (h.astype(jnp.float32) @ word_emb.T
                       + self.param("mlm_bias", nn.initializers.zeros,
                                    (c.vocab_size,), jnp.float32))
-        # NSP head on [CLS]
+        # NSP head on [CLS]; under sequence parallelism the true [CLS] lives
+        # on the seq-block-0 device — broadcast it to all blocks
+        from autodist_tpu.parallel.context import current_seq_axis
+
+        cls = x[:, 0]
+        seq_axis = current_seq_axis()
+        if seq_axis is not None:
+            idx = jax.lax.axis_index(seq_axis)
+            cls = jax.lax.psum(jnp.where(idx == 0, cls, jnp.zeros_like(cls)),
+                               seq_axis)
         pooled = jnp.tanh(nn.Dense(c.hidden_size, dtype=c.dtype,
-                                   name="pooler")(x[:, 0]))
+                                   name="pooler")(cls))
         nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp")(
             pooled.astype(jnp.float32))
         return mlm_logits, nsp_logits

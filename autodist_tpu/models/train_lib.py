@@ -60,7 +60,10 @@ def bert_capture(config, seq_len, rng=None):
             deterministic=False, rngs={"dropout": step_rng})
         return pretraining_loss(mlm, nsp, batch)
 
-    return loss_fn, params, ["bert/word_embeddings"]
+    # word_embeddings is tied to the MLM head -> its gradient is dense
+    # (rows + projection term); no variable qualifies for the pure-sparse
+    # path, matching the reference where tied IndexedSlices densify
+    return loss_fn, params, []
 
 
 def lm_capture(config, seq_len, rng=None):

@@ -64,17 +64,29 @@ def _make_lookup(tshape, tdtype):
             flat_g = jax.lax.all_gather(flat_g, axis_name, axis=0, tiled=True)
         dense = jnp.zeros(tshape, tdtype).at[flat_ids].add(flat_g)
         if axis_name is not None:
-            dense = dense / jax.lax.axis_size(axis_name)
+            from autodist_tpu.parallel.collectives import axis_size
+
+            dense = dense / axis_size(axis_name)
         return dense, None
 
     lookup.defvjp(fwd, bwd)
     return lookup
 
 
-def embedding_lookup(table, ids):
+def embedding_lookup(table, ids, sync=True):
     """Gather rows of ``table`` by integer ``ids`` (any leading shape).
 
-    Use this for variables declared in ``sparse_vars``: its backward pass
-    performs the sparse synchronization (see module docstring).
+    With ``sync=True`` (for variables declared in ``sparse_vars``) the
+    backward pass performs the sparse synchronization (see module
+    docstring).  **Contract**: a ``sparse_vars`` variable must be used
+    ONLY through sync=True lookups — any other use (e.g. a tied output
+    projection ``h @ table.T``) adds a device-local dense gradient that the
+    engine will NOT synchronize, silently diverging replicas.  For tied
+    embeddings pass ``sync=False`` and do NOT declare the variable sparse:
+    the engine then dense-synchronizes the combined gradient (exactly the
+    reference's behavior — TF densifies tied IndexedSlices, so Parallax
+    routes them to AllReduce).
     """
+    if not sync:
+        return jnp.take(table, ids, axis=0)
     return _make_lookup(tuple(table.shape), jnp.dtype(table.dtype).name)(table, ids)

@@ -50,10 +50,21 @@ def ppermute(x, axis_name, perm):
 
 
 def axis_index(axis_name):
+    """Flattened index over one axis name or a tuple (major-to-minor)."""
+    if isinstance(axis_name, (tuple, list)):
+        idx = jax.lax.axis_index(axis_name[0])
+        for a in axis_name[1:]:
+            idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        return idx
     return jax.lax.axis_index(axis_name)
 
 
 def axis_size(axis_name):
+    """Total size over one axis name or a tuple of names."""
+    if isinstance(axis_name, (tuple, list)):
+        import math
+
+        return math.prod(jax.lax.axis_size(a) for a in axis_name)
     return jax.lax.axis_size(axis_name)
 
 

@@ -1,0 +1,42 @@
+"""Trace-time parallelism context.
+
+The graph transformer enters these contexts while tracing the SPMD step so
+library ops (sparse lookups, sequence-parallel attention, position offsets)
+can discover the mesh axes without threading them through user code — the
+functional analog of the reference's implicit graph-scope state.
+"""
+import contextlib
+import contextvars
+
+import jax
+
+_SEQ_AXIS = contextvars.ContextVar("autodist_tpu_seq_axis", default=None)
+
+
+@contextlib.contextmanager
+def seq_axis_context(axis_name):
+    token = _SEQ_AXIS.set(axis_name)
+    try:
+        yield
+    finally:
+        _SEQ_AXIS.reset(token)
+
+
+def current_seq_axis():
+    """Mesh axis name the sequence dimension is sharded over, or None."""
+    return _SEQ_AXIS.get()
+
+
+def seq_shard_info():
+    """(index, size) of this device along the sequence axis; (0, 1) when
+    sequence parallelism is off."""
+    axis = current_seq_axis()
+    if axis is None:
+        return 0, 1
+    return jax.lax.axis_index(axis), jax.lax.axis_size(axis)
+
+
+def global_position_offset(local_len):
+    """Global token-position offset of this device's sequence block."""
+    idx, _ = seq_shard_info()
+    return idx * local_len

@@ -14,6 +14,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from autodist_tpu.kernel.partitioner import Placement
 from autodist_tpu.utils import logging
 
 
@@ -24,33 +25,53 @@ class DistributedSession:
         self._axis = transformer.axis
         self.state = transformer.init_state(rng=rng)
         self._step = transformer.make_train_step(donate=donate)
-        self._batch_sharding = NamedSharding(self._mesh, P(self._axis))
+        self._batch_spec = transformer.batch_spec
         self._multi_host = jax.process_count() > 1
         self._eval_cache = {}
 
     # -- feeds (reference remapper._remap_feed analog) ---------------------
 
+    def _spec_dim_size(self, entry):
+        """Mesh-device count a batch dim is split across for one spec entry."""
+        if entry is None:
+            return 1
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        for a in axes:
+            size *= self._mesh.shape[a]
+        return size
+
     def _shard_batch(self, batch):
-        # each process feeds its host-local slice; it must split across the
-        # devices this process contributes to the replica axis
-        denom = (jax.local_device_count() if self._multi_host
-                 else self._t.num_replicas)
+        spec = tuple(self._batch_spec)
 
         def put(x):
             x = np.asarray(x) if not isinstance(x, jax.Array) else x
-            if x.ndim == 0 or x.shape[0] % denom != 0:
-                raise ValueError(
-                    f"Batch leading dimension must be divisible by the "
-                    f"{'local device count' if self._multi_host else 'replica count'} "
-                    f"({denom}); got shape {x.shape}. Pad or trim the batch "
-                    f"(the reference's np.array_split uneven feed has no "
-                    f"SPMD equivalent).")
+            # leaves with fewer dims than the spec (e.g. (B,) labels under a
+            # (replica, seq) spec) shard only their leading dims
+            leaf_spec = P(*spec[:x.ndim])
             if self._multi_host:
+                # host-local slices: divisibility/layout is validated by the
+                # global-array conversion against per-host shard shapes
                 from jax.experimental import multihost_utils
 
                 return multihost_utils.host_local_array_to_global_array(
-                    x, self._mesh, P(self._axis))
-            return jax.device_put(x, self._batch_sharding)
+                    x, self._mesh, leaf_spec)
+            entries = tuple(leaf_spec)
+            if entries:
+                n0 = self._spec_dim_size(entries[0])
+                if x.ndim == 0 or x.shape[0] % n0 != 0:
+                    raise ValueError(
+                        f"Batch leading dimension must be divisible by the "
+                        f"replica count ({n0}); got shape {x.shape}. Pad or "
+                        f"trim the batch (the reference's np.array_split "
+                        f"uneven feed has no SPMD equivalent).")
+            for d, entry in enumerate(entries[1:], start=1):
+                n = self._spec_dim_size(entry)
+                if n > 1 and x.shape[d] % n != 0:
+                    raise ValueError(
+                        f"Batch dim {d} must be divisible by {n} (sharded "
+                        f"over {entry}); got shape {x.shape}")
+            return jax.device_put(x, NamedSharding(self._mesh, leaf_spec))
 
         return jax.tree.map(put, batch)
 
@@ -116,9 +137,29 @@ class DistributedSession:
         if self._multi_host:
             from jax.experimental import multihost_utils
 
+            spec = tuple(self._batch_spec)
+            out_specs = jax.tree.map(lambda x: P(*spec[:x.ndim]), out)
             return multihost_utils.global_array_to_host_local_array(
-                out, self._mesh, jax.tree.map(lambda _: P(self._axis), out))
+                out, self._mesh, out_specs)
         return jax.device_get(out)
+
+    def check_replication(self, atol=0.0):
+        """Debug guard: verify all REPLICATED storage really is identical
+        across devices.  Catches silent divergence (e.g. a variable with an
+        unsynchronized device-local gradient contribution).  Returns the
+        list of offending variable names (empty = healthy)."""
+        t = self._t
+        bad = []
+        leaves = t.treedef.flatten_up_to(self.state["params"])
+        for name, leaf in zip(t.names, leaves):
+            if t.plans[name].placement is not Placement.REPLICATED:
+                continue
+            shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+            for s in shards[1:]:
+                if not np.allclose(shards[0], s, atol=atol, rtol=0):
+                    bad.append(name)
+                    break
+        return bad
 
     def mutable_state(self):
         """Current non-trainable state (e.g. batch stats), host-fetched."""
