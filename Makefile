@@ -1,0 +1,30 @@
+# Developer entry points (CI parity with the reference's Jenkinsfile stages:
+# lint, local tests, distributed tests, benchmarks).
+PY ?= python
+
+.PHONY: test test-all test-dist native proto bench lint clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-all:
+	$(PY) -m pytest tests/ -q --run-integration
+
+test-dist:
+	$(PY) -m pytest tests/integration/ -q --run-integration
+
+native:
+	$(MAKE) -C native
+
+proto:
+	bash autodist_tpu/proto/gen.sh
+
+bench:
+	$(PY) bench.py
+
+lint:
+	$(PY) -m compileall -q autodist_tpu tests examples
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

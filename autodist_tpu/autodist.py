@@ -16,6 +16,7 @@ TPU-native UX (no graph capture needed — models are functions)::
 ``loss_fn(params, batch[, rng]) -> loss`` is single-device code; the
 framework distributes it according to the strategy.
 """
+import contextlib
 from typing import Any, Callable, Optional, Sequence
 
 from autodist_tpu import const
@@ -111,6 +112,7 @@ class AutoDist:
         remat: bool = False,
         data_axes=None,
         batch_spec=None,
+        accum_steps: int = 1,
     ):
         """Capture single-device code and return a distributed session.
 
@@ -129,11 +131,31 @@ class AutoDist:
                          mutable_state=mutable_state, eval_fn=eval_fn, name=name)
         strategy = self.build_strategy(item)
         transformer = GraphTransformer(strategy, item, self.mesh,
-                                       data_axes=data_axes, batch_spec=batch_spec)
+                                       data_axes=data_axes, batch_spec=batch_spec,
+                                       accum_steps=accum_steps)
         return DistributedSession(transformer, rng=rng, donate=donate)
 
     # parity alias with the reference's create_distributed_session
     create_distributed_session = distribute
+
+    @contextlib.contextmanager
+    def scope(self):
+        """Parity with the reference's ``ad.scope()`` (autodist.py:309-322).
+
+        In the reference this captures the TF default graph; in the
+        functional world there is no implicit graph, so the scope simply
+        marks this AutoDist as the process default for the block — model
+        code built inside may consult :func:`get_default_autodist`.
+        """
+        prev = _DEFAULT_AUTODIST.pop("instance", None)
+        _DEFAULT_AUTODIST["instance"] = self
+        try:
+            yield self
+        finally:
+            if prev is None:
+                _DEFAULT_AUTODIST.pop("instance", None)
+            else:
+                _DEFAULT_AUTODIST["instance"] = prev
 
     def function(self, loss_fn, params, optimizer, **kwargs):
         """Reference ``autodist.function`` UX (``autodist.py:201-289``):

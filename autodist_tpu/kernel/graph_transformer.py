@@ -55,7 +55,7 @@ class GraphTransformer:
     """Builds ``init_state`` and the jitted distributed ``train_step``."""
 
     def __init__(self, strategy, model_item, mesh, data_axes=None,
-                 batch_spec=None):
+                 batch_spec=None, accum_steps=1):
         """`data_axes`: mesh axes forming the data-parallel device set
         (default: ALL mesh axes — a pure-DP 1-D mesh, or replica x seq for
         sequence parallelism where gradients still synchronize over every
@@ -66,6 +66,7 @@ class GraphTransformer:
         self.strategy = strategy
         self.model_item = model_item
         self.mesh = mesh
+        self.accum_steps = int(accum_steps)
         axes = tuple(data_axes) if data_axes else tuple(mesh.axis_names)
         # self.axis: the axis (name or tuple) every gradient collective uses
         self.axis = axes if len(axes) > 1 else axes[0]
@@ -264,9 +265,10 @@ class GraphTransformer:
         item = self.model_item
         has_mutable = item.mutable_state is not None
 
-        def loss_wrapper(p, *rest):
+        def loss_wrapper(p, mut, *rest):
+            # normalized aux shape: (loss, (mutable_or_None, aux_dict))
             if has_mutable:
-                out = item.loss_fn(p, mutable, *rest)
+                out = item.loss_fn(p, mut, *rest)
                 if item.has_aux:
                     loss_, (new_mut, aux_) = out
                 else:
@@ -274,27 +276,67 @@ class GraphTransformer:
                     aux_ = {}
                 return loss_, (new_mut, aux_)
             if item.has_aux:
-                return item.loss_fn(p, *rest)
-            return item.loss_fn(p, *rest), {}
+                loss_, aux_ = item.loss_fn(p, *rest)
+                return loss_, (None, aux_)
+            return item.loss_fn(p, *rest), (None, {})
 
         vag = jax.value_and_grad(loss_wrapper, has_aux=True)
-        args = (full, batch)
-        if item.has_rng:
-            step_rng = jax.random.fold_in(jax.random.fold_in(rng, step), my)
-            args = args + (step_rng,)
+
+        def run_vag(micro_batch, micro_idx, mut):
+            args = (full, mut, micro_batch)
+            if item.has_rng:
+                step_rng = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.fold_in(rng, step), my),
+                    micro_idx)
+                args = args + (step_rng,)
+            return vag(*args)
+
         from autodist_tpu.parallel.context import seq_axis_context
 
+        A = self.accum_steps
         with replica_axis_context(axis), seq_axis_context(self.seq_axis):
+            if A <= 1:
+                (loss, (maybe_mut, aux)), grads = run_vag(batch, 0, mutable)
+                new_mutable = maybe_mut if has_mutable else None
+            else:
+                # gradient accumulation: split the local batch into A
+                # microbatches, scan value_and_grad, average — one sync per
+                # step regardless of A (trades HBM for step latency).
+                # Mutable state (e.g. BN stats) threads THROUGH the scan so
+                # each microbatch updates the previous one's statistics.
+                def to_micro(x):
+                    if x.shape[0] % A:
+                        raise ValueError(
+                            f"Per-device batch {x.shape[0]} must divide by "
+                            f"accum_steps={A}")
+                    return x.reshape((A, x.shape[0] // A) + x.shape[1:])
+
+                micro = jax.tree.map(to_micro, batch)
+
+                def scan_body(carry, mb_i):
+                    mb, i = mb_i
+                    acc_l, acc_g, mut_cur = carry
+                    (l, (mut_next, aux_)), g = run_vag(mb, i, mut_cur)
+                    if not has_mutable:
+                        mut_next = mut_cur
+                    return ((acc_l + l / A,
+                             jax.tree.map(lambda a, b: a + b / A, acc_g, g),
+                             mut_next),
+                            aux_)
+
+                zero_g = jax.tree.map(jnp.zeros_like, full)
+                (loss, grads, mut_final), auxs = jax.lax.scan(
+                    scan_body,
+                    (jnp.zeros((), jnp.float32), zero_g, mutable),
+                    (micro, jnp.arange(A)))
+                new_mutable = mut_final if has_mutable else None
+                aux = jax.tree.map(lambda x: jnp.mean(x, axis=0), auxs)
             if has_mutable:
-                (loss, (new_mutable, aux)), grads = vag(*args)
                 # cross-replica average of float statistics (e.g. BN stats)
                 new_mutable = jax.tree.map(
                     lambda x: jax.lax.pmean(x, axis)
                     if jnp.issubdtype(x.dtype, jnp.floating) else x,
                     new_mutable)
-            else:
-                (loss, aux), grads = vag(*args)
-                new_mutable = None
 
         g_leaves = self.treedef.flatten_up_to(grads)
         g_by_name = dict(zip(self.names, g_leaves))
@@ -408,10 +450,16 @@ class GraphTransformer:
             if plan.placement == Placement.SHARDED:
                 new_storage.append(nu)
             elif plan.placement == Placement.DIVERGENT:
+                # lax.cond skips the collective entirely on non-averaging
+                # steps (the whole point of staleness); the predicate is
+                # replicated so all devices take the same branch
                 period = plan.sync_period
                 do_avg = jnp.equal(jnp.mod(step + 1, period), 0)
-                avg = jax.lax.pmean(nu, axis)
-                new_storage.append(jnp.where(do_avg, avg, nu))
+                new_storage.append(jax.lax.cond(
+                    do_avg,
+                    lambda x: jax.lax.pmean(x, axis),
+                    lambda x: x,
+                    nu))
             elif plan.sync == SyncKind.PS:
                 if name in ps_full:
                     new_storage.append(ps_full[name])

@@ -1,0 +1,49 @@
+"""Network identity utilities (reference ``autodist/utils/network.py``:
+loopback/local-address detection via netifaces; here stdlib-only).
+Used by the cluster layer to decide local-vs-remote worker launch."""
+import ipaddress
+import socket
+
+_LOCAL_NAMES = {"localhost", "0.0.0.0"}
+
+
+def _host_of(address):
+    """Extract the host part of 'host', 'host:port', '[v6]:port', or a bare
+    IPv6 literal."""
+    if address.startswith("["):
+        return address[1:].split("]", 1)[0]
+    if address.count(":") == 1:
+        return address.split(":", 1)[0]
+    return address  # bare hostname, IPv4, or bare IPv6 literal
+
+
+def local_addresses():
+    """Addresses that resolve to this host."""
+    addrs = set(_LOCAL_NAMES)
+    hostname = socket.gethostname()
+    addrs.add(hostname)
+    try:
+        for info in socket.getaddrinfo(hostname, None):
+            addrs.add(info[4][0])
+    except socket.gaierror:
+        pass
+    return addrs
+
+
+def is_loopback_address(address):
+    host = _host_of(address)
+    try:
+        return ipaddress.ip_address(host).is_loopback
+    except ValueError:
+        return host == "localhost"
+
+
+def is_local_address(address):
+    """True if `address` names this machine (reference network.py:22-75)."""
+    host = _host_of(address)
+    if is_loopback_address(address) or host in local_addresses():
+        return True
+    try:
+        return socket.gethostbyname(host) in local_addresses() | {"127.0.0.1"}
+    except socket.gaierror:
+        return False
