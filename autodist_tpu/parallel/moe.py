@@ -1,0 +1,99 @@
+"""Expert parallelism: mixture-of-experts with all_to_all token routing.
+
+Beyond the reference's strategy space (SURVEY.md section 2.8 lists EP as a
+future dimension): experts are sharded over the ``expert`` mesh axis, and
+tokens travel to their expert's device via ``all_to_all`` — the standard
+TPU MoE dispatch (GShard-style), with fixed capacity so every shape is
+static for XLA.
+
+Functions run inside ``shard_map`` with the expert axis present.  The
+expert weights live sharded over the axis (one expert group per device); the
+engine stores them like any other array — callers shard via a leading
+``num_local_experts`` dim so EP composes with the strategy engine's
+replicated storage (weights replicated across the DATA axes, distinct along
+the expert axis is achieved by per-device slicing of a stacked tensor).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def top1_gating(logits, num_experts, capacity):
+    """Top-1 router with fixed per-expert capacity.  logits: (T, E).
+    Returns (expert_idx, gate, pos, keep): chosen expert per token, its
+    gate value (zeroed for overflow), the token's position in the expert's
+    queue, and the keep mask (False = dropped by capacity)."""
+    gate = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gate, axis=-1)                     # (T,)
+    gate_val = jnp.take_along_axis(gate, expert_idx[:, None], axis=-1)[:, 0]
+    # position of each token within its expert's queue
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # (T, E)
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot
+    pos = jnp.sum(pos_in_expert, axis=-1) - 1                  # (T,)
+    keep = pos < capacity                                      # overflow drops
+    return expert_idx, gate_val * keep, pos, keep
+
+
+def moe_dispatch(x, expert_idx, pos, keep, num_experts, capacity):
+    """Scatter tokens into (E, C, D) expert buffers (dropped slots zero)."""
+    T, D = x.shape
+    buf = jnp.zeros((num_experts, capacity, D), x.dtype)
+    safe_pos = jnp.where(keep, pos, 0)
+    buf = buf.at[expert_idx, safe_pos].add(
+        jnp.where(keep[:, None], x, 0.0))
+    return buf
+
+
+def moe_combine(buf, expert_idx, pos, keep, gate):
+    """Gather expert outputs back to token order, scaled by the gate."""
+    out = buf[expert_idx, jnp.where(keep, pos, 0)]
+    return out * (gate * keep)[:, None]
+
+
+def expert_parallel_ffn(x, gate_w, w_in, w_out, axis_name):
+    """MoE feed-forward over the expert mesh axis.
+
+    Args:
+      x: (T, D) local tokens.
+      gate_w: (D, E_total) router weights (replicated).
+      w_in: (E_local, D, H), w_out: (E_local, H, D) — this device's expert
+        group (storage: stacked (E_total_over_axis...) sliced per device by
+        the caller, or passed already-local inside shard_map).
+      axis_name: the expert mesh axis.
+
+    Routing: tokens are bucketed per GLOBAL expert, all_to_all sends each
+    device its experts' tokens, experts run locally (batched einsum — one
+    MXU matmul per projection), all_to_all returns outputs.
+    """
+    T, D = x.shape
+    n_dev = jax.lax.axis_size(axis_name)
+    e_local = w_in.shape[0]
+    n_exp = n_dev * e_local
+    capacity = max(1, (T * 2) // n_exp)  # capacity factor 2
+
+    if gate_w.shape[-1] != n_exp:
+        raise ValueError(
+            f"gate_w has {gate_w.shape[-1]} experts but the mesh provides "
+            f"{n_dev} devices x {e_local} local experts = {n_exp}")
+    logits = x @ gate_w                                   # (T, E_total)
+    expert_idx, gate, pos, keep = top1_gating(logits, n_exp, capacity)
+    buf = moe_dispatch(x, expert_idx, pos, keep, n_exp, capacity)
+    # (E_total, C, D) -> exchange so device d holds ITS experts' tokens from
+    # every peer: (E_local, n_dev, C, D) after the all_to_all + reshape
+    buf = buf.reshape(n_dev, e_local, capacity, D)
+    buf = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=2,
+                             tiled=True)        # -> (1, e_local, n_dev*C, D)
+    buf = buf.reshape(e_local, n_dev * capacity, D)
+    # run local experts: batched matmuls
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", buf, w_in))
+    y = jnp.einsum("ech,ehd->ecd", h, w_out)              # (E_local, n_dev*C, D)
+    # send results back
+    y = y.reshape(e_local, n_dev, capacity, D)
+    y = jax.lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
+                           tiled=True)
+    y = y.reshape(n_exp, capacity, D)
+    out = moe_combine(y, expert_idx, pos, keep, gate)
+    # auxiliary load-balance loss (Switch-style)
+    density = jnp.mean(jax.nn.one_hot(expert_idx, n_exp), axis=0)
+    router_prob = jnp.mean(jax.nn.softmax(logits, axis=-1), axis=0)
+    aux_loss = n_exp * jnp.sum(density * router_prob)
+    return out, aux_loss

@@ -1,0 +1,80 @@
+"""Expert-parallel MoE: all_to_all routing vs a single-device reference."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.parallel.mesh import build_mesh
+from autodist_tpu.parallel.moe import (
+    expert_parallel_ffn, moe_combine, moe_dispatch, top1_gating,
+)
+
+E, D, H, T = 8, 16, 32, 64
+
+
+def _weights(seed=0):
+    r = np.random.RandomState(seed)
+    return (jnp.asarray(r.randn(D, E), jnp.float32) * 0.5,
+            jnp.asarray(r.randn(E, D, H), jnp.float32) * 0.1,
+            jnp.asarray(r.randn(E, H, D), jnp.float32) * 0.1)
+
+
+def _dense_reference(x, gate_w, w_in, w_out, capacity):
+    """Same MoE math with all experts on one device."""
+    logits = x @ gate_w
+    idx, gate, pos, keep = top1_gating(logits, E, capacity)
+    buf = moe_dispatch(x, idx, pos, keep, E, capacity)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", buf, w_in))
+    y = jnp.einsum("ech,ehd->ecd", h, w_out)
+    return moe_combine(y, idx, pos, keep, gate)
+
+
+def test_expert_parallel_matches_dense():
+    mesh = build_mesh(axes={"expert": 8})
+    gate_w, w_in, w_out = _weights()
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.randn(T, D), jnp.float32)
+
+    capacity = max(1, (T * 2) // E)
+    want = _dense_reference(x, gate_w, w_in, w_out, capacity)
+
+    def f(x_, gw, wi, wo):
+        out, aux = expert_parallel_ffn(x_, gw, wi, wo, "expert")
+        return out, aux
+
+    got, aux = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(jax.P(), jax.P(), jax.P("expert"), jax.P("expert")),
+        out_specs=(jax.P(), jax.P()),
+        check_vma=False,
+    ))(x, gate_w, w_in, w_out)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    assert float(aux) > 0  # load-balance loss well-defined
+
+
+def test_expert_parallel_sharded_tokens():
+    """Tokens distributed over the expert axis: per-device routing, finite
+    outputs, correct shapes."""
+    mesh = build_mesh(axes={"expert": 8})
+    gate_w, w_in, w_out = _weights()
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(T, D), jnp.float32)
+
+    def f(x_, gw, wi, wo):
+        out, aux = expert_parallel_ffn(x_, gw, wi, wo, "expert")
+        return out, jax.lax.pmean(aux, "expert")
+
+    got, aux = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(jax.P("expert"), jax.P(), jax.P("expert"), jax.P("expert")),
+        out_specs=(jax.P("expert"), jax.P()),
+        check_vma=False,
+    ))(x, gate_w, w_in, w_out)
+    assert got.shape == x.shape
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_gating_capacity_drops_overflow():
+    logits = jnp.zeros((10, 2)).at[:, 0].set(1.0)  # all tokens pick expert 0
+    idx, gate, pos, keep = top1_gating(logits, 2, capacity=4)
+    assert int(keep.sum()) == 4  # only capacity tokens kept
+    assert np.all(np.asarray(idx) == 0)
