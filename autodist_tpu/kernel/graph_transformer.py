@@ -38,17 +38,14 @@ from autodist_tpu.utils import logging
 
 
 class _SpecBox:
-    """Opaque holder so PartitionSpecs survive tree_map as leaves."""
+    """Opaque holder so PartitionSpecs (plus the expected update-space
+    shape) survive tree_map as leaves."""
 
-    __slots__ = ("spec",)
+    __slots__ = ("spec", "expected_shape")
 
-    def __init__(self, spec):
+    def __init__(self, spec, expected_shape=None):
         self.spec = spec
-
-
-def _unbox(tree):
-    return jax.tree.map(lambda b: b.spec, tree,
-                        is_leaf=lambda x: isinstance(x, _SpecBox))
+        self.expected_shape = expected_shape
 
 
 class GraphTransformer:
@@ -132,18 +129,32 @@ class GraphTransformer:
         return self.treedef.unflatten(self._params_spec_leaves(space))
 
     def _opt_spec_tree(self, opt_state_shapes):
+        specs = self._params_spec_leaves("update")
+        shapes = [part.update_space_shape(self.plans[n], self.num_replicas)
+                  for n in self.names]
         boxed = self.treedef.unflatten(
-            [_SpecBox(s) for s in self._params_spec_leaves("update")]
+            [_SpecBox(s, shp) for s, shp in zip(specs, shapes)]
         )
         boxed_state = optax.tree_map_params(
             self.model_item.optimizer,
             lambda _leaf, box: box,
             opt_state_shapes,
             boxed,
-            transform_non_params=lambda _leaf: _SpecBox(P()),
+            transform_non_params=lambda _leaf: _SpecBox(P(), None),
             is_leaf=lambda x: isinstance(x, _SpecBox),
         )
-        return _unbox(boxed_state)
+
+        # some optimizers keep REDUCED state at param positions (novograd's
+        # per-param scalar norm, adafactor's factored rows/cols): only a
+        # leaf matching the update-space shape takes the sharded spec;
+        # reduced leaves stay replicated
+        def fit(shape_leaf, box):
+            if (box.expected_shape is not None
+                    and tuple(shape_leaf.shape) == tuple(box.expected_shape)):
+                return box.spec
+            return P()
+
+        return jax.tree.map(fit, opt_state_shapes, boxed_state)
 
     def _comp_spec(self):
         return {b.key: (P(self.axis) if get_stateful(b) else ())
@@ -493,7 +504,11 @@ class GraphTransformer:
     # -- canonical (single-device) forms for checkpointing -----------------
 
     def _canon_leaf(self, leaf, plan):
-        """update-space array -> original param shape (global arrays)."""
+        """update-space array -> original param shape (global arrays).
+        Leaves that are not update-space-shaped (e.g. a per-param scalar
+        statistic) pass through unchanged."""
+        if tuple(leaf.shape) != part.update_space_shape(plan, self.num_replicas):
+            return leaf
         if plan.placement == Placement.SHARDED:
             dim = plan.shape[plan.partition_axis]
             if leaf.shape[plan.partition_axis] != dim:
@@ -507,8 +522,11 @@ class GraphTransformer:
         return leaf
 
     def _uncanon_leaf(self, leaf, plan):
-        """original param shape -> update-space array (inverse of above)."""
+        """original param shape -> update-space array (inverse of above).
+        Non-param-shaped leaves (per-param scalar statistics) pass through."""
         R = self.num_replicas
+        if tuple(leaf.shape) != tuple(plan.shape):
+            return leaf
         if plan.placement == Placement.SHARDED:
             pad = plan.padded_dim - leaf.shape[plan.partition_axis]
             if pad:

@@ -136,9 +136,17 @@ def build_var_plans(strategy, model_item, num_replicas):
         else:
             logging.debug("Variable %s node has no synchronizer; AllReduce default", v.name)
 
+        if len(v.shape) == 0:
+            # scalars: sharding/divergence buys nothing and makes their
+            # update space ambiguous with scalar optimizer statistics —
+            # always replicate + allreduce
+            if plan.sync != SyncKind.ALL_REDUCE:
+                logging.debug("Scalar variable %s: forcing AllReduce sync", v.name)
+            plan.sync = SyncKind.ALL_REDUCE
+            plan.placement = Placement.REPLICATED
+            plans[v.name] = plan
+            continue
         if axis is not None:
-            if len(v.shape) == 0:
-                raise ValueError(f"Cannot partition scalar variable {v.name}")
             plan.placement = Placement.SHARDED
             plan.partition_axis = axis
             plan.logical_shards = k

@@ -1,0 +1,93 @@
+"""Optimizer matrix: the reference parametrizes update-op discovery over 14
+optimizer configs (tests/test_graph_item.py:53-85); the functional analog is
+value-exactness of the distributed step vs single-device training for a wide
+optax matrix — including PS strategies whose optimizer STATE is sharded.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import PS, AllReduce, PartitionedPS
+
+SPEC = ResourceSpec.from_num_chips(8)
+BATCH = np.random.RandomState(0).randn(16, 10).astype(np.float32)
+
+OPTIMIZERS = {
+    "sgd": lambda: optax.sgd(0.05),
+    "momentum": lambda: optax.sgd(0.05, momentum=0.9),
+    "nesterov": lambda: optax.sgd(0.05, momentum=0.9, nesterov=True),
+    "adam": lambda: optax.adam(0.01),
+    "adamw": lambda: optax.adamw(0.01, weight_decay=0.01),
+    "adagrad": lambda: optax.adagrad(0.05),
+    "rmsprop": lambda: optax.rmsprop(0.01),
+    "adadelta": lambda: optax.adadelta(0.5),
+    "nadam": lambda: optax.nadam(0.01),
+    "radam": lambda: optax.radam(0.01),
+    "lamb": lambda: optax.lamb(0.01),
+    "lion": lambda: optax.lion(0.005),
+    "novograd": lambda: optax.novograd(0.01),
+    "amsgrad": lambda: optax.amsgrad(0.01),
+    "adafactor": lambda: optax.adafactor(0.01),
+}
+
+
+def _loss(p, b):
+    return jnp.mean((b @ p["w"] + p["b"]) ** 2)
+
+
+def _params():
+    r = np.random.RandomState(3)
+    return {"w": jnp.asarray(r.randn(10, 4), jnp.float32),
+            "b": jnp.zeros((4,), jnp.float32)}
+
+
+def _oracle(opt, steps=3):
+    p = _params()
+    st = opt.init(p)
+    for _ in range(steps):
+        g = jax.grad(_loss)(p, jnp.asarray(BATCH))
+        u, st = opt.update(g, st, p)
+        p = optax.apply_updates(p, u)
+    return p
+
+
+# Optimizers whose update depends on PER-PARAMETER aggregates (lamb's trust
+# ratio, novograd's per-layer grad norm).  Under weight-update-sharded PS /
+# partitioned storage the optimizer sees per-SHARD buffers, so these
+# aggregates become per-shard — a documented deviation (same class of caveat
+# as clip_by_global_norm).  They remain exact under AllReduce.
+NON_ELEMENTWISE = {"lamb", "novograd", "adafactor"}
+
+
+@pytest.mark.parametrize("opt_name", sorted(OPTIMIZERS))
+@pytest.mark.parametrize("builder_cls", [AllReduce, PS])
+def test_optimizer_value_exact(opt_name, builder_cls):
+    if builder_cls is PS and opt_name in NON_ELEMENTWISE:
+        pytest.skip("per-param-aggregate optimizer under sharded update "
+                    "space: see test_nonelementwise_optimizer_caveat")
+    opt = OPTIMIZERS[opt_name]()
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=builder_cls())
+    sess = ad.distribute(_loss, _params(), opt)
+    for _ in range(3):
+        sess.run(BATCH)
+    exp = _oracle(opt)
+    got = sess.params()
+    np.testing.assert_allclose(got["w"], exp["w"], atol=5e-5,
+                               err_msg=f"{opt_name}/{builder_cls.__name__}")
+    np.testing.assert_allclose(got["b"], exp["b"], atol=5e-5)
+
+
+@pytest.mark.parametrize("opt_name", sorted(NON_ELEMENTWISE))
+def test_nonelementwise_optimizer_caveat(opt_name):
+    """Per-param-aggregate optimizers under sharded update space: per-shard
+    aggregates deviate from single-device training but must stay finite and
+    converge (use AllReduce for exact semantics with these optimizers)."""
+    for builder in [PS(), PartitionedPS(max_shards=8)]:
+        ad = AutoDist(resource_spec=SPEC, strategy_builder=builder)
+        sess = ad.distribute(_loss, _params(), OPTIMIZERS[opt_name]())
+        losses = [float(sess.run(BATCH)["loss"]) for _ in range(5)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0], opt_name
