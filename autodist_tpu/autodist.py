@@ -113,6 +113,7 @@ class AutoDist:
         data_axes=None,
         batch_spec=None,
         accum_steps: int = 1,
+        clip_global_norm=None,
     ):
         """Capture single-device code and return a distributed session.
 
@@ -132,7 +133,8 @@ class AutoDist:
         strategy = self.build_strategy(item)
         transformer = GraphTransformer(strategy, item, self.mesh,
                                        data_axes=data_axes, batch_spec=batch_spec,
-                                       accum_steps=accum_steps)
+                                       accum_steps=accum_steps,
+                                       clip_global_norm=clip_global_norm)
         return DistributedSession(transformer, rng=rng, donate=donate)
 
     # parity alias with the reference's create_distributed_session

@@ -52,7 +52,7 @@ class GraphTransformer:
     """Builds ``init_state`` and the jitted distributed ``train_step``."""
 
     def __init__(self, strategy, model_item, mesh, data_axes=None,
-                 batch_spec=None, accum_steps=1):
+                 batch_spec=None, accum_steps=1, clip_global_norm=None):
         """`data_axes`: mesh axes forming the data-parallel device set
         (default: ALL mesh axes — a pure-DP 1-D mesh, or replica x seq for
         sequence parallelism where gradients still synchronize over every
@@ -64,6 +64,7 @@ class GraphTransformer:
         self.model_item = model_item
         self.mesh = mesh
         self.accum_steps = int(accum_steps)
+        self.clip_global_norm = clip_global_norm
         axes = tuple(data_axes) if data_axes else tuple(mesh.axis_names)
         # self.axis: the axis (name or tuple) every gradient collective uses
         self.axis = axes if len(axes) > 1 else axes[0]
@@ -426,6 +427,30 @@ class GraphTransformer:
             else:  # REPLICATED + AllReduce
                 u_params.append(s_leaf)
                 u_grads.append(synced.get(name, g))  # sparse: pre-synced
+
+        # 4c. mesh-aware global-norm clipping: optax.clip_by_global_norm
+        # would see per-shard norms for PS/SHARDED update spaces; here the
+        # TRUE global norm is assembled from per-leaf contributions (sharded
+        # leaves psum their squared sums; replicated leaves count once)
+        if self.clip_global_norm is not None:
+            sq = jnp.zeros((), jnp.float32)
+            sq_sharded = jnp.zeros((), jnp.float32)
+            for plan, ug in zip(plans, u_grads):
+                s = jnp.sum(jnp.square(ug.astype(jnp.float32)))
+                if plan.placement == Placement.DIVERGENT:
+                    # local (or pre-synced sparse) gradients: count each
+                    # device's copy once by averaging, not summing, over the
+                    # axis — keeps the norm comparable to single-device
+                    sq_sharded = sq_sharded + s / R
+                elif (plan.placement == Placement.SHARDED
+                        or plan.sync == SyncKind.PS):
+                    sq_sharded = sq_sharded + s  # disjoint shards: sum = true
+                else:
+                    sq = sq + s
+            total = sq + jax.lax.psum(sq_sharded, axis)
+            norm = jnp.sqrt(total)
+            scale = jnp.minimum(1.0, self.clip_global_norm / jnp.maximum(norm, 1e-12))
+            u_grads = [g * scale.astype(g.dtype) for g in u_grads]
 
         u_params_t = self.treedef.unflatten(u_params)
         u_grads_t = self.treedef.unflatten(u_grads)

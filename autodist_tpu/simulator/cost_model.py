@@ -130,6 +130,35 @@ def rank_strategies(builders, model_item, resource_spec, **kw):
     return scored
 
 
+def measure_and_record(session, batch, resource_yaml="", steps=10, warmup=2):
+    """Measure a session's step time and produce an AutoSync-style
+    :class:`RuntimeRecord` — the reference dataset's (model, resource,
+    strategy, runtime) tuple (``simulator/dataset/README.md``)."""
+    import time
+
+    import jax
+
+    if steps < 1:
+        raise ValueError("steps must be >= 1")
+    last = None
+    for _ in range(warmup):
+        last = session.run(batch)
+    if last is not None:
+        jax.block_until_ready(last["loss"])  # don't time in-flight warmup
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = session.run(batch)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    t = session._t
+    return RuntimeRecord(
+        model_def=t.model_item.serialize(),
+        strategy_pb=t.strategy.proto.SerializeToString(),
+        resource_yaml=resource_yaml,
+        step_time_s=dt,
+    )
+
+
 @dataclasses.dataclass
 class RuntimeRecord:
     """AutoSync-style measured tuple: (model, resource, strategy, runtime)."""
