@@ -114,6 +114,7 @@ class AutoDist:
         batch_spec=None,
         accum_steps: int = 1,
         clip_global_norm=None,
+        param_specs=None,
     ):
         """Capture single-device code and return a distributed session.
 
@@ -134,7 +135,8 @@ class AutoDist:
         transformer = GraphTransformer(strategy, item, self.mesh,
                                        data_axes=data_axes, batch_spec=batch_spec,
                                        accum_steps=accum_steps,
-                                       clip_global_norm=clip_global_norm)
+                                       clip_global_norm=clip_global_norm,
+                                       param_specs=param_specs)
         return DistributedSession(transformer, rng=rng, donate=donate)
 
     # parity alias with the reference's create_distributed_session

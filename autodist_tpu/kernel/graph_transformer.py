@@ -52,7 +52,8 @@ class GraphTransformer:
     """Builds ``init_state`` and the jitted distributed ``train_step``."""
 
     def __init__(self, strategy, model_item, mesh, data_axes=None,
-                 batch_spec=None, accum_steps=1, clip_global_norm=None):
+                 batch_spec=None, accum_steps=1, clip_global_norm=None,
+                 param_specs=None):
         """`data_axes`: mesh axes forming the data-parallel device set
         (default: ALL mesh axes — a pure-DP 1-D mesh, or replica x seq for
         sequence parallelism where gradients still synchronize over every
@@ -96,7 +97,7 @@ class GraphTransformer:
         self.treedef = jax.tree_util.tree_structure(model_item.params)
 
         self.plans: Dict[str, part.VarPlan] = part.build_var_plans(
-            strategy, model_item, self.num_replicas
+            strategy, model_item, self.num_replicas, param_specs=param_specs
         )
         for name in self.names:
             if name not in self.plans:
@@ -104,6 +105,34 @@ class GraphTransformer:
         shapes = {v.name: v.shape for v in model_item.var_infos}
         dtypes = {v.name: v.dtype for v in model_item.var_infos}
         self.buckets = ar_sync.plan_buckets(self.plans, shapes, dtypes)
+        # CUSTOM (tensor-parallel) vars: specs must only name NON-data mesh
+        # axes (a data axis in a custom spec would make the data-axes pmean
+        # average distinct blocks); fuse their grad pmeans per (spec, dtype)
+        self.custom_groups = {}
+        for name in self.names:
+            plan = self.plans[name]
+            if plan.placement is not Placement.CUSTOM:
+                continue
+            spec_axes = set()
+            for entry in tuple(plan.custom_spec):
+                if entry is None:
+                    continue
+                spec_axes.update(entry if isinstance(entry, tuple) else (entry,))
+            bad = spec_axes & set(self.data_axes)
+            if bad:
+                raise ValueError(
+                    f"param_specs for {name!r} names data axes {sorted(bad)}; "
+                    f"custom specs may only use non-data (model) mesh axes — "
+                    f"pass data_axes=... excluding them")
+            unknown = spec_axes - set(mesh.axis_names)
+            if unknown:
+                raise ValueError(
+                    f"param_specs for {name!r} names unknown mesh axes "
+                    f"{sorted(unknown)}; mesh has {mesh.axis_names}")
+            key = (str(plan.custom_spec), str(np.dtype(plan.dtype)))
+            self.custom_groups.setdefault(key, ([], frozenset(spec_axes)))
+            self.custom_groups[key][0].append(name)
+
         # fused-PS groups (static): dtype -> ordered names of dense
         # replicated PS vars whose reduce-scatter/all-gather are merged
         self.ps_groups = {}
@@ -172,7 +201,7 @@ class GraphTransformer:
         R = self.num_replicas
 
         def to_storage(leaf, plan):
-            if plan.placement == Placement.REPLICATED:
+            if plan.placement in (Placement.REPLICATED, Placement.CUSTOM):
                 return leaf
             if plan.placement == Placement.SHARDED:
                 pad = plan.padded_dim - leaf.shape[plan.partition_axis]
@@ -238,8 +267,10 @@ class GraphTransformer:
     # -- the SPMD step -----------------------------------------------------
 
     def _materialize(self, leaf, plan):
-        """storage (local view) -> full param for the forward pass."""
-        if plan.placement == Placement.REPLICATED:
+        """storage (local view) -> what the forward pass sees.  CUSTOM
+        (tensor-parallel) vars stay LOCAL blocks — the loss fn handles them
+        with parallel.tensor_parallel helpers."""
+        if plan.placement in (Placement.REPLICATED, Placement.CUSTOM):
             return leaf
         if plan.placement == Placement.SHARDED:
             full = jax.lax.all_gather(leaf, self.axis, axis=plan.partition_axis,
@@ -390,11 +421,31 @@ class GraphTransformer:
                 ps_grad_shards[name] = jax.lax.dynamic_slice_in_dim(red, off, ss)
                 off += ss
 
+        # 4a'. fused pmean of CUSTOM (tensor-parallel) grads: one collective
+        # per (spec, dtype) group over the data axes instead of one per var
+        custom_synced = {}
+        for (_, _), (names_c, _axes) in self.custom_groups.items():
+            flats = [jnp.ravel(g_by_name[n]) for n in names_c]
+            buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            buf = jax.lax.pmean(buf, axis)
+            off = 0
+            for n in names_c:
+                gshape = g_by_name[n].shape
+                size = g_by_name[n].size
+                custom_synced[n] = jax.lax.dynamic_slice_in_dim(
+                    buf, off, size).reshape(gshape)
+                off += size
+
         # 4b. update-space params/grads per variable
         u_params, u_grads = [], []
         for name, plan, s_leaf in zip(self.names, plans, s_leaves):
             g = g_by_name[name]
-            if plan.placement == Placement.SHARDED:
+            if plan.placement == Placement.CUSTOM:
+                # tensor-parallel block: replicated over the data axes,
+                # sharded over model axes -> averaged over data axes (fused)
+                u_params.append(s_leaf)
+                u_grads.append(custom_synced[name])
+            elif plan.placement == Placement.SHARDED:
                 gp = self._pad_axis(g, plan)
                 if plan.sparse:
                     # pre-synced (replicated mean): take own block
@@ -432,12 +483,23 @@ class GraphTransformer:
         # would see per-shard norms for PS/SHARDED update spaces; here the
         # TRUE global norm is assembled from per-leaf contributions (sharded
         # leaves psum their squared sums; replicated leaves count once)
+        grad_norm = None
         if self.clip_global_norm is not None:
             sq = jnp.zeros((), jnp.float32)
             sq_sharded = jnp.zeros((), jnp.float32)
+            # CUSTOM blocks are disjoint only over the axes their spec
+            # names; psum per spec-axis set (a block replicated over an
+            # unnamed model axis must be counted once)
+            sq_custom = {}  # frozenset(axes) -> scalar
             for plan, ug in zip(plans, u_grads):
                 s = jnp.sum(jnp.square(ug.astype(jnp.float32)))
-                if plan.placement == Placement.DIVERGENT:
+                if plan.placement == Placement.CUSTOM:
+                    axes_key = next(a for (_, _), (ns, a)
+                                    in self.custom_groups.items()
+                                    if plan.name in ns)
+                    sq_custom[axes_key] = sq_custom.get(
+                        axes_key, jnp.zeros((), jnp.float32)) + s
+                elif plan.placement == Placement.DIVERGENT:
                     # local (or pre-synced sparse) gradients: count each
                     # device's copy once by averaging, not summing, over the
                     # axis — keeps the norm comparable to single-device
@@ -448,8 +510,12 @@ class GraphTransformer:
                 else:
                     sq = sq + s
             total = sq + jax.lax.psum(sq_sharded, axis)
-            norm = jnp.sqrt(total)
-            scale = jnp.minimum(1.0, self.clip_global_norm / jnp.maximum(norm, 1e-12))
+            for axes_key, s in sq_custom.items():
+                total = (total + jax.lax.psum(s, tuple(sorted(axes_key)))
+                         if axes_key else total + s)
+            grad_norm = jnp.sqrt(total)
+            scale = jnp.minimum(
+                1.0, self.clip_global_norm / jnp.maximum(grad_norm, 1e-12))
             u_grads = [g * scale.astype(g.dtype) for g in u_grads]
 
         u_params_t = self.treedef.unflatten(u_params)
@@ -483,7 +549,7 @@ class GraphTransformer:
         # 6b. write back to storage
         new_storage = []
         for name, plan, nu, s_leaf in zip(self.names, plans, new_u_leaves, s_leaves):
-            if plan.placement == Placement.SHARDED:
+            if plan.placement in (Placement.SHARDED, Placement.CUSTOM):
                 new_storage.append(nu)
             elif plan.placement == Placement.DIVERGENT:
                 # lax.cond skips the collective entirely on non-averaging
@@ -507,6 +573,9 @@ class GraphTransformer:
                 new_storage.append(nu)
 
         metrics = {"loss": jax.lax.pmean(loss, axis), "step": step + 1}
+        if grad_norm is not None:
+            # total already includes the cross-device psums -> replicated
+            metrics["grad_norm"] = grad_norm
         for k, v in (aux.items() if isinstance(aux, dict) else ()):
             metrics[k] = jax.lax.pmean(v, axis)
 

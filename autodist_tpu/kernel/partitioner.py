@@ -40,8 +40,11 @@ from autodist_tpu.utils import logging
 
 class Placement(enum.Enum):
     REPLICATED = "replicated"
-    SHARDED = "sharded"
-    DIVERGENT = "divergent"
+    SHARDED = "sharded"      # over the data axes (ZeRO-3 style)
+    DIVERGENT = "divergent"  # per-device copies (stale sync)
+    CUSTOM = "custom"        # user PartitionSpec (tensor parallelism): the
+    #                          loss fn receives the LOCAL block and uses
+    #                          parallel.tensor_parallel helpers
 
 
 class SyncKind(enum.Enum):
@@ -71,6 +74,8 @@ class VarPlan:
     staleness: int = 0
     local_replication: bool = False
     reduction_destination: str = ""
+    # CUSTOM placement: the user-supplied PartitionSpec
+    custom_spec: Optional[object] = None
     # logical metadata (cost model / parity with reference part_config)
     logical_shards: int = 1
 
@@ -93,16 +98,35 @@ def _partition_axis_of(node):
     return active[0], parts[active[0]]
 
 
-def build_var_plans(strategy, model_item, num_replicas):
+def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
     """Compute a VarPlan for every trainable variable.
 
     Variables without a node config default to AllReduce (the reference
     transformer would fail on them; defaulting is kinder and matches pjit
-    intuition).
+    intuition).  `param_specs` ({name_or_glob: PartitionSpec}) overrides a
+    variable to CUSTOM placement: stored with that spec (tensor
+    parallelism), gradients averaged over the data axes only.
     """
+    import fnmatch
+
+    param_specs = param_specs or {}
+    matched_patterns = set()
     plans = {}
     for v in model_item.var_infos:
         if not v.trainable:
+            continue
+        override = None
+        for pat, spec in param_specs.items():
+            if (v.name == pat or fnmatch.fnmatchcase(v.name, pat)
+                    or v.name.endswith("/" + pat)):
+                override = spec
+                matched_patterns.add(pat)
+                break
+        if override is not None:
+            plans[v.name] = VarPlan(
+                name=v.name, shape=v.shape, dtype=v.dtype,
+                placement=Placement.CUSTOM, sync=SyncKind.ALL_REDUCE,
+                sparse=False, custom_spec=override)
             continue
         node = strategy.node_for(v.name)
         plan = VarPlan(
@@ -155,11 +179,18 @@ def build_var_plans(strategy, model_item, num_replicas):
         elif plan.sync == SyncKind.PS and (not plan.ps_sync or plan.staleness > 0):
             plan.placement = Placement.DIVERGENT
         plans[v.name] = plan
+    unmatched = set(param_specs) - matched_patterns
+    if unmatched:
+        raise ValueError(
+            f"param_specs entries {sorted(unmatched)} match no trainable "
+            f"variable; have {[v.name for v in model_item.var_infos]}")
     return plans
 
 
 def storage_spec(plan, replica_axis="replica"):
     """PartitionSpec of the variable's *storage* array on the mesh."""
+    if plan.placement == Placement.CUSTOM:
+        return plan.custom_spec
     if plan.placement == Placement.REPLICATED:
         return P()
     if plan.placement == Placement.SHARDED:
@@ -175,6 +206,8 @@ def storage_spec(plan, replica_axis="replica"):
 def update_space_spec(plan, replica_axis="replica"):
     """PartitionSpec of the variable's *update-space* array (what the
     optimizer state mirrors)."""
+    if plan.placement == Placement.CUSTOM:
+        return plan.custom_spec
     if plan.placement == Placement.SHARDED:
         return storage_spec(plan, replica_axis)
     if plan.placement == Placement.DIVERGENT:
@@ -187,7 +220,7 @@ def update_space_spec(plan, replica_axis="replica"):
 
 def storage_shape(plan, num_replicas):
     """Global shape of the storage array."""
-    if plan.placement == Placement.REPLICATED:
+    if plan.placement in (Placement.REPLICATED, Placement.CUSTOM):
         return tuple(plan.shape)
     if plan.placement == Placement.SHARDED:
         s = list(plan.shape)
@@ -200,7 +233,8 @@ def storage_shape(plan, num_replicas):
 
 def update_space_shape(plan, num_replicas):
     """Global shape of the update-space array."""
-    if plan.placement in (Placement.SHARDED, Placement.DIVERGENT):
+    if plan.placement in (Placement.SHARDED, Placement.DIVERGENT,
+                          Placement.CUSTOM):
         return storage_shape(plan, num_replicas)
     if plan.sync == SyncKind.PS:
         import numpy as np

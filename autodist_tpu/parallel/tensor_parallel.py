@@ -1,0 +1,100 @@
+"""Tensor parallelism: row/column-parallel ops over a model mesh axis.
+
+Forward-looking dimension the reference's strategy schema anticipated
+(strategy.proto:40-42, SURVEY.md §2.8).  Weights are placed with
+``distribute(param_specs={"mlp/w1": P(None, "model"), ...},
+data_axes=("replica",))`` — the engine stores them sharded over the model
+axis (CUSTOM placement) and hands the loss function the LOCAL block; these
+helpers supply the matching collectives (Megatron-style):
+
+  column-parallel: W sharded on the OUTPUT dim -> local matmul, output
+                   stays sharded (no comm; follow with row-parallel)
+  row-parallel:    W sharded on the INPUT dim -> local matmul + psum
+
+The canonical TP MLP: y = RowParallel(act(ColumnParallel(x)))  — one psum
+per MLP, weights and activations split num_model_shards ways.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=None)
+def _make_reduce(axis_name):
+    """psum forward, IDENTITY backward (Megatron's reduce-from-model-
+    parallel).  A plain psum's VJP is another psum, which would scale every
+    shard gradient by the model-group size — the loss is computed once per
+    model replica, so cotangents arriving at the reduction are already the
+    full dL/dy and must pass through unchanged."""
+
+    @jax.custom_vjp
+    def reduce_(x):
+        return jax.lax.psum(x, axis_name)
+
+    def fwd(x):
+        return jax.lax.psum(x, axis_name), None
+
+    def bwd(_, g):
+        return (g,)
+
+    reduce_.defvjp(fwd, bwd)
+    return reduce_
+
+
+@functools.lru_cache(maxsize=None)
+def _make_copy(axis_name):
+    """identity forward, psum backward (Megatron's copy-to-model-parallel):
+    use on replicated activations ENTERING a TP block so their gradient
+    collects every shard's contribution."""
+
+    @jax.custom_vjp
+    def copy_(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (jax.lax.psum(g, axis_name),)
+
+    copy_.defvjp(fwd, bwd)
+    return copy_
+
+
+def reduce_from_tp(x, axis_name):
+    return _make_reduce(axis_name)(x)
+
+
+def copy_to_tp(x, axis_name):
+    return _make_copy(axis_name)(x)
+
+
+def column_parallel_dense(x, w_local, b_local=None):
+    """x: (..., D) replicated over the model axis; w_local: (D, H/M) block.
+    Returns the LOCAL (..., H/M) output slice; no communication.  If `x`
+    carries gradients from upstream replicated params, wrap it with
+    :func:`copy_to_tp` first."""
+    y = x @ w_local
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel_dense(x_local, w_local, axis_name, b=None):
+    """x_local: (..., H/M) the local slice (e.g. a column-parallel output);
+    w_local: (H/M, D) block.  Reduction over the model axis completes the
+    contraction (identity backward — see _make_reduce); b (replicated) is
+    added once, after the reduction."""
+    y = reduce_from_tp(x_local @ w_local, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(x, w1_local, w2_local, axis_name, act=jax.nn.gelu):
+    """Megatron MLP: copy in (so upstream replicated params receive every
+    shard's gradient contribution), column-parallel, row-parallel out."""
+    x = copy_to_tp(x, axis_name)
+    return row_parallel_dense(act(column_parallel_dense(x, w1_local)),
+                              w2_local, axis_name)
