@@ -115,13 +115,19 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
     for v in model_item.var_infos:
         if not v.trainable:
             continue
+        # exact-name entries take priority over glob/suffix patterns, so an
+        # exact key is never shadowed by an earlier glob in dict order
         override = None
-        for pat, spec in param_specs.items():
-            if (v.name == pat or fnmatch.fnmatchcase(v.name, pat)
-                    or v.name.endswith("/" + pat)):
-                override = spec
-                matched_patterns.add(pat)
-                break
+        if v.name in param_specs:
+            override = param_specs[v.name]
+            matched_patterns.add(v.name)
+        else:
+            for pat, spec in param_specs.items():
+                if (fnmatch.fnmatchcase(v.name, pat)
+                        or v.name.endswith("/" + pat)):
+                    override = spec
+                    matched_patterns.add(pat)
+                    break
         if override is not None:
             plans[v.name] = VarPlan(
                 name=v.name, shape=v.shape, dtype=v.dtype,
