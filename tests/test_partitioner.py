@@ -1,0 +1,96 @@
+"""Placement-planner unit tests (reference tests/test_kernels analog):
+plan derivation, storage/update-space shapes and specs."""
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu.kernel import partitioner as part
+from autodist_tpu.kernel.partitioner import Placement, SyncKind
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import PS, AllReduce, PartitionedPS, UnevenPartitionedPS
+
+SPEC = ResourceSpec.from_num_chips(8)
+R = 8
+
+
+def _item():
+    return ModelItem(lambda p, b: 0.0, {
+        "emb": jnp.zeros((100, 8)),   # partitionable
+        "w": jnp.zeros((12, 3)),
+        "s": jnp.zeros(()),           # scalar
+    })
+
+
+def _plans(builder, **kw):
+    item = _item()
+    return part.build_var_plans(builder.build(item, SPEC), item, R, **kw)
+
+
+def test_allreduce_plans():
+    plans = _plans(AllReduce())
+    assert all(p.placement is Placement.REPLICATED for p in plans.values())
+    assert all(p.sync is SyncKind.ALL_REDUCE for p in plans.values())
+    assert part.storage_spec(plans["w"], "replica") == P()
+    assert part.update_space_shape(plans["w"], R) == (12, 3)
+
+
+def test_ps_plans_flat_update_space():
+    plans = _plans(PS())
+    w = plans["w"]
+    assert w.placement is Placement.REPLICATED and w.sync is SyncKind.PS
+    # 36 elements -> padded to 40 = 8*5
+    assert part.update_space_shape(w, R) == (40,)
+    assert part.update_space_spec(w, "replica") == P("replica")
+    # storage stays full replicated
+    assert part.storage_shape(w, R) == (12, 3)
+
+
+def test_scalar_always_allreduced():
+    plans = _plans(PS(staleness=2))
+    s = plans["s"]
+    assert s.placement is Placement.REPLICATED
+    assert s.sync is SyncKind.ALL_REDUCE  # never PS/DIVERGENT
+    # non-scalars under staleness go divergent
+    assert plans["w"].placement is Placement.DIVERGENT
+    assert plans["w"].sync_period == 3
+    assert part.storage_shape(plans["w"], R) == (R, 12, 3)
+
+
+def test_partitioned_storage_padding():
+    plans = _plans(PartitionedPS(max_shards=8))
+    emb = plans["emb"]
+    assert emb.placement is Placement.SHARDED
+    assert emb.partition_axis == 0
+    assert emb.padded_dim == 104  # 100 -> next multiple of 8
+    assert part.storage_shape(emb, R) == (104, 8)
+    assert part.storage_spec(emb, "replica") == P("replica", None)
+
+
+def test_uneven_partition_metadata():
+    plans = _plans(UnevenPartitionedPS(max_shards=8))
+    emb = plans["emb"]
+    assert emb.logical_shards == 3  # smallest non-divisor of 100
+    assert emb.placement is Placement.SHARDED
+
+
+def test_custom_override_beats_strategy():
+    plans = _plans(PS(), param_specs={"w": P(None, "model")})
+    w = plans["w"]
+    assert w.placement is Placement.CUSTOM
+    assert part.storage_spec(w, "replica") == P(None, "model")
+    assert part.update_space_shape(w, R) == (12, 3)
+
+
+def test_unmatched_param_spec_errors():
+    with pytest.raises(ValueError, match="match no trainable"):
+        _plans(PS(), param_specs={"nope": P("model")})
+
+
+def test_multi_axis_partition_rejected():
+    item = _item()
+    s = PartitionedPS(max_shards=8).build(item, SPEC)
+    node = s.node_for("emb")
+    node.partition[:] = [2, 2]
+    with pytest.raises(ValueError, match="one partition axis"):
+        part.build_var_plans(s, item, R)
