@@ -23,6 +23,7 @@ bench:
 	$(PY) bench.py
 
 lint:
+	$(PY) tools/lint.py
 	$(PY) -m compileall -q autodist_tpu tests examples
 
 clean:

@@ -115,11 +115,19 @@ class AutoDist:
         accum_steps: int = 1,
         clip_global_norm=None,
         param_specs=None,
+        batch_mask: bool = False,
     ):
         """Capture single-device code and return a distributed session.
 
         ``remat=True`` wraps the loss in ``jax.checkpoint`` — trade FLOPs
         for HBM by rematerializing activations in the backward pass.
+
+        ``batch_mask=True`` enables uneven global batches: non-divisible
+        dict batches are padded and given a ``const.BATCH_MASK_KEY`` leaf,
+        and the engine weights each device's loss so the update equals the
+        reference's weighted average (``remapper.py:109-118``).  The loss
+        MUST exclude masked rows from its local mean (all
+        ``models.train_lib`` losses do when the mask is present).
         """
         from autodist_tpu.kernel.graph_transformer import GraphTransformer
         from autodist_tpu.runner import DistributedSession
@@ -137,7 +145,8 @@ class AutoDist:
                                        accum_steps=accum_steps,
                                        clip_global_norm=clip_global_norm,
                                        param_specs=param_specs)
-        return DistributedSession(transformer, rng=rng, donate=donate)
+        return DistributedSession(transformer, rng=rng, donate=donate,
+                                  batch_mask=batch_mask)
 
     # parity alias with the reference's create_distributed_session
     create_distributed_session = distribute

@@ -17,7 +17,6 @@ Storage backend: orbax (atomic, async-capable, multi-host aware).
 import os
 
 import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
 from autodist_tpu.utils import logging
@@ -42,25 +41,95 @@ class Saver:
             "rng": state["rng"],
         }
 
+    @staticmethod
+    def _comp_sidecar(path):
+        return path + ".comp"
+
+    def _stateful_comp(self, comp):
+        """Buckets with actual state (EF residuals, PowerSGD factors);
+        stateless buckets carry () and need no persistence."""
+        return {k: v for k, v in comp.items() if jax.tree.leaves(v)}
+
     def save(self, path):
-        """Write a canonical (single-device-shaped) checkpoint."""
+        """Write a canonical (single-device-shaped) checkpoint.
+
+        Stateful compressor state (error-feedback residuals, warm PowerSGD
+        factors — per-device, stacked on the replica axis) goes to a
+        ``<path>.comp`` sidecar so the MAIN checkpoint keeps the exact
+        single-device structure (``restore_single_device`` contract).
+        """
         path = os.path.abspath(path)
-        canonical = self._canonical_state()
-        canonical = jax.device_get(canonical)
+        canonical = jax.device_get(self._canonical_state())
         self._ckptr.save(path, canonical, force=True)
+        sidecar = self._comp_sidecar(path)
+        comp = {}
+        if jax.process_count() == 1:
+            # multi-host comp state spans non-addressable devices; the
+            # sidecar is a single-host convenience — skip it there (the main
+            # checkpoint is unaffected) rather than crash on device_get
+            comp = self._stateful_comp(jax.device_get(self._sess.state["comp"]))
+        if comp:
+            self._ckptr.save(sidecar, comp, force=True)
+        elif os.path.exists(sidecar):
+            # never leave a stale sidecar from an earlier run at this path:
+            # a later stateful restore would pair new params with old
+            # residuals
+            import shutil
+
+            shutil.rmtree(sidecar, ignore_errors=True)
         logging.info("Saved checkpoint to %s (step %d)", path, int(canonical["step"]))
         return path
 
     def restore(self, path):
-        """Load a canonical checkpoint into the session (any strategy)."""
+        """Load a canonical checkpoint into the session (any strategy).
+
+        Compressor state is restored from the sidecar when the restoring
+        session's bucket layout matches the saving one, so resumed training
+        equals uninterrupted training; on a cross-strategy resume (or an
+        old checkpoint without sidecar) it reinitializes with a warning.
+        """
         sess = self._sess
         t = sess._t
+        path = os.path.abspath(path)
         template = jax.device_get(self._canonical_state())
-        restored = self._ckptr.restore(os.path.abspath(path), item=template)
+        restored = self._ckptr.restore(path, item=template)
+
+        fresh = t.init_comp_states()
+        comp = fresh
+        sidecar = self._comp_sidecar(path)
+        fresh_stateful = self._stateful_comp(jax.device_get(fresh))
+        if os.path.exists(sidecar) and fresh_stateful:
+            try:
+                saved = self._ckptr.restore(sidecar, item=fresh_stateful)
+            except Exception:  # different bucket structure on disk
+                saved = None
+
+            def _layout(tree):
+                return jax.tree.map(
+                    lambda a: (tuple(a.shape), str(a.dtype)), tree)
+
+            if saved is not None and _layout(saved) == _layout(fresh_stateful):
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sh = NamedSharding(t.mesh, P(t.axis))
+                comp = dict(fresh)
+                for k, v in saved.items():
+                    comp[k] = jax.tree.map(
+                        lambda a: jax.device_put(a, sh), v)
+            else:
+                logging.warning(
+                    "Compressor sidecar %s does not match this strategy's "
+                    "bucket layout; error-feedback residuals reset to zero "
+                    "(cross-strategy resume)", sidecar)
+        elif fresh_stateful:
+            logging.warning(
+                "No compressor sidecar at %s; error-feedback residuals "
+                "reset to zero", sidecar)
+
         sess.state = {
             "params": t.uncanonicalize_params(restored["params"]),
             "opt_state": t.uncanonicalize_opt_state(restored["opt_state"]),
-            "comp": t.init_comp_states(),  # residuals restart at 0
+            "comp": comp,
             "mutable": jax.device_put(restored["mutable"]),
             "step": jax.device_put(restored["step"]),
             "rng": jax.device_put(restored["rng"]),

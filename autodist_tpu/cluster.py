@@ -115,7 +115,10 @@ class Cluster:
         if ssh is not None and ssh.python_venv:
             py = f"{ssh.python_venv}/bin/python"
         remote = f"{envs} {py} -u " + " ".join(shlex.quote(a) for a in argv)
-        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-tt"]
+        # trust-on-first-use: unlike =no this still detects key CHANGES, so
+        # the chief->worker channel (which executes code remotely) cannot be
+        # silently MITM'd after first contact
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=accept-new", "-tt"]
         if ssh is not None:
             if ssh.key_file:
                 cmd += ["-i", ssh.key_file]

@@ -32,6 +32,12 @@ AXIS_PIPELINE = "pipe"
 AXIS_SEQUENCE = "seq"
 AXIS_EXPERT = "expert"
 
+# Reserved batch key carrying the per-example validity mask that the session
+# injects when a global batch does not divide evenly across replicas
+# (reference ``remapper.py:109-118`` np.array_split uneven feed; here:
+# pad + mask + engine-side loss weighting — see runner._shard_batch).
+BATCH_MASK_KEY = "__batch_mask__"
+
 # Default bucket size (bytes) for gradient bucketing in the all-reduce
 # synchronizer -- the XLA-side analog of ScopedAllocator merging
 # (reference ``runner.py:41-45`` + ``all_reduce_strategy.py:61-66``).

@@ -84,7 +84,12 @@ class RecordDataset:
         if lib:
             self._ds = lib.adio_open(path.encode(), self.record_bytes)
             if not self._ds:
-                raise OSError(f"adio_open failed for {path}")
+                size = os.path.getsize(path) if os.path.exists(path) else -1
+                raise OSError(
+                    f"adio_open failed for {path}: file size {size} is empty, "
+                    f"unreadable, or not a multiple of record_bytes="
+                    f"{self.record_bytes} (shape {self.record_shape} "
+                    f"{self.dtype}) — truncated file or wrong shape/dtype")
             self._n = int(lib.adio_num_records(self._ds))
             self._mm = None
         else:

@@ -6,7 +6,7 @@ Here the target namespace is mesh coordinates: a device string resolves to
 ``"mesh:<flat_index>"`` — the linear index of that chip in the process-major
 global device order that :func:`autodist_tpu.parallel.mesh.build_mesh` uses.
 """
-from autodist_tpu.resource_spec import DeviceSpec, DeviceType
+from autodist_tpu.resource_spec import DeviceSpec
 
 
 class DeviceResolver:

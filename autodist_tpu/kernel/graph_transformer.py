@@ -19,8 +19,7 @@ The returned step is jitted once; XLA fuses and overlaps the collectives
 (the ScopedAllocator/grouping analog is the bucketing in
 :mod:`..synchronization.all_reduce` plus XLA collective combining).
 """
-import functools
-from typing import Any, Dict
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +32,6 @@ from autodist_tpu.kernel.partitioner import Placement, SyncKind
 from autodist_tpu.kernel.synchronization import all_reduce as ar_sync
 from autodist_tpu.model_item import path_name
 from autodist_tpu.ops.sparse import replica_axis_context
-from autodist_tpu.parallel.mesh import replica_axis
 from autodist_tpu.utils import logging
 
 
@@ -269,10 +267,16 @@ class GraphTransformer:
     def _materialize(self, leaf, plan):
         """storage (local view) -> what the forward pass sees.  CUSTOM
         (tensor-parallel) vars stay LOCAL blocks — the loss fn handles them
-        with parallel.tensor_parallel helpers."""
+        with parallel.tensor_parallel helpers.  Row-sharded SPARSE tables
+        stay local too: the loss sees a ShardedTable and embedding_lookup
+        row-exchanges, so no device ever holds the (vocab, dim) array."""
         if plan.placement in (Placement.REPLICATED, Placement.CUSTOM):
             return leaf
         if plan.placement == Placement.SHARDED:
+            if plan.sparse and plan.partition_axis == 0:
+                from autodist_tpu.ops.sparse import ShardedTable
+
+                return ShardedTable(leaf, self.axis, full_shape=plan.shape)
             full = jax.lax.all_gather(leaf, self.axis, axis=plan.partition_axis,
                                       tiled=True)
             dim = plan.shape[plan.partition_axis]
@@ -308,6 +312,19 @@ class GraphTransformer:
         item = self.model_item
         has_mutable = item.mutable_state is not None
 
+        # uneven global batch (runner._pad_uneven): scale each device's loss
+        # by s_local * R / S so that the plain pmean/psum-scatter downstream
+        # — and the sparse backward's internal sync — all deliver the
+        # reference's WEIGHTED average over real examples
+        # (``cases/c0.py:88-121`` semantics); pad rows carry mask 0 and the
+        # loss fn is responsible for excluding them from its local mean.
+        from autodist_tpu.const import BATCH_MASK_KEY
+
+        mask_present = isinstance(batch, dict) and BATCH_MASK_KEY in batch
+        if mask_present:
+            S_total = jax.lax.psum(
+                jnp.sum(batch[BATCH_MASK_KEY].astype(jnp.float32)), axis)
+
         def loss_wrapper(p, mut, *rest):
             # normalized aux shape: (loss, (mutable_or_None, aux_dict))
             if has_mutable:
@@ -317,11 +334,18 @@ class GraphTransformer:
                 else:
                     loss_, new_mut = out
                     aux_ = {}
-                return loss_, (new_mut, aux_)
-            if item.has_aux:
+            elif item.has_aux:
                 loss_, aux_ = item.loss_fn(p, *rest)
-                return loss_, (None, aux_)
-            return item.loss_fn(p, *rest), (None, {})
+                new_mut = None
+            else:
+                loss_ = item.loss_fn(p, *rest)
+                new_mut, aux_ = None, {}
+            if mask_present:
+                m = rest[0][BATCH_MASK_KEY].astype(jnp.float32)
+                w = (jnp.sum(m) * (self.num_replicas * self.accum_steps)
+                     / jnp.maximum(S_total, 1.0))
+                loss_ = loss_ * w
+            return loss_, (new_mut, aux_)
 
         vag = jax.value_and_grad(loss_wrapper, has_aux=True)
 
@@ -446,18 +470,29 @@ class GraphTransformer:
                 u_params.append(s_leaf)
                 u_grads.append(custom_synced[name])
             elif plan.placement == Placement.SHARDED:
-                gp = self._pad_axis(g, plan)
-                if plan.sparse:
-                    # pre-synced (replicated mean): take own block
+                if plan.sparse and plan.partition_axis == 0:
+                    # ShardedTable lookup: the backward already produced the
+                    # local block's mean gradient (update space) directly
+                    from autodist_tpu.ops.sparse import ShardedTable
+
+                    assert isinstance(g, ShardedTable)
+                    u_params.append(s_leaf)
+                    u_grads.append(g.block)
+                elif plan.sparse:
+                    # non-dim0 shard of a sparse var: pre-synced dense mean
+                    gp = self._pad_axis(g, plan)
                     block = plan.padded_dim // R
                     ug = jax.lax.dynamic_slice_in_dim(
                         gp, my * block, block, axis=plan.partition_axis)
+                    u_params.append(s_leaf)
+                    u_grads.append(ug)
                 else:
+                    gp = self._pad_axis(g, plan)
                     ug = jax.lax.psum_scatter(
                         gp, axis, scatter_dimension=plan.partition_axis,
                         tiled=True) / R
-                u_params.append(s_leaf)
-                u_grads.append(ug)
+                    u_params.append(s_leaf)
+                    u_grads.append(ug)
             elif plan.placement == Placement.DIVERGENT:
                 # local update either way: dense grads are local by nature,
                 # sparse grads arrive pre-synced (a harmless strengthening)

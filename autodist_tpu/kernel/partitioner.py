@@ -34,7 +34,6 @@ from typing import Optional
 
 from jax.sharding import PartitionSpec as P
 
-from autodist_tpu.proto import synchronizers_pb2
 from autodist_tpu.utils import logging
 
 

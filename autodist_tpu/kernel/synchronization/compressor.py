@@ -103,6 +103,7 @@ class Int8Compressor(Compressor):
     BLOCK = 256
 
     def all_reduce(self, buf, state, axis_name):
+        buf = buf.astype(jnp.float32)  # quantization math in f32
         n_dev = _axis_size(axis_name)
         n = buf.shape[0]
         # pad so chunks split evenly into blocks
@@ -215,6 +216,7 @@ class PowerSGDCompressor(Compressor):
         }
 
     def all_reduce(self, buf, state, axis_name):
+        buf = buf.astype(jnp.float32)  # low-rank factors in f32
         R = _axis_size(axis_name)
         n = buf.shape[0]
         rows, cols = self._dims(n)

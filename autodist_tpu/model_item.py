@@ -20,7 +20,6 @@ import dataclasses
 from typing import Any, Callable, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from autodist_tpu.proto import modelitem_pb2

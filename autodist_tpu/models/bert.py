@@ -161,9 +161,18 @@ class BertForPreTraining(nn.Module):
 
 
 def pretraining_loss(mlm_logits, nsp_logits, batch):
-    """Masked-LM cross entropy (over masked positions) + NSP loss."""
+    """Masked-LM cross entropy (over masked positions) + NSP loss.
+
+    Honors the session's uneven-batch example mask (``const.BATCH_MASK_KEY``)
+    by zeroing padded examples' positions out of both terms.
+    """
+    from autodist_tpu.const import BATCH_MASK_KEY
+
     labels = batch["labels"]           # (B, S), -100 = unmasked
     mask = (labels >= 0).astype(jnp.float32)
+    ex_mask = batch.get(BATCH_MASK_KEY)
+    if ex_mask is not None:
+        mask = mask * ex_mask[:, None].astype(mask.dtype)
     safe = jnp.maximum(labels, 0)
     logp = jax.nn.log_softmax(mlm_logits, axis=-1)
     ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
@@ -171,7 +180,12 @@ def pretraining_loss(mlm_logits, nsp_logits, batch):
     nsp_loss = 0.0
     if "next_sentence_label" in batch:
         nlogp = jax.nn.log_softmax(nsp_logits, axis=-1)
-        nsp_loss = -jnp.mean(
-            jnp.take_along_axis(nlogp, batch["next_sentence_label"][:, None],
-                                axis=-1))
+        nll = jnp.take_along_axis(nlogp,
+                                  batch["next_sentence_label"][:, None],
+                                  axis=-1)[..., 0]
+        if ex_mask is not None:
+            m = ex_mask.astype(nll.dtype)
+            nsp_loss = -jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+        else:
+            nsp_loss = -jnp.mean(nll)
     return mlm_loss + nsp_loss

@@ -25,15 +25,21 @@ class LMConfig:
     dtype: Any = jnp.float32
 
 
-class LSTMLM(nn.Module):
+class LSTMBody(nn.Module):
+    """Recurrence + softmax head over pre-looked-up embeddings.
+
+    Separated from the embedding so the table can live as a TOP-LEVEL
+    framework param: a sharded-sparse (PartitionedPS) table reaches the loss
+    as an ``ops.sparse.ShardedTable`` local block, which module frameworks'
+    own param shape checks would reject — so the engine-managed table must
+    not be a flax-managed param.
+    """
+
     config: LMConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, x):
         c = self.config
-        emb = self.param("embedding", nn.initializers.normal(0.05),
-                         (c.vocab_size, c.embed_dim), jnp.float32)
-        x = embedding_lookup(emb, tokens).astype(c.dtype)
         for i in range(c.num_layers):
             cell = nn.OptimizedLSTMCell(c.hidden_dim, dtype=c.dtype,
                                         name=f"lstm_{i}")
@@ -45,7 +51,29 @@ class LSTMLM(nn.Module):
         return logits
 
 
-def lm_loss(logits, targets):
+class LSTMLM(nn.Module):
+    """Single-device convenience wrapper (embedding flax-managed).  For
+    distributed training with a sharded table use ``train_lib.lm_capture``,
+    which keeps the table outside the module."""
+
+    config: LMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        c = self.config
+        emb = self.param("embedding", nn.initializers.normal(0.05),
+                         (c.vocab_size, c.embed_dim), jnp.float32)
+        x = embedding_lookup(emb, tokens).astype(c.dtype)
+        return LSTMBody(c, name="body")(x)
+
+
+def lm_loss(logits, targets, mask=None):
+    """Token cross entropy; ``mask`` (1.0 real / 0.0 pad example, from the
+    session's uneven-batch padding) excludes padded examples."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    if mask is None:
+        return -jnp.mean(ll)
+    per_ex = jnp.mean(ll, axis=tuple(range(1, ll.ndim)))
+    m = mask.astype(per_ex.dtype)
+    return -jnp.sum(per_ex * m) / jnp.maximum(jnp.sum(m), 1.0)

@@ -44,8 +44,12 @@ class NeuMF(nn.Module):
         return nn.Dense(1, dtype=jnp.float32, name="prediction")(x)[..., 0]
 
 
-def ncf_loss(logits, labels):
-    """Binary cross entropy on implicit-feedback labels."""
-    return jnp.mean(
-        jnp.maximum(logits, 0) - logits * labels
-        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+def ncf_loss(logits, labels, mask=None):
+    """Binary cross entropy on implicit-feedback labels; ``mask`` excludes
+    padded examples (uneven-batch sessions)."""
+    per_ex = (jnp.maximum(logits, 0) - logits * labels
+              + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    if mask is None:
+        return jnp.mean(per_ex)
+    m = mask.astype(per_ex.dtype)
+    return jnp.sum(per_ex * m) / jnp.maximum(jnp.sum(m), 1.0)

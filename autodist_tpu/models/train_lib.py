@@ -6,10 +6,18 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from autodist_tpu.const import BATCH_MASK_KEY
 
-def softmax_cross_entropy(logits, labels):
+
+def softmax_cross_entropy(logits, labels, mask=None):
+    """Mean cross-entropy; with ``mask`` (1.0 real / 0.0 pad, from the
+    session's uneven-batch padding) a masked mean over real examples."""
     logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+    per_ex = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return jnp.mean(per_ex)
+    mask = mask.astype(per_ex.dtype)
+    return jnp.sum(per_ex * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
 def classifier_capture(model, input_shape, rng=None, with_batch_stats=True):
@@ -28,13 +36,15 @@ def classifier_capture(model, input_shape, rng=None, with_batch_stats=True):
             logits, new_s = model.apply(
                 {"params": p, **s}, batch["image"], train=True,
                 mutable=list(s.keys()))
-            return softmax_cross_entropy(logits, batch["label"]), new_s
+            return softmax_cross_entropy(logits, batch["label"],
+                                         batch.get(BATCH_MASK_KEY)), new_s
 
         return loss_fn, params, state
 
     def loss_fn(p, batch):
         logits = model.apply({"params": p}, batch["image"], train=True)
-        return softmax_cross_entropy(logits, batch["label"])
+        return softmax_cross_entropy(logits, batch["label"],
+                                     batch.get(BATCH_MASK_KEY))
 
     return loss_fn, params, None
 
@@ -67,16 +77,26 @@ def bert_capture(config, seq_len, rng=None):
 
 
 def lm_capture(config, seq_len, rng=None):
-    from autodist_tpu.models.lm import LSTMLM, lm_loss
+    """The embedding table is a TOP-LEVEL param (not flax-managed) so a
+    PartitionedPS strategy can shard it end-to-end: the engine then hands
+    the loss a ``ShardedTable`` local block that ``embedding_lookup``
+    row-exchanges (flax's own param shape check would reject it)."""
+    from autodist_tpu.models.lm import LSTMBody, lm_loss
+    from autodist_tpu.ops.sparse import embedding_lookup
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    model = LSTMLM(config)
-    dummy = jnp.zeros((1, seq_len), jnp.int32)
-    params = model.init(rng, dummy)["params"]
+    c = config
+    body = LSTMBody(c)
+    k_emb, k_body = jax.random.split(rng)
+    emb = jax.random.normal(k_emb, (c.vocab_size, c.embed_dim),
+                            jnp.float32) * 0.05
+    dummy = jnp.zeros((1, seq_len, c.embed_dim), c.dtype)
+    params = {"embedding": emb, "body": body.init(k_body, dummy)["params"]}
 
     def loss_fn(p, batch):
-        logits = model.apply({"params": p}, batch["tokens"])
-        return lm_loss(logits, batch["targets"])
+        x = embedding_lookup(p["embedding"], batch["tokens"]).astype(c.dtype)
+        logits = body.apply({"params": p["body"]}, x)
+        return lm_loss(logits, batch["targets"], batch.get(BATCH_MASK_KEY))
 
     return loss_fn, params, ["embedding"]
 
@@ -91,7 +111,7 @@ def ncf_capture(config, rng=None):
 
     def loss_fn(p, batch):
         logits = model.apply({"params": p}, batch["user"], batch["item"])
-        return ncf_loss(logits, batch["label"])
+        return ncf_loss(logits, batch["label"], batch.get(BATCH_MASK_KEY))
 
     sparse = [n for n in ("mf_user_embedding", "mf_item_embedding",
                           "mlp_user_embedding", "mlp_item_embedding")]

@@ -17,7 +17,6 @@ per MLP, weights and activations split num_model_shards ways.
 import functools
 
 import jax
-import jax.numpy as jnp
 
 
 @functools.lru_cache(maxsize=None)

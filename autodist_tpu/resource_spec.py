@@ -117,6 +117,7 @@ class ResourceSpec:
         self._chief_address = None
         self._ssh_configs = {}
         self._bandwidths = {}
+        self._explicit_bandwidths = {}  # only yaml-specified entries
         self._topology = None
         self._mesh_request = None
 
@@ -185,6 +186,7 @@ class ResourceSpec:
             devices.append(d)
         if "network_bandwidth" in node:
             self._bandwidths[address] = float(node["network_bandwidth"])
+            self._explicit_bandwidths[address] = float(node["network_bandwidth"])
         else:
             if num_nodes > 1:
                 logging.warning(
@@ -264,6 +266,12 @@ class ResourceSpec:
 
     def network_bandwidth(self, address):
         return self._bandwidths[address]
+
+    @property
+    def explicit_bandwidths(self):
+        """Only bandwidths the yaml actually specified (no 1 Gbps default) —
+        cost models fall back to a hardware-class default otherwise."""
+        return dict(self._explicit_bandwidths)
 
     def ssh_config(self, address):
         group = self._nodes[address].get("ssh_config")

@@ -14,12 +14,13 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from autodist_tpu.const import BATCH_MASK_KEY
 from autodist_tpu.kernel.partitioner import Placement
 from autodist_tpu.utils import logging
 
 
 class DistributedSession:
-    def __init__(self, transformer, rng=None, donate=True):
+    def __init__(self, transformer, rng=None, donate=True, batch_mask=False):
         self._t = transformer
         self._mesh = transformer.mesh
         self._axis = transformer.axis
@@ -28,6 +29,11 @@ class DistributedSession:
         self._batch_spec = transformer.batch_spec
         self._multi_host = jax.process_count() > 1
         self._eval_cache = {}
+        # uneven-batch pad+mask is OPT-IN (distribute(batch_mask=True)):
+        # the loss must exclude masked rows from its local mean, otherwise
+        # pad rows silently bias the update — a loud error beats that
+        self._batch_mask = batch_mask
+        self._warned_uneven = False
 
     # -- feeds (reference remapper._remap_feed analog) ---------------------
 
@@ -41,8 +47,60 @@ class DistributedSession:
             size *= self._mesh.shape[a]
         return size
 
+    def _pad_uneven(self, batch):
+        """Uneven global batch -> (padded batch + validity mask, n_pad).
+
+        The reference's remapper np.array_splits a polymorphic batch so every
+        example is used exactly once and the synchronized update equals the
+        *weighted* average of per-replica gradients (``remapper.py:109-118``,
+        asserted by ``cases/c0.py:88-121``).  SPMD requires equal shard
+        shapes, so instead: pad dim 0 up to the next multiple of the replica
+        count by repeating the last example, and inject a ``BATCH_MASK_KEY``
+        leaf (1.0 real / 0.0 pad).  The engine scales each device's loss by
+        ``s_local * R / S`` so every sync path reproduces the reference's
+        weighted average.  REQUIRES a mask-aware loss (one that excludes
+        masked rows from its local mean — all ``models.train_lib`` losses
+        are); that is why the session must opt in via
+        ``distribute(batch_mask=True)``.  Only dict batches can carry the
+        mask leaf.
+        """
+        spec = tuple(self._batch_spec)
+        if not spec or not isinstance(batch, dict) or BATCH_MASK_KEY in batch:
+            return batch, 0
+        # pad to a multiple of replicas x accum_steps so the microbatch
+        # split inside the engine divides evenly too
+        n0 = self._spec_dim_size(spec[0]) * self._t.accum_steps
+        sizes = {np.shape(v)[0] for v in jax.tree.leaves(batch)
+                 if np.ndim(v) >= 1}
+        if len(sizes) != 1:
+            return batch, 0  # mixed leading dims: let divisibility checks fire
+        (B,) = sizes
+        pad = (-B) % n0
+        if pad == 0:
+            return batch, 0
+        if not self._warned_uneven:
+            self._warned_uneven = True
+            logging.warning(
+                "Global batch %d not divisible by replica count %d: padding "
+                "%d row(s) + '%s' mask (loss must ignore masked rows; "
+                "warning logged once).", B, n0, pad, BATCH_MASK_KEY)
+
+        def pad_leaf(x):
+            x = np.asarray(x)
+            if x.ndim == 0:
+                return x
+            return np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
+
+        padded = jax.tree.map(pad_leaf, batch)
+        mask = np.zeros((B + pad,), np.float32)
+        mask[:B] = 1.0
+        padded[BATCH_MASK_KEY] = mask
+        return padded, pad
+
     def _shard_batch(self, batch):
         spec = tuple(self._batch_spec)
+        if self._batch_mask and not self._multi_host:
+            batch, _ = self._pad_uneven(batch)
 
         def put(x):
             x = np.asarray(x) if not isinstance(x, jax.Array) else x
@@ -62,9 +120,10 @@ class DistributedSession:
                 if x.ndim == 0 or x.shape[0] % n0 != 0:
                     raise ValueError(
                         f"Batch leading dimension must be divisible by the "
-                        f"replica count ({n0}); got shape {x.shape}. Pad or "
-                        f"trim the batch (the reference's np.array_split "
-                        f"uneven feed has no SPMD equivalent).")
+                        f"replica count ({n0}); got shape {x.shape}. For "
+                        f"uneven dict batches pass distribute(..., "
+                        f"batch_mask=True) with a loss that ignores "
+                        f"'{BATCH_MASK_KEY}' rows (train_lib losses do).")
             for d, entry in enumerate(entries[1:], start=1):
                 n = self._spec_dim_size(entry)
                 if n > 1 and x.shape[d] % n != 0:
@@ -118,11 +177,13 @@ class DistributedSession:
         apply_fn = apply_fn or self._t.model_item.eval_fn
         if apply_fn is None:
             raise ValueError("No eval_fn: pass apply_fn or distribute(eval_fn=...)")
+        # the cache holds a strong reference to apply_fn so its id cannot be
+        # recycled by GC and collide with a dead function's entry
         key = id(apply_fn)
         has_mutable = self.state["mutable"] is not None
         if key not in self._eval_cache:
             if len(self._eval_cache) >= 8:
-                self._eval_cache.pop(next(iter(self._eval_cache)))
+                self._eval_cache.pop(next(iter(self._eval_cache)))  # FIFO
             t = self._t
 
             def eval_step(storage, mutable, b):
@@ -131,9 +192,19 @@ class DistributedSession:
                     return apply_fn(params, mutable, b)
                 return apply_fn(params, b)
 
-            self._eval_cache[key] = jax.jit(eval_step)
-        out = self._eval_cache[key](self.state["params"], self.state["mutable"],
-                                    self._shard_batch(batch))
+            self._eval_cache[key] = (apply_fn, jax.jit(eval_step))
+        # padding gates on the same opt-in as training: a batch-reduced
+        # apply_fn (e.g. a mean metric) would silently include pad rows
+        pad = 0
+        if self._batch_mask and not self._multi_host:
+            batch, pad = self._pad_uneven(batch)
+        out = self._eval_cache[key][1](self.state["params"], self.state["mutable"],
+                                       self._shard_batch(batch))
+        if pad:
+            padded_b = np.shape(batch[BATCH_MASK_KEY])[0]
+            out = jax.tree.map(
+                lambda x: x[:padded_b - pad]
+                if np.ndim(x) >= 1 and np.shape(x)[0] == padded_b else x, out)
         if self._multi_host:
             from jax.experimental import multihost_utils
 

@@ -63,10 +63,21 @@ def _gather_time(bytes_, n, bw_bytes_per_s):
 def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
              batch_per_chip=32, peak_flops=DEFAULT_PEAK_FLOPS,
              mxu_eff=DEFAULT_MXU_EFF, ici_gbps=DEFAULT_ICI_GBPS,
-             dcn_gbps=DEFAULT_DCN_GBPS, avg_sparse_rows=None):
-    """Estimate per-step cost of `strategy` for `model_item` on the spec."""
+             dcn_gbps=None, avg_sparse_rows=None):
+    """Estimate per-step cost of `strategy` for `model_item` on the spec.
+
+    Multi-node DCN bandwidth comes from the spec's per-node
+    ``network_bandwidth`` entries (the slowest node bounds the ring) unless
+    overridden via ``dcn_gbps``.
+    """
     R = max(1, resource_spec.num_accelerators)
     multi_node = not resource_spec.is_single_node
+    if dcn_gbps is None:
+        # only yaml-SPECIFIED bandwidths count (the parser defaults
+        # unspecified nodes to 1 Gbps for reference parity, which would
+        # silently price every default multi-node spec 100x too slow here)
+        explicit = getattr(resource_spec, "explicit_bandwidths", {})
+        dcn_gbps = min(explicit.values()) if explicit else DEFAULT_DCN_GBPS
     bw = (min(ici_gbps, dcn_gbps) if multi_node else ici_gbps) * 1e9 / 8
     plans = build_var_plans(strategy, model_item, R)
 
@@ -95,7 +106,11 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                 ps_bytes += nbytes
                 gather_bytes += nbytes
         else:
-            if plan.compressor == 5:  # PowerSGD: wire = r*(rows+cols) floats
+            from autodist_tpu.proto import synchronizers_pb2
+
+            _C = synchronizers_pb2.AllReduceSynchronizer
+            if plan.compressor == _C.PowerSGDCompressor:
+                # PowerSGD: wire = r*(rows+cols) floats
                 from autodist_tpu.kernel.synchronization.compressor import (
                     PowerSGDCompressor,
                 )
@@ -105,8 +120,15 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                 r = PowerSGDCompressor._rank(size)
                 comp_factor = min(1.0, r * (rows + cols) / size)
             else:
-                comp_factor = {0: 1.0, 1: 0.5, 2: 0.5, 3: 0.25, 4: 0.25}.get(
-                    plan.compressor, 1.0)
+                # keyed on the proto enum (not raw ints) so a reordering in
+                # synchronizers.proto cannot silently skew rankings
+                comp_factor = {
+                    _C.NoneCompressor: 1.0,
+                    _C.BF16Compressor: 0.5,
+                    _C.BF16CompressorEF: 0.5,
+                    _C.Int8Compressor: 0.25,
+                    _C.Int8CompressorEF: 0.25,
+                }.get(plan.compressor, 1.0)
             ar_bytes += nbytes * comp_factor
 
     comm_s = (_ring_time(ar_bytes, R, bw)

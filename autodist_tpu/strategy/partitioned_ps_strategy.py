@@ -5,7 +5,7 @@ shard count = smallest divisor > 1 of dim0 (``get_num_shards``, lines
 126-136); shards placed round-robin/greedy across PS devices; emits
 ``partitioner="k,1,..."`` + per-shard ``part_config``.
 """
-from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+from autodist_tpu.strategy.base import Strategy
 from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing, byte_size_load_fn
 
 

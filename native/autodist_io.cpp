@@ -46,6 +46,13 @@ AdioDataset* adio_open(const char* path, uint64_t record_bytes) {
   if (fd < 0) return nullptr;
   struct stat st;
   if (fstat(fd, &st) != 0 || record_bytes == 0) { ::close(fd); return nullptr; }
+  // a truncated file or a wrong record_bytes (mis-specified shape/dtype)
+  // must be an error, not silent clipping into garbled batches
+  if (st.st_size == 0 ||
+      static_cast<uint64_t>(st.st_size) % record_bytes != 0) {
+    ::close(fd);
+    return nullptr;
+  }
   void* p = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
   if (p == MAP_FAILED) { ::close(fd); return nullptr; }
   madvise(p, st.st_size, MADV_WILLNEED);

@@ -38,9 +38,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
 
-from autodist_tpu.const import DEFAULT_SERIALIZATION_DIR  # noqa: E402
 from autodist_tpu import strategy as S  # noqa: E402
-from autodist_tpu.autodist import AutoDist  # noqa: E402
 from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
 
 R = 2 * nproc  # global replica count

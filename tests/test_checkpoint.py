@@ -4,7 +4,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from autodist_tpu.autodist import AutoDist
 from autodist_tpu.checkpoint.saver import SavedModelBuilder, Saver
@@ -84,6 +83,37 @@ def test_cross_strategy_resume(tmp_path):
 
     sess.run(BATCH)
     np.testing.assert_allclose(sess2.params()["w"], sess.params()["w"], atol=1e-5)
+
+
+def test_ef_residuals_survive_resume(tmp_path):
+    """Resume with a stateful compressor (bf16 error feedback) equals
+    uninterrupted training: the residual sidecar round-trips (r1 advisor
+    finding: residuals were silently reset on restore)."""
+    def build():
+        ad = AutoDist(resource_spec=SPEC,
+                      strategy_builder=AllReduce(compressor="HorovodCompressorEF"))
+        p = {"w": jnp.zeros((32,))}
+        return ad.distribute(lambda p_, b: jnp.mean(b @ p_["w"]), p,
+                             optax.sgd(0.01))
+
+    b = np.full((8, 32), 1.0 + 2**-10, np.float32)  # bf16-unrepresentable
+    sess = build()
+    for _ in range(10):
+        sess.run(b)
+    path = Saver(sess).save(str(tmp_path / "ef"))
+    for _ in range(10):
+        sess.run(b)
+    uninterrupted = sess.params()["w"]
+
+    sess2 = build()
+    Saver(sess2).restore(path)
+    # residual state restored bit-for-bit, not reinitialized to zero
+    comp_leaves = jax.tree.leaves(jax.device_get(sess2.state["comp"]))
+    assert any(np.abs(l).max() > 0 for l in comp_leaves)
+    for _ in range(10):
+        sess2.run(b)
+    np.testing.assert_allclose(sess2.params()["w"], uninterrupted, atol=0,
+                               rtol=0)
 
 
 def test_saved_model_export(tmp_path):

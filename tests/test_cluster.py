@@ -1,5 +1,4 @@
 """Cluster layer tests: rank assignment, env contract, ssh command build."""
-import sys
 
 import pytest
 

@@ -2,7 +2,6 @@
 
 Mirrors the reference's integration cases: c1/c5 (Keras classifier), c2
 (sparse embeddings + Adam), c6 (LSTM), plus the benchmark families."""
-import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -12,7 +11,7 @@ from autodist_tpu.autodist import AutoDist
 from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy import AllReduce, Parallax, PartitionedPS, PSLoadBalancing
 from autodist_tpu.models import (
-    BERT_TINY, DenseNet121, InceptionV3, LMConfig, NCFConfig, NeuMF,
+    BERT_TINY, DenseNet121, InceptionV3, LMConfig, NCFConfig,
     ResNet18, ResNet50, VGG16,
 )
 from autodist_tpu.models import train_lib

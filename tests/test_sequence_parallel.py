@@ -6,7 +6,6 @@ gradients synchronize over ALL devices.  The SP run must match a plain 1-D
 data-parallel run on the identical model/batch.
 """
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest

@@ -1,7 +1,6 @@
 """Strategy builders + wrapper tests (mirrors reference test_strategy_base.py
 and exercises every builder's placement logic)."""
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from autodist_tpu.model_item import ModelItem

@@ -4,7 +4,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from autodist_tpu.autodist import AutoDist
 from autodist_tpu.parallel.tensor_parallel import tp_mlp

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Minimal dependency-free linter (the image ships no flake8/ruff).
+
+Real static checks over the AST — the subset of prospector (the reference's
+Jenkins lint stage, ``Jenkinsfile:46-56``) that matters most for this
+codebase:
+
+  F401  unused import
+  F811  duplicate/shadowed import name
+  E722  bare ``except:``
+  B006  mutable default argument
+  E711  comparison to None with ``==`` / ``!=``
+  W291  trailing whitespace
+  W191  tab indentation
+  F502  f-string without placeholders
+
+Exit code 1 when any finding is reported.
+"""
+import ast
+import sys
+from pathlib import Path
+
+IGNORED_DIRS = {"__pycache__", ".git", "build", ".pytest_cache"}
+GENERATED_SUFFIX = "_pb2.py"
+
+
+class Checker(ast.NodeVisitor):
+    def __init__(self, path, source):
+        self.path = path
+        self.findings = []
+        self.imports = {}      # module-level name -> lineno
+        self.used = set()
+        self.source = source
+        self._depth = 0        # function nesting: local imports aren't tracked
+
+    def add(self, lineno, code, msg):
+        self.findings.append((self.path, lineno, code, msg))
+
+    # -- imports -----------------------------------------------------------
+
+    def _record_import(self, name, lineno):
+        if self._depth:
+            return  # local (function-scoped) imports: scope rules differ
+        base = name.split(".")[0]
+        if base in self.imports:
+            self.add(lineno, "F811", f"redefinition of imported name {base!r}")
+        self.imports[base] = lineno
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self._record_import(a.asname or a.name, node.lineno)
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self._record_import(a.asname or a.name, node.lineno)
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    # -- other checks ------------------------------------------------------
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.add(node.lineno, "E722", "bare 'except:' (catches SystemExit)")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node):
+        for d in node.args.defaults + [d for d in node.args.kw_defaults if d]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.add(d.lineno, "B006", "mutable default argument")
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    def visit_AsyncFunctionDef(self, node):
+        self.visit_FunctionDef(node)
+
+    def visit_Compare(self, node):
+        for op, cmp in zip(node.ops, node.comparators):
+            if (isinstance(op, (ast.Eq, ast.NotEq))
+                    and isinstance(cmp, ast.Constant) and cmp.value is None):
+                self.add(node.lineno, "E711", "comparison to None (use 'is')")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.add(node.lineno, "F502", "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node):
+        # a format_spec like ':.4f' is itself a placeholder-free JoinedStr;
+        # do not descend into it (F502 false positive)
+        self.visit(node.value)
+
+    def finish(self):
+        # names used inside __all__ strings count as used
+        tree_all = set()
+        try:
+            tree = ast.parse(self.source)
+            for n in ast.walk(tree):
+                if (isinstance(n, ast.Assign)
+                        and any(getattr(t, "id", "") == "__all__" for t in n.targets)
+                        and isinstance(n.value, (ast.List, ast.Tuple))):
+                    for elt in n.value.elts:
+                        if isinstance(elt, ast.Constant):
+                            tree_all.add(str(elt.value))
+        except SyntaxError:
+            pass
+        if Path(self.path).name != "__init__.py":  # re-export stubs are fine
+            for name, lineno in self.imports.items():
+                if name not in self.used and name not in tree_all:
+                    self.add(lineno, "F401", f"unused import {name!r}")
+        for i, line in enumerate(self.source.splitlines(), 1):
+            if line != line.rstrip():
+                self.add(i, "W291", "trailing whitespace")
+            if line.startswith("\t"):
+                self.add(i, "W191", "tab indentation")
+
+
+def lint_file(path):
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+    c = Checker(path, source)
+    c.visit(tree)
+    c.finish()
+    lines = source.splitlines()
+    return [(p, ln, code, msg) for p, ln, code, msg in c.findings
+            if not (0 < ln <= len(lines) and "# noqa" in lines[ln - 1])]
+
+
+def main(roots):
+    findings = []
+    seen = set()
+    for root in roots:
+        for path in sorted(Path(root).rglob("*.py")):
+            if (any(part in IGNORED_DIRS for part in path.parts)
+                    or path.name.endswith(GENERATED_SUFFIX)
+                    or path.resolve() in seen):
+                continue
+            seen.add(path.resolve())
+            findings.extend(lint_file(path))
+    for path, lineno, code, msg in findings:
+        print(f"{path}:{lineno}: {code} {msg}")
+    print(f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] or ["autodist_tpu", "tests", "examples", "."]))
