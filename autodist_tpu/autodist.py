@@ -129,9 +129,6 @@ class AutoDist:
         MUST exclude masked rows from its local mean (all
         ``models.train_lib`` losses do when the mask is present).
         """
-        from autodist_tpu.kernel.graph_transformer import GraphTransformer
-        from autodist_tpu.runner import DistributedSession
-
         if remat:
             import jax
 
@@ -139,17 +136,73 @@ class AutoDist:
         item = ModelItem(loss_fn, params, optimizer, sparse_vars=sparse_vars,
                          has_aux=has_aux, has_rng=has_rng,
                          mutable_state=mutable_state, eval_fn=eval_fn, name=name)
-        strategy = self.build_strategy(item)
+        raw = self._build_or_load_strategy(item)
+        return self._assemble_session(
+            item, raw, rng=rng, donate=donate, batch_mask=batch_mask,
+            data_axes=data_axes, batch_spec=batch_spec,
+            accum_steps=accum_steps, clip_global_norm=clip_global_norm,
+            param_specs=param_specs)
+
+    def _assemble_session(self, item, raw, *, rng, donate, batch_mask,
+                          **transformer_kwargs):
+        """Shared tail of :meth:`distribute` and :meth:`launch`: verify
+        cross-host agreement, compile, transform, wrap in a session."""
+        from autodist_tpu.kernel.graph_transformer import GraphTransformer
+        from autodist_tpu.runner import DistributedSession
+        from autodist_tpu.utils.consistency import verify_agreement
+
+        verify_agreement(raw.proto.SerializeToString(), "strategy")
+        strategy = StrategyCompiler(item, self._resource_spec).compile(raw)
         transformer = GraphTransformer(strategy, item, self.mesh,
-                                       data_axes=data_axes, batch_spec=batch_spec,
-                                       accum_steps=accum_steps,
-                                       clip_global_norm=clip_global_norm,
-                                       param_specs=param_specs)
+                                       **transformer_kwargs)
         return DistributedSession(transformer, rng=rng, donate=donate,
                                   batch_mask=batch_mask)
 
     # parity alias with the reference's create_distributed_session
     create_distributed_session = distribute
+
+    def launch(self, loss_fn, params, optimizer, *, coordinator_port=None,
+               **kwargs):
+        """Full multi-host entry (reference ``create_distributed_session``
+        + ``Coordinator.launch_clients``, ``coordinator.py:46-90``): on the
+        chief, build + serialize the strategy, SSH-launch every worker
+        (re-executing this script with the ``AUTODIST_*`` env contract),
+        and join the ``jax.distributed`` group; on workers (re-executed by
+        the chief), join the group and load the strategy by id.  All hosts
+        then verify byte-identical strategies and build the same SPMD
+        session.
+
+        The strategy serialization dir (``const.DEFAULT_SERIALIZATION_DIR``)
+        must be visible to the workers (shared filesystem), matching the
+        reference's NFS assumption for its strategy handoff.
+
+        Single-node specs degrade to plain :meth:`distribute`.
+        """
+        from autodist_tpu.cluster import Coordinator
+
+        if kwargs.pop("remat", False):
+            import jax
+
+            loss_fn = jax.checkpoint(loss_fn)
+        capture_keys = ("sparse_vars", "has_aux", "has_rng", "mutable_state",
+                        "eval_fn", "name")
+        item = ModelItem(loss_fn, params, optimizer,
+                         **{k: kwargs.pop(k) for k in capture_keys
+                            if k in kwargs})
+        raw = self._build_or_load_strategy(item)
+
+        kw = {} if coordinator_port is None else {
+            "coordinator_port": coordinator_port}
+        coordinator = Coordinator(self._resource_spec, **kw)
+        self._coordinator = coordinator  # keep monitors/terminate reachable
+        coordinator.setup(raw)  # chief launches workers; everyone joins
+
+        return self._assemble_session(
+            item, raw,
+            rng=kwargs.pop("rng", None),
+            donate=kwargs.pop("donate", True),
+            batch_mask=kwargs.pop("batch_mask", False),
+            **kwargs)
 
     @contextlib.contextmanager
     def scope(self):

@@ -1,0 +1,212 @@
+"""True asynchronous bounded-staleness parameter serving.
+
+The reference's stale-sync PS (``ps_synchronizer.py:388-458``) gives every
+worker a size-``s`` token queue: a fast worker may run up to ``s`` steps
+AHEAD of the slowest worker, pushing gradients computed against stale
+parameters while the stragglers catch up (integration case c9: fast chief /
+slow worker, ``tests/integration/cases/c9.py:14-22``).
+
+An XLA SPMD program is bulk-synchronous — collectives rendezvous every
+device — so this semantics cannot live inside one jitted step.  The engine's
+DIVERGENT placement (``kernel/partitioner.py``) covers the *synchronous*
+reading of staleness (local steps + periodic averaging); THIS module is the
+genuinely asynchronous runtime, designed host-side the TPU way:
+
+- every worker is a Python thread driving its own device (or device subset)
+  with a per-device jitted gradient function — JAX dispatch is thread-safe
+  and devices execute concurrently;
+- the parameter server is host memory behind a lock; ``optax`` updates
+  apply as gradient pushes arrive (async SGD), tagged with the version the
+  gradient was computed against;
+- a token barrier enforces the reference's bound: a worker may be at most
+  ``staleness`` steps ahead of the slowest worker — NOT a lockstep barrier,
+  exactly the c9 contract.
+
+Use when stragglers dominate (heterogeneous hosts, preemptible pools).  For
+homogeneous TPU slices the SPMD engine's synchronous path is faster — this
+trades collective bandwidth for host round-trips (the same trade the
+reference's gRPC PS makes).
+"""
+import threading
+import time
+
+import jax
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+
+class TokenBarrier:
+    """Bounded-lead barrier: ``wait_turn(w)`` blocks while worker ``w`` is
+    more than ``staleness`` steps ahead of the slowest worker (the size-s
+    token queue of ``ps_synchronizer._get_queue_ops_stale``)."""
+
+    def __init__(self, num_workers, staleness):
+        self._steps = [0] * num_workers
+        self._cv = threading.Condition()
+        self._s = max(0, int(staleness))
+        self.max_lead_seen = 0
+
+    def wait_turn(self, worker, stop=None):
+        with self._cv:
+            while (self._steps[worker] - min(self._steps) > self._s
+                   and not (stop and stop.is_set())):
+                self._cv.wait(timeout=0.05)
+            # lead measured at step START (how far ahead this worker is
+            # about to run) — the quantity the size-s token queue bounds
+            self.max_lead_seen = max(
+                self.max_lead_seen,
+                self._steps[worker] - min(self._steps))
+
+    def advance(self, worker):
+        with self._cv:
+            self._steps[worker] += 1
+            self._cv.notify_all()
+
+    @property
+    def steps(self):
+        with self._cv:
+            return list(self._steps)
+
+
+class AsyncPSSession:
+    """Asynchronous bounded-staleness training session.
+
+    ``loss_fn(params, batch) -> loss`` is single-device code.  Each worker
+    computes gradients on its own device against its last-pulled parameter
+    snapshot and pushes them to the host parameter server, which applies
+    them immediately (async SGD).  ``staleness`` bounds how far any worker
+    may run ahead of the slowest.
+    """
+
+    def __init__(self, loss_fn, params, optimizer, *, staleness=0,
+                 devices=None, num_workers=None):
+        self._devices = list(devices if devices is not None
+                             else jax.local_devices())
+        if num_workers is not None:
+            self._devices = self._devices[:num_workers]
+        if not self._devices:
+            raise ValueError("No devices for async workers")
+        self._opt = optimizer
+        # the server lives on host CPU (the reference's PS placement); with
+        # a TPU backend present, committing inputs to the cpu device keeps
+        # server updates off the accelerators
+        try:
+            self._host_dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            self._host_dev = None
+        self._params = jax.device_get(params)           # host copy (server)
+        self._opt_state = jax.device_get(optimizer.init(
+            self._to_host(self._params)))
+        self._version = 0
+        self._lock = threading.Lock()
+        self._grad = jax.jit(jax.value_and_grad(loss_fn))
+        self._apply = jax.jit(lambda g, st, p: optimizer.update(g, st, p))
+        self.staleness = int(staleness)
+        self.barrier = TokenBarrier(len(self._devices), staleness)
+        self.history = []                               # (worker, version, loss)
+        self._stale_pushes = 0
+
+    def _to_host(self, tree):
+        if self._host_dev is None:
+            return tree
+        return jax.device_put(tree, self._host_dev)
+
+    # -- server ------------------------------------------------------------
+
+    def pull(self):
+        """Snapshot (params, version) for a worker."""
+        with self._lock:
+            return self._params, self._version
+
+    def push(self, grads, seen_version):
+        """Apply one gradient (async); returns the new server version."""
+        grads = jax.device_get(grads)
+        with self._lock:
+            updates, self._opt_state = jax.device_get(
+                self._apply(self._to_host(grads),
+                            self._to_host(self._opt_state),
+                            self._to_host(self._params)))
+            import optax
+
+            self._params = jax.device_get(
+                optax.apply_updates(self._params, updates))
+            self._version += 1
+            if seen_version < self._version - 1:
+                self._stale_pushes += 1
+            return self._version
+
+    @property
+    def params(self):
+        with self._lock:
+            return jax.tree.map(np.asarray, self._params)
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    @property
+    def stale_pushes(self):
+        """How many applied gradients were computed against parameters older
+        than the then-current server state (true asynchrony evidence)."""
+        with self._lock:
+            return self._stale_pushes
+
+    # -- workers -----------------------------------------------------------
+
+    def _worker_loop(self, w, batches, steps, delay, stop, errors):
+        dev = self._devices[w]
+        try:
+            for i in range(steps):
+                if stop.is_set():
+                    return
+                self.barrier.wait_turn(w, stop)
+                if delay:
+                    time.sleep(delay)                  # induced straggler
+                p, ver = self.pull()
+                p_dev = jax.device_put(p, dev)
+                b_dev = jax.device_put(batches[i % len(batches)], dev)
+                loss, g = self._grad(p_dev, b_dev)
+                new_ver = self.push(g, ver)
+                self.history.append((w, new_ver, float(loss)))
+                self.barrier.advance(w)
+        except Exception as e:  # surface to the caller, don't die silently
+            errors.append((w, e))
+            stop.set()
+
+    def run(self, batches_per_worker, steps, delays=None, timeout=300.0):
+        """Run every worker for ``steps`` steps; returns final host params.
+
+        ``batches_per_worker``: list (len == num workers) of batch lists.
+        ``delays``: optional per-worker seconds of induced slowness (the c9
+        fast-chief / slow-worker rig).
+        """
+        W = len(self._devices)
+        if len(batches_per_worker) != W:
+            raise ValueError(f"need {W} batch streams, got {len(batches_per_worker)}")
+        delays = delays or [0.0] * W
+        stop = threading.Event()
+        errors = []
+        threads = [
+            threading.Thread(
+                target=self._worker_loop,
+                args=(w, batches_per_worker[w], steps, delays[w], stop, errors),
+                daemon=True)
+            for w in range(W)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(max(0.0, timeout - (time.time() - t0)))
+        stop.set()
+        if errors:
+            raise errors[0][1]
+        alive = [t for t in threads if t.is_alive()]
+        if alive:
+            raise TimeoutError(f"{len(alive)} async workers still running "
+                               f"after {timeout}s")
+        logging.info("AsyncPS run done: version=%d, max_lead=%d, stale_pushes=%d",
+                     self.version, self.barrier.max_lead_seen, self.stale_pushes)
+        return self.params

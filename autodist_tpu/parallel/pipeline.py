@@ -1,0 +1,119 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pipe`` axis.
+
+Beyond the reference's strategy space (its FAQ disclaims model parallelism,
+``docs/usage/faq.md:30-34``) but anticipated by the strategy schema
+(``strategy.proto:40-42``) and reserved in this framework's mesh axes
+(``const.AXIS_PIPELINE``).  TPU-first design — no graph surgery, no
+per-stage processes:
+
+- Stage parameters are STACKED on a leading stage dim and placed with
+  ``distribute(param_specs={"blocks": P("pipe")}, data_axes=("replica",))``:
+  the engine's CUSTOM placement stores each device's stage block locally and
+  fuses the data-axis gradient pmean, so pipeline composes with data
+  parallelism (and TP/SP on further axes) with no engine changes.
+- :func:`pipeline_apply` runs inside the engine's ``shard_map``: a
+  ``lax.scan`` over ``M + S - 1`` ticks; every tick each stage applies its
+  block to its current microbatch and ``ppermute`` hands the activation to
+  the next stage (the GPipe bubble is the usual ``(S-1)/(M+S-1)``).
+- The last stage's outputs are broadcast back over the pipe axis (masked
+  psum), so replicated params (embedding, head) see identical activations
+  on every pipe member and their gradients stay replica-consistent; the
+  backward pass through ``ppermute`` is its reverse permutation, giving the
+  GPipe full-forward/full-backward schedule from plain autodiff.
+
+Constraints (standard for stacked-stage pipelining): homogeneous stages
+(same params structure and same activation shape in/out), local batch
+divisible by ``num_microbatches``.
+"""
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.parallel.collectives import axis_index, axis_size
+
+
+def stack_stages(params_per_stage):
+    """[stage0_params, stage1_params, ...] -> stacked pytree (S, ...) ready
+    for ``param_specs={...: P("pipe")}`` placement."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_per_stage)
+
+
+def pipeline_apply(body_fn, stacked_local, x, axis_name, num_microbatches,
+                   remat=True):
+    """Run ``x`` through the pipeline of stages; returns final activations
+    (valid and identical on every pipe member).
+
+    Args:
+      body_fn: ``body_fn(stage_params, act) -> act`` for ONE stage; the
+        activation shape must be preserved (homogeneous stages).
+      stacked_local: this device's local block of the stacked stage params —
+        leading dim 1 (what the engine hands the loss under ``P("pipe")``
+        CUSTOM placement).  For a single-device reference run use
+        :func:`pipeline_reference` instead (no mesh axis needed).
+      x: local batch activations ``(B, ...)``.
+      axis_name: the pipeline mesh axis (``const.AXIS_PIPELINE``).
+      num_microbatches: M; ``B % M == 0``.  Larger M shrinks the bubble.
+      remat: rematerialize each stage application in the backward pass
+        (GPipe's memory profile: activations per microbatch boundary only).
+    """
+    S = axis_size(axis_name)
+    idx = axis_index(axis_name)
+    lead = {l.shape[0] for l in jax.tree.leaves(stacked_local)}
+    if S > 1 and lead != {1}:
+        # unsharded stacked params would silently run every stage with
+        # stage 0's weights — the one param_specs misconfiguration the
+        # engine cannot catch for us
+        raise ValueError(
+            f"pipeline_apply expected shard-local stage params (leading dim "
+            f"1), got leading dims {sorted(lead)}: place the stacked tree "
+            f"with distribute(param_specs={{'<blocks>/...': P('{axis_name}')"
+            f"}}) so each device holds exactly its stage")
+    stage_params = jax.tree.map(lambda a: a[0], stacked_local)
+    M = int(num_microbatches)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(
+            f"Local batch {B} must be divisible by num_microbatches={M}")
+    mb = B // M
+    micro = x.reshape((M, mb) + x.shape[1:])
+    body = jax.checkpoint(body_fn) if remat else body_fn
+
+    def tick(act, t):
+        # stage 0 consumes microbatch t (clamped into range during the
+        # drain ticks; those outputs never reach the last stage in time and
+        # are discarded), later stages consume the activation handed to
+        # them by the previous tick's ppermute
+        feed = jax.lax.dynamic_index_in_dim(
+            micro, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        cur = jnp.where(jnp.equal(idx, 0), feed, act)
+        y = body(stage_params, cur)
+        nxt = jax.lax.ppermute(y, axis_name,
+                               [(i, i + 1) for i in range(S - 1)])
+        return nxt, y
+
+    act0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+    _, ys = jax.lax.scan(tick, act0, jnp.arange(M + S - 1))
+    # the last stage's valid outputs are ticks S-1 .. S-1+M-1
+    outs = jax.lax.dynamic_slice_in_dim(ys, S - 1, M, axis=0)
+    out = outs.reshape((B,) + outs.shape[2:])
+    # Broadcast the true (last-stage) result to every pipe member so
+    # downstream replicated computation stays consistent across the axis.
+    # Megatron-style asymmetric collective (psum forward, IDENTITY
+    # backward): every pipe member re-computes the same downstream loss, so
+    # each cotangent is already the full dL/dout — a plain psum's VJP
+    # (another psum) would scale every stage gradient by the pipe size.
+    from autodist_tpu.parallel.tensor_parallel import reduce_from_tp
+
+    is_last = jnp.equal(idx, S - 1)
+    out = reduce_from_tp(jnp.where(is_last, out, jnp.zeros_like(out)),
+                         axis_name)
+    return out
+
+
+def pipeline_reference(body_fn, stacked, x):
+    """Single-device reference: apply all S stages sequentially (for
+    exactness tests and non-distributed use)."""
+    S = jax.tree.leaves(stacked)[0].shape[0]
+    for s in range(S):
+        stage = jax.tree.map(lambda a: a[s], stacked)
+        x = body_fn(stage, x)
+    return x

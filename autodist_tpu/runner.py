@@ -32,6 +32,11 @@ class DistributedSession:
         # uneven-batch pad+mask is OPT-IN (distribute(batch_mask=True)):
         # the loss must exclude masked rows from its local mean, otherwise
         # pad rows silently bias the update — a loud error beats that
+        if batch_mask and self._multi_host:
+            raise ValueError(
+                "batch_mask=True is single-host for now: on multi-host runs "
+                "each host must feed evenly-sized local slices (pre-pad per "
+                "host and include the mask leaf yourself)")
         self._batch_mask = batch_mask
         self._warned_uneven = False
 

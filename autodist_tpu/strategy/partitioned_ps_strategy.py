@@ -25,8 +25,12 @@ class PartitionedPS(PSLoadBalancing):
         super().__init__(local_proxy_variable, sync, staleness)
         self._max_shards = max_shards
 
-    def _num_shards(self, v, num_anchors):
-        cap = self._max_shards or num_anchors
+    def _num_shards(self, v, num_anchors, num_accelerators):
+        # reference caps shards at the PS-anchor count (CPUs of nodes); the
+        # TPU realization shards storage over the chips themselves, so a
+        # single-host many-chip spec still benefits from partitioning —
+        # cap at max(anchors, chips) unless the user pinned max_shards
+        cap = self._max_shards or max(num_anchors, num_accelerators)
         dim0 = v.shape[0] if v.shape else None
         return get_num_shards(dim0, cap)
 
@@ -41,7 +45,8 @@ class PartitionedPS(PSLoadBalancing):
             n = s.node_config.add()
             n.var_name = v.name
             n.sparse = v.sparse
-            k = self._num_shards(v, len(anchors))
+            k = self._num_shards(v, len(anchors),
+                                 resource_spec.num_accelerators)
             if k <= 1:
                 dest = min(self.loads, key=self.loads.get)
                 self.loads[dest] += byte_size_load_fn(v)

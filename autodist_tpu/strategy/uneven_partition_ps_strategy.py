@@ -18,7 +18,7 @@ def get_uneven_num_shards(dim0, max_shards):
 
 
 class UnevenPartitionedPS(PartitionedPS):
-    def _num_shards(self, v, num_anchors):
-        cap = self._max_shards or num_anchors
+    def _num_shards(self, v, num_anchors, num_accelerators):
+        cap = self._max_shards or max(num_anchors, num_accelerators)
         dim0 = v.shape[0] if v.shape else None
         return get_uneven_num_shards(dim0, cap)

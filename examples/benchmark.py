@@ -9,7 +9,6 @@ a strategy by flag, train on synthetic data, report examples/sec.
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -85,12 +84,104 @@ def build(model_name, seq_len, image_size):
     raise SystemExit(f"unknown model {model_name}")
 
 
+# rough forward FLOPs per example for the cost model's compute term
+# (ranking needs relative comm cost; compute is strategy-invariant)
+FLOPS_PER_EXAMPLE = {
+    "resnet50": 4.1e9, "resnet101": 7.8e9, "vgg16": 15.5e9,
+    "densenet121": 2.9e9, "inception_v3": 5.7e9,
+    "bert_base": 2.8e10, "bert_large": 9.8e10,  # ~2 * params * seq_len(128)
+}
+
+
+def run_one(args, strategy_name, cap, n_chips):
+    """Build a session under one strategy; measure; return (eps, record)."""
+    from autodist_tpu import strategy as S
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.simulator.cost_model import measure_and_record
+
+    B = args.batch_per_chip * n_chips
+    builder = getattr(S, strategy_name)()
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
+                  strategy_builder=builder)
+    sess = ad.distribute(cap["loss_fn"], cap["params"], cap["optimizer"],
+                         sparse_vars=cap["sparse_vars"], has_rng=cap["has_rng"],
+                         mutable_state=cap["mutable_state"])
+    batch = cap["batch_fn"](B)
+    gbatch = sess._shard_batch(batch)  # device-resident: measure the step
+    record = measure_and_record(sess, gbatch, steps=args.steps,
+                                warmup=args.warmup)
+    eps = B / record.step_time_s
+    print(f"model={args.model} strategy={strategy_name} chips={n_chips} "
+          f"global_batch={B} examples/sec={eps:.1f} per_chip={eps / n_chips:.1f} "
+          f"step_ms={1000 * record.step_time_s:.2f}")
+    return eps, record, sess
+
+
+def sweep(args):
+    """Per-strategy sweep + cost-model validation (the AutoDist thesis:
+    different models peak under different strategies — reference
+    ``docs/usage/performance.md`` figure1; r1 verdict item 2).  Dumps an
+    AutoSync-style RuntimeRecord per strategy and compares the analytic
+    cost model's ranking against measured step times."""
+    import json
+
+    from autodist_tpu.simulator.cost_model import estimate
+
+    os.environ["AUTODIST_IS_TESTING"] = "True"  # several AutoDist instances
+    n_chips = jax.device_count()
+    strategies = [s.strip() for s in args.strategies.split(",") if s.strip()]
+    measured, estimated = {}, {}
+    records_dir = args.records_dir
+    if records_dir:
+        os.makedirs(records_dir, exist_ok=True)
+    for name in strategies:
+        cap = build(args.model, args.seq_len, args.image_size)
+        eps, record, sess = run_one(args, name, cap, n_chips)
+        measured[name] = record.step_time_s
+        est = estimate(sess._t.strategy, sess._t.model_item, _spec(n_chips),
+                       flops_per_example=FLOPS_PER_EXAMPLE.get(args.model, 0.0),
+                       batch_per_chip=args.batch_per_chip)
+        estimated[name] = est.total_s
+        if records_dir:
+            record.dump(os.path.join(
+                records_dir, f"{args.model}_{name}.json"))
+        del sess
+
+    measured_rank = sorted(measured, key=measured.get)
+    estimated_rank = sorted(estimated, key=estimated.get)
+    summary = {
+        "model": args.model, "chips": n_chips,
+        "batch_per_chip": args.batch_per_chip,
+        "measured_step_s": measured, "estimated_step_s": estimated,
+        "measured_rank": measured_rank, "estimated_rank": estimated_rank,
+        "top_choice_agrees": measured_rank[0] == estimated_rank[0],
+    }
+    print(json.dumps(summary))
+    if records_dir:
+        with open(os.path.join(records_dir,
+                               f"{args.model}_summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+    return summary
+
+
+def _spec(n_chips):
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    return ResourceSpec.from_num_chips(n_chips)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--autodist_strategy", default="AllReduce",
                     help="PS | PSLoadBalancing | PartitionedPS | UnevenPartitionedPS | "
                          "AllReduce | PartitionedAR | RandomAxisPartitionAR | Parallax")
+    ap.add_argument("--strategies", default="",
+                    help="comma list -> per-strategy sweep + cost-model "
+                         "validation (e.g. 'AllReduce,PS,PartitionedPS,Parallax')")
+    ap.add_argument("--records_dir", default="",
+                    help="dump AutoSync-style RuntimeRecords + summary here")
     ap.add_argument("--batch_per_chip", type=int, default=64)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
@@ -98,32 +189,18 @@ def main():
     ap.add_argument("--image_size", type=int, default=224)
     args = ap.parse_args()
 
-    from autodist_tpu import strategy as S
-    from autodist_tpu.autodist import AutoDist
-    from autodist_tpu.resource_spec import ResourceSpec
+    if args.strategies:
+        sweep(args)
+        return
 
     n_chips = jax.device_count()
-    B = args.batch_per_chip * n_chips
     cap = build(args.model, args.seq_len, args.image_size)
-    builder = getattr(S, args.autodist_strategy)()
-    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
-                  strategy_builder=builder)
-    sess = ad.distribute(cap["loss_fn"], cap["params"], cap["optimizer"],
-                         sparse_vars=cap["sparse_vars"], has_rng=cap["has_rng"],
-                         mutable_state=cap["mutable_state"])
-    batch = cap["batch_fn"](B)
-    for _ in range(args.warmup):
-        m = sess.run(batch)
-    jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        m = sess.run(batch)
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-    eps = args.steps * B / dt
-    print(f"model={args.model} strategy={args.autodist_strategy} chips={n_chips} "
-          f"global_batch={B} examples/sec={eps:.1f} per_chip={eps / n_chips:.1f} "
-          f"loss={float(m['loss']):.4f}")
+    _, record, sess = run_one(args, args.autodist_strategy, cap, n_chips)
+    if args.records_dir:
+        os.makedirs(args.records_dir, exist_ok=True)
+        record.dump(os.path.join(
+            args.records_dir,
+            f"{args.model}_{args.autodist_strategy}.json"))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,83 @@
+"""Chief/worker script for the real launch-path integration test.
+
+Run as the CHIEF (no AUTODIST_WORKER env) by the test; the chief's
+``AutoDist.launch`` SSH-launches this same script on the "remote" node (an
+ssh shim on PATH executes the command locally — the image ships no sshd),
+exactly the reference coordinator's re-execute-the-user-script contract
+(``coordinator.py:46-90``).
+
+argv: out_dir coordinator_port [fail_worker]
+"""
+import json
+import os
+import sys
+
+out_dir = sys.argv[1]
+port = int(sys.argv[2])
+fail_worker = len(sys.argv) > 3 and sys.argv[3] == "fail_worker"
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["AUTODIST_IS_TESTING"] = "True"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu.autodist import AutoDist  # noqa: E402
+from autodist_tpu.const import IS_AUTODIST_CHIEF  # noqa: E402
+from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
+from autodist_tpu.strategy import PSLoadBalancing  # noqa: E402
+
+role = "chief" if IS_AUTODIST_CHIEF else "worker"
+
+if fail_worker and role == "worker":
+    # die BEFORE joining the group: the chief's monitor must fail-fast
+    print("worker: induced failure", flush=True)
+    sys.exit(1)
+
+# chief = this host's name (resolvable; the loopback literal is rejected in
+# multi-node specs, reference rule); the worker "address" is only an ssh
+# target, which the test's shim executes locally
+import socket  # noqa: E402
+
+SPEC = ResourceSpec(resource_info={
+    "nodes": [
+        {"address": socket.gethostname(), "chips": [0, 1], "chief": True},
+        {"address": "worker-node", "chips": [0, 1]},
+    ],
+})
+
+
+def loss_fn(p, batch):
+    return jnp.mean((batch @ p["w"]) ** 2)
+
+
+# numpy only: jax.distributed.initialize (inside launch) must run before
+# anything touches the XLA backend
+params = {"w": np.linspace(1, 2, 6, dtype=np.float32)}
+
+ad = AutoDist(resource_spec=SPEC, strategy_builder=PSLoadBalancing())
+sess = ad.launch(loss_fn, params, optax.sgd(0.1), coordinator_port=port)
+
+assert jax.process_count() == 2, jax.process_count()
+full = np.random.RandomState(0).randn(16, 6).astype(np.float32)
+pid = jax.process_index()
+local = full[pid * 8:(pid + 1) * 8]
+for _ in range(3):
+    metrics = sess.run(local)
+
+result = {"role": role, "pid": pid, "loss": float(metrics["loss"]),
+          "w": np.asarray(sess.params()["w"]).tolist()}
+with open(os.path.join(out_dir, f"launch_result_{pid}.json"), "w") as f:
+    json.dump(result, f)
+print("LAUNCH_OK", role, pid, flush=True)
+
+if role == "chief":
+    ad._coordinator.cluster.terminate()

@@ -1,0 +1,77 @@
+"""Real multi-host launch path (r1 verdict item 8): drives
+``AutoDist.launch`` -> ``Coordinator.setup`` -> ssh -> worker re-execution
+-> ``jax.distributed`` group -> consistency check -> training -> fail-fast
+monitors, end-to-end.
+
+The image ships no sshd, so an ``ssh`` SHIM on PATH executes the remote
+command locally — every other line is the production code path
+(``cluster.py`` command construction, env contract, monitors), the analog
+of the reference's two-container SSH rig (Jenkinsfile:94-120).
+"""
+import json
+import os
+import stat
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.integration
+
+SCRIPT = os.path.join(os.path.dirname(__file__), "launch_script.py")
+
+
+def _make_ssh_shim(tmp_path):
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "ssh"
+    shim.write_text(
+        "#!/bin/sh\n"
+        "# fake ssh for the launch test: run the remote command locally\n"
+        'for a in "$@"; do last="$a"; done\n'
+        'exec sh -c "$last"\n')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    return str(shim_dir)
+
+
+def _chief_env(tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("AUTODIST_WORKER", "AUTODIST_STRATEGY_ID",
+                        "AUTODIST_PROCESS_ID", "AUTODIST_COORDINATOR",
+                        "XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PATH"] = _make_ssh_shim(tmp_path) + os.pathsep + env.get("PATH", "")
+    return env
+
+
+def test_launch_two_hosts_via_ssh(tmp_path):
+    port = 15810 + os.getpid() % 150
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(tmp_path), str(port)],
+        env=_chief_env(tmp_path), capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    results = {}
+    for pid in range(2):
+        with open(tmp_path / f"launch_result_{pid}.json") as f:
+            results[pid] = json.load(f)
+    assert results[0]["role"] == "chief"
+    assert results[1]["role"] == "worker"
+    # both trained the same model to the same weights
+    np.testing.assert_allclose(results[0]["w"], results[1]["w"], atol=1e-6)
+    assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6
+
+
+def test_launch_fail_fast_on_dead_worker(tmp_path):
+    """A worker that dies must kill the chief promptly (reference
+    coordinator.py:98-110 os._exit(1) monitors) instead of hanging in the
+    process-group rendezvous."""
+    port = 15810 + (os.getpid() + 7) % 150
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(tmp_path), str(port), "fail_worker"],
+        env=_chief_env(tmp_path), capture_output=True, text=True, timeout=240)
+    elapsed = time.time() - t0
+    assert proc.returncode != 0
+    # fail-fast: far quicker than the distributed-init rendezvous timeout
+    assert elapsed < 120, elapsed

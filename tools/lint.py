@@ -32,6 +32,7 @@ class Checker(ast.NodeVisitor):
         self.used = set()
         self.source = source
         self._depth = 0        # function nesting: local imports aren't tracked
+        self._all_names = set()  # strings listed in __all__
 
     def add(self, lineno, code, msg):
         self.findings.append((self.path, lineno, code, msg))
@@ -83,6 +84,14 @@ class Checker(ast.NodeVisitor):
     def visit_AsyncFunctionDef(self, node):
         self.visit_FunctionDef(node)
 
+    def visit_Assign(self, node):
+        if (any(getattr(t, "id", "") == "__all__" for t in node.targets)
+                and isinstance(node.value, (ast.List, ast.Tuple))):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant):
+                    self._all_names.add(str(elt.value))
+        self.generic_visit(node)
+
     def visit_Compare(self, node):
         for op, cmp in zip(node.ops, node.comparators):
             if (isinstance(op, (ast.Eq, ast.NotEq))
@@ -101,22 +110,10 @@ class Checker(ast.NodeVisitor):
         self.visit(node.value)
 
     def finish(self):
-        # names used inside __all__ strings count as used
-        tree_all = set()
-        try:
-            tree = ast.parse(self.source)
-            for n in ast.walk(tree):
-                if (isinstance(n, ast.Assign)
-                        and any(getattr(t, "id", "") == "__all__" for t in n.targets)
-                        and isinstance(n.value, (ast.List, ast.Tuple))):
-                    for elt in n.value.elts:
-                        if isinstance(elt, ast.Constant):
-                            tree_all.add(str(elt.value))
-        except SyntaxError:
-            pass
         if Path(self.path).name != "__init__.py":  # re-export stubs are fine
             for name, lineno in self.imports.items():
-                if name not in self.used and name not in tree_all:
+                # names listed in __all__ count as used (re-exports)
+                if name not in self.used and name not in self._all_names:
                     self.add(lineno, "F401", f"unused import {name!r}")
         for i, line in enumerate(self.source.splitlines(), 1):
             if line != line.rstrip():
