@@ -55,6 +55,10 @@ def _load_native():
         lib.adio_loader_new.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                         ctypes.c_uint64, ctypes.c_int,
                                         ctypes.c_uint64, ctypes.c_uint64]
+        lib.adio_loader_new_sharded.restype = ctypes.c_void_p
+        lib.adio_loader_new_sharded.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
         lib.adio_loader_next.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.adio_loader_next.argtypes = [ctypes.c_void_p]
         lib.adio_loader_release.argtypes = [ctypes.c_void_p,
@@ -132,10 +136,18 @@ class RecordDataset:
 
 
 class BatchLoader:
-    """Iterator of shuffled batches assembled by C++ worker threads."""
+    """Iterator of shuffled batches assembled by C++ worker threads.
+
+    ``shard_index/shard_count`` restrict this loader to records with
+    ``index % shard_count == shard_index`` — the multi-host feed split
+    (each host constructs its own loader with its ``jax.process_index()``),
+    the input-pipeline half of the reference remapper's per-replica feeds.
+    """
 
     def __init__(self, dataset, batch_size, *, shuffle=True, seed=0,
-                 threads=2, prefetch=2):
+                 threads=2, prefetch=2, shard_index=0, shard_count=1):
+        if shard_count < 1 or not (0 <= shard_index < shard_count):
+            raise ValueError(f"bad shard {shard_index}/{shard_count}")
         self._ds = dataset
         self._batch = batch_size
         lib = _load_native()
@@ -145,15 +157,18 @@ class BatchLoader:
             # a single worker for deterministic batch order
             threads = 1
         if self._native:
-            self._ld = lib.adio_loader_new(dataset._ds, batch_size, threads,
-                                           1 if shuffle else 0, seed, prefetch)
+            self._ld = lib.adio_loader_new_sharded(
+                dataset._ds, batch_size, threads, 1 if shuffle else 0, seed,
+                prefetch, shard_index, shard_count)
             if not self._ld:
-                raise OSError("adio_loader_new failed")
+                raise OSError("adio_loader_new failed (empty shard?)")
             dataset._active_loaders += 1
         else:
             self._rng = np.random.RandomState(seed)
             self._shuffle = shuffle
-            self._perm = np.arange(len(dataset))
+            self._perm = np.arange(shard_index, len(dataset), shard_count)
+            if len(self._perm) == 0:
+                raise OSError("adio_loader_new failed (empty shard?)")
             if shuffle:
                 self._rng.shuffle(self._perm)
             self._cursor = 0

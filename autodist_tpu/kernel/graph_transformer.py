@@ -677,14 +677,17 @@ class GraphTransformer:
     def canonicalize_opt_state(self, opt_state):
         """Sharded optimizer state -> single-device-shaped state (the
         reference Saver's 'original variable names/shapes' contract,
-        ``checkpoint/saver.py:50-58``)."""
+        ``checkpoint/saver.py:50-58``).  Output is REPLICATED so every
+        process can fetch it (multi-host ``device_get`` cannot touch
+        non-addressable shards)."""
         boxed = self._plans_boxed_tree()
         fn = jax.jit(lambda s: optax.tree_map_params(
             self.model_item.optimizer,
             lambda leaf, box: self._canon_leaf(leaf, box.spec),
             s, boxed,
             transform_non_params=lambda leaf: leaf,
-            is_leaf=lambda x: isinstance(x, _SpecBox)))
+            is_leaf=lambda x: isinstance(x, _SpecBox)),
+            out_shardings=NamedSharding(self.mesh, P()))
         return fn(opt_state)
 
     def uncanonicalize_opt_state(self, canonical):
@@ -702,7 +705,8 @@ class GraphTransformer:
         return fn(canonical)
 
     def canonicalize_params(self, storage):
-        """Storage tree -> original-shape param tree."""
+        """Storage tree -> original-shape param tree (REPLICATED output so
+        multi-host fetch works — see canonicalize_opt_state)."""
         plans_tree = self.treedef.unflatten([self.plans[n] for n in self.names])
 
         def fetch(leaf, plan):
@@ -710,7 +714,8 @@ class GraphTransformer:
                 return leaf
             return self._canon_leaf(leaf, plan)
 
-        return jax.jit(lambda s: jax.tree.map(fetch, s, plans_tree))(storage)
+        return jax.jit(lambda s: jax.tree.map(fetch, s, plans_tree),
+                       out_shardings=NamedSharding(self.mesh, P()))(storage)
 
     def uncanonicalize_params(self, params):
         plans_tree = self.treedef.unflatten([self.plans[n] for n in self.names])

@@ -71,6 +71,10 @@ class VarPlan:
     # PS fields
     ps_sync: bool = True
     staleness: int = 0
+    # carried for proto fidelity only: the weight-update-sharding backend
+    # ALWAYS produces the post-update all-gathered copy, which IS the
+    # reference's proxy — so PS() and PS(local_proxy_variable=True) compile
+    # to the identical program (documented in docs/usage.md)
     local_replication: bool = False
     reduction_destination: str = ""
     # CUSTOM placement: the user-supplied PartitionSpec

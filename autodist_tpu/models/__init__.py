@@ -10,5 +10,8 @@ from autodist_tpu.models.inception import InceptionV3  # noqa: F401
 from autodist_tpu.models.bert import (  # noqa: F401
     BERT_BASE, BERT_LARGE, BERT_TINY, Bert, BertConfig, BertForPreTraining,
 )
+from autodist_tpu.models.gpt import (  # noqa: F401
+    GPT, GPT_SMALL, GPT_TINY, GPTConfig,
+)
 from autodist_tpu.models.lm import LMConfig, LSTMBody, LSTMLM  # noqa: F401
 from autodist_tpu.models.ncf import NCFConfig, NeuMF  # noqa: F401

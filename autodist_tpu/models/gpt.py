@@ -1,0 +1,125 @@
+"""GPT-style causal decoder LM — the long-context flagship.
+
+Beyond the reference's model zoo (its benchmark families are BERT /
+imagenet convnets / NCF / LSTM-LM): a decoder-only transformer whose
+attention runs CAUSAL ring attention when the engine's ``seq`` mesh axis is
+active, so context length scales with the mesh (per-device memory
+O(S/num_seq_shards)) — the "long-context and distributed are first-class"
+requirement.  TPU-native choices mirror ``models/bert.py``: bf16
+activations / f32 params, fused QKV, pre-LayerNorm blocks, tied input/output
+embedding (dense-synced, see ``ops/sparse.embedding_lookup`` contract).
+"""
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.ops.sparse import embedding_lookup
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 1024
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+
+GPT_SMALL = GPTConfig()
+GPT_TINY = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=2, intermediate_size=128, max_position=128,
+                     dtype=jnp.float32)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic):
+        from autodist_tpu.parallel.context import current_seq_axis
+        from autodist_tpu.parallel.ring_attention import ring_attention
+
+        c = self.config
+        head_dim = c.hidden_size // c.num_heads
+        qkv = nn.Dense(3 * c.hidden_size, dtype=c.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, S = x.shape[0], x.shape[1]
+        shape = (B, S, c.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        seq_axis = current_seq_axis()
+        if seq_axis is not None:
+            # causal masking over GLOBAL positions while K/V blocks stream
+            # around the seq ring
+            y = ring_attention(q, k, v, seq_axis, causal=True)
+        else:
+            pos = jnp.arange(S)
+            bias = jnp.where(pos[:, None] >= pos[None, :], 0.0,
+                             -1e9)[None, None].astype(c.dtype)
+            y = jax.nn.dot_product_attention(q, k, v, bias=bias)
+        y = y.reshape(B, S, c.hidden_size)
+        return nn.Dense(c.hidden_size, dtype=c.dtype, name="out")(y)
+
+
+class GPTBlock(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic):
+        c = self.config
+        y = nn.LayerNorm(dtype=c.dtype, name="ln_1")(x)
+        y = CausalSelfAttention(c, name="attn")(y, deterministic)
+        y = nn.Dropout(c.dropout_rate)(y, deterministic=deterministic)
+        x = x + y
+        y = nn.LayerNorm(dtype=c.dtype, name="ln_2")(x)
+        y = nn.Dense(c.intermediate_size, dtype=c.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_out")(y)
+        y = nn.Dropout(c.dropout_rate)(y, deterministic=deterministic)
+        return x + y
+
+
+class GPT(nn.Module):
+    """Returns next-token logits (B, S, V)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens, deterministic=True):
+        from autodist_tpu.parallel.context import global_position_offset
+
+        c = self.config
+        B, S = tokens.shape
+        # tied with the output head -> dense gradient (sync=False contract)
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (c.vocab_size, c.hidden_size), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.02),
+                         (c.max_position, c.hidden_size), jnp.float32)
+        x = embedding_lookup(wte, tokens, sync=False)
+        pos0 = global_position_offset(S)  # seq-parallel: global block start
+        x = x + jax.lax.dynamic_slice_in_dim(wpe, pos0, S)[None]
+        x = nn.Dropout(c.dropout_rate)(x.astype(c.dtype),
+                                       deterministic=deterministic)
+        for i in range(c.num_layers):
+            x = GPTBlock(c, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
+        return x.astype(jnp.float32) @ wte.T
+
+
+def gpt_loss(logits, targets, mask=None):
+    """Next-token cross entropy; ``targets[t]`` is the token after position
+    ``t`` (the caller shifts — under sequence parallelism each device then
+    holds matching local blocks).  ``mask``: per-EXAMPLE validity from the
+    session's uneven-batch padding; -100 targets are ignored per-position."""
+    valid = (targets >= 0).astype(jnp.float32)
+    if mask is not None:
+        valid = valid * mask.reshape(mask.shape + (1,) * (valid.ndim - 1))
+    safe = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return -jnp.sum(ll * valid) / jnp.maximum(jnp.sum(valid), 1.0)

@@ -76,6 +76,28 @@ def bert_capture(config, seq_len, rng=None):
     return loss_fn, params, []
 
 
+def gpt_capture(config, seq_len, rng=None):
+    """Init a GPT causal LM; returns (loss_fn, params, sparse_vars).
+
+    ``loss_fn(params, batch, rng)`` with ``batch = {"tokens", "targets"}``
+    (targets pre-shifted by the caller).  The tied embedding's gradient is
+    dense, so no variable takes the sparse path (same as BERT).
+    """
+    from autodist_tpu.models.gpt import GPT, gpt_loss
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = GPT(config)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(rng, dummy, deterministic=True)["params"]
+
+    def loss_fn(p, batch, step_rng):
+        logits = model.apply({"params": p}, batch["tokens"],
+                             deterministic=False, rngs={"dropout": step_rng})
+        return gpt_loss(logits, batch["targets"], batch.get(BATCH_MASK_KEY))
+
+    return loss_fn, params, []
+
+
 def lm_capture(config, seq_len, rng=None):
     """The embedding table is a TOP-LEVEL param (not flax-managed) so a
     PartitionedPS strategy can shard it end-to-end: the engine then hands

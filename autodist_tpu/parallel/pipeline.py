@@ -38,7 +38,7 @@ def stack_stages(params_per_stage):
 
 
 def pipeline_apply(body_fn, stacked_local, x, axis_name, num_microbatches,
-                   remat=True):
+                   remat=True, stages_per_device=1):
     """Run ``x`` through the pipeline of stages; returns final activations
     (valid and identical on every pipe member).
 
@@ -46,28 +46,41 @@ def pipeline_apply(body_fn, stacked_local, x, axis_name, num_microbatches,
       body_fn: ``body_fn(stage_params, act) -> act`` for ONE stage; the
         activation shape must be preserved (homogeneous stages).
       stacked_local: this device's local block of the stacked stage params —
-        leading dim 1 (what the engine hands the loss under ``P("pipe")``
-        CUSTOM placement).  For a single-device reference run use
-        :func:`pipeline_reference` instead (no mesh axis needed).
+        leading dim ``stages_per_device`` (what the engine hands the loss
+        under ``P("pipe")`` CUSTOM placement).  For a single-device
+        reference run use :func:`pipeline_reference` (no mesh axis needed).
       x: local batch activations ``(B, ...)``.
       axis_name: the pipeline mesh axis (``const.AXIS_PIPELINE``).
       num_microbatches: M; ``B % M == 0``.  Larger M shrinks the bubble.
       remat: rematerialize each stage application in the backward pass
         (GPipe's memory profile: activations per microbatch boundary only).
+      stages_per_device: deep models on a small pipe axis — stack ``L*S``
+        stages and each device applies its contiguous L-stage block per
+        tick (device p owns global stages ``[p*L, (p+1)*L)``).
+
+    Design note: GPipe (full forward then AD-generated full backward) is
+    the right schedule for this engine because the loss lives OUTSIDE the
+    pipeline op — 1F1B needs per-microbatch loss cotangents DURING the
+    schedule, i.e. the loss inside the op; with ``remat`` the per-device
+    boundary-activation storage is O(M + S) microbatch blocks.
     """
     S = axis_size(axis_name)
     idx = axis_index(axis_name)
     lead = {l.shape[0] for l in jax.tree.leaves(stacked_local)}
-    if S > 1 and lead != {1}:
-        # unsharded stacked params would silently run every stage with
-        # stage 0's weights — the one param_specs misconfiguration the
-        # engine cannot catch for us
+    if len(lead) != 1:
+        raise ValueError(f"stage params disagree on stage count: {sorted(lead)}")
+    (L,) = lead  # stages PER DEVICE (virtual pipeline: total = L*S stages)
+    if S > 1 and L != stages_per_device:
+        # an unsharded stacked tree would silently run every device with
+        # the same leading stages — the one param_specs misconfiguration
+        # the engine cannot catch for us
         raise ValueError(
-            f"pipeline_apply expected shard-local stage params (leading dim "
-            f"1), got leading dims {sorted(lead)}: place the stacked tree "
-            f"with distribute(param_specs={{'<blocks>/...': P('{axis_name}')"
-            f"}}) so each device holds exactly its stage")
-    stage_params = jax.tree.map(lambda a: a[0], stacked_local)
+            f"pipeline_apply expected shard-local stage params with leading "
+            f"dim {stages_per_device} (stages_per_device), got {L}: place "
+            f"the stacked tree with distribute(param_specs="
+            f"{{'<blocks>/...': P('{axis_name}')}}) so each device holds "
+            f"exactly its stages")
+    stage_params = stacked_local
     M = int(num_microbatches)
     B = x.shape[0]
     if B % M:
@@ -77,6 +90,13 @@ def pipeline_apply(body_fn, stacked_local, x, axis_name, num_microbatches,
     micro = x.reshape((M, mb) + x.shape[1:])
     body = jax.checkpoint(body_fn) if remat else body_fn
 
+    def superstage(params_local, x_in):
+        # contiguous block assignment: device p holds global stages
+        # [p*L, (p+1)*L), applied in order within the tick
+        for j in range(L):
+            x_in = body(jax.tree.map(lambda a: a[j], params_local), x_in)
+        return x_in
+
     def tick(act, t):
         # stage 0 consumes microbatch t (clamped into range during the
         # drain ticks; those outputs never reach the last stage in time and
@@ -85,7 +105,7 @@ def pipeline_apply(body_fn, stacked_local, x, axis_name, num_microbatches,
         feed = jax.lax.dynamic_index_in_dim(
             micro, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
         cur = jnp.where(jnp.equal(idx, 0), feed, act)
-        y = body(stage_params, cur)
+        y = superstage(stage_params, cur)
         nxt = jax.lax.ppermute(y, axis_name,
                                [(i, i + 1) for i in range(S - 1)])
         return nxt, y

@@ -32,11 +32,6 @@ class DistributedSession:
         # uneven-batch pad+mask is OPT-IN (distribute(batch_mask=True)):
         # the loss must exclude masked rows from its local mean, otherwise
         # pad rows silently bias the update — a loud error beats that
-        if batch_mask and self._multi_host:
-            raise ValueError(
-                "batch_mask=True is single-host for now: on multi-host runs "
-                "each host must feed evenly-sized local slices (pre-pad per "
-                "host and include the mask leaf yourself)")
         self._batch_mask = batch_mask
         self._warned_uneven = False
 
@@ -69,17 +64,12 @@ class DistributedSession:
         ``distribute(batch_mask=True)``.  Only dict batches can carry the
         mask leaf.
         """
-        spec = tuple(self._batch_spec)
-        if not spec or not isinstance(batch, dict) or BATCH_MASK_KEY in batch:
+        B = self._maskable_batch_size(batch)
+        if B is None:
             return batch, 0
         # pad to a multiple of replicas x accum_steps so the microbatch
         # split inside the engine divides evenly too
-        n0 = self._spec_dim_size(spec[0]) * self._t.accum_steps
-        sizes = {np.shape(v)[0] for v in jax.tree.leaves(batch)
-                 if np.ndim(v) >= 1}
-        if len(sizes) != 1:
-            return batch, 0  # mixed leading dims: let divisibility checks fire
-        (B,) = sizes
+        n0 = self._spec_dim_size(tuple(self._batch_spec)[0]) * self._t.accum_steps
         pad = (-B) % n0
         if pad == 0:
             return batch, 0
@@ -89,23 +79,86 @@ class DistributedSession:
                 "Global batch %d not divisible by replica count %d: padding "
                 "%d row(s) + '%s' mask (loss must ignore masked rows; "
                 "warning logged once).", B, n0, pad, BATCH_MASK_KEY)
+        return self._pad_to(batch, B, B + pad), pad
+
+    def _maskable_batch_size(self, batch):
+        """Leading batch size if this batch is eligible for pad+mask (dict,
+        single leading dim, no mask yet), else None."""
+        spec = tuple(self._batch_spec)
+        if not spec or not isinstance(batch, dict) or BATCH_MASK_KEY in batch:
+            return None
+        sizes = {np.shape(v)[0] for v in jax.tree.leaves(batch)
+                 if np.ndim(v) >= 1}
+        if len(sizes) != 1:
+            return None  # mixed leading dims: let divisibility checks fire
+        (B,) = sizes
+        return int(B)
+
+    @staticmethod
+    def _pad_to(batch, B, target):
+        """Pad every leading-dim leaf from B to target rows (repeating the
+        last row) and inject the validity mask leaf."""
+        pad = target - B
 
         def pad_leaf(x):
             x = np.asarray(x)
-            if x.ndim == 0:
+            if x.ndim == 0 or pad == 0:
                 return x
             return np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], axis=0)
 
         padded = jax.tree.map(pad_leaf, batch)
-        mask = np.zeros((B + pad,), np.float32)
+        mask = np.zeros((target,), np.float32)
         mask[:B] = 1.0
         padded[BATCH_MASK_KEY] = mask
-        return padded, pad
+        return padded
+
+    def _pad_uneven_multihost(self, batch):
+        """Multi-host uneven feeds: hosts may bring different local batch
+        sizes (the reference's per-replica np.array_split allowed it); SPMD
+        needs one per-device row count, so the hosts agree on it via a
+        host-level allgather, each pads its slice to that multiple and
+        injects its mask rows.  The engine's s_local*R/S weighting then
+        reproduces the global weighted average across hosts.
+
+        The skip decision is made AFTER the allgather from the gathered
+        sizes (an ineligible batch reports -1), so no host can return early
+        while the others block in the collective.
+        """
+        from jax.experimental import multihost_utils
+
+        B = self._maskable_batch_size(batch)
+        code = -1 if B is None else B
+        all_b = np.asarray(multihost_utils.process_allgather(np.int32(code)))
+        if (all_b < 0).any():
+            # some host's batch is ineligible (mask already present / mixed
+            # leading dims): every host skips so structures stay consistent
+            return batch
+        spec = tuple(self._batch_spec)
+        n0_local = self._spec_dim_size(spec[0]) // jax.process_count()
+        # per-device rows must also divide into accum_steps microbatches
+        A = self._t.accum_steps
+        k = -(-int(all_b.max()) // max(1, n0_local))
+        k = -(-k // A) * A
+        target = k * n0_local
+        if int(all_b.min()) == int(all_b.max()) and target == B:
+            return batch
+        pad = target - B
+        if pad < 0:
+            raise ValueError(f"local batch {B} exceeds computed target {target}")
+        if not self._warned_uneven:
+            self._warned_uneven = True
+            logging.warning(
+                "Uneven multi-host feed (local %d, host sizes %s): padding "
+                "to %d rows + '%s' mask per host.", B, all_b.tolist(),
+                target, BATCH_MASK_KEY)
+        return self._pad_to(batch, B, target)
 
     def _shard_batch(self, batch):
         spec = tuple(self._batch_spec)
         if self._batch_mask and not self._multi_host:
             batch, _ = self._pad_uneven(batch)
+        elif self._batch_mask and self._multi_host:
+            batch = self._pad_uneven_multihost(batch)
 
         def put(x):
             x = np.asarray(x) if not isinstance(x, jax.Array) else x
@@ -160,6 +213,61 @@ class DistributedSession:
             if log_every and (i + 1) % log_every == 0:
                 logging.info("step %d: loss=%s", i + 1, float(metrics["loss"]))
         return metrics
+
+    def fit(self, batch_fn, steps, *, checkpoint_path=None, save_every=0,
+            log_every=0, resume=True):
+        """Managed training loop: periodic checkpoints + crash resume.
+
+        ``batch_fn(step) -> batch`` supplies the step's global batch (a
+        callable rather than an iterator so a resumed run can re-enter the
+        stream at the restored step).  With ``checkpoint_path``, the loop
+        restores the latest checkpoint on entry (``resume=True``), saves
+        every ``save_every`` steps and at the end — so a preempted or
+        crashed job re-run with the same arguments continues where it left
+        off (the reference's fail-fast coordinator offers no recovery; this
+        is the TPU-pod-preemption story on top of the Saver contract).
+        """
+        saver = None
+        if checkpoint_path:
+            from autodist_tpu.checkpoint.saver import Saver
+
+            saver = Saver(self)
+            if resume:
+                # remote stores (gs:// etc.) aren't visible to os.path —
+                # attempt the restore and treat failure as "no checkpoint"
+                is_remote = "://" in checkpoint_path
+                if is_remote or os.path.exists(checkpoint_path):
+                    try:
+                        saver.restore(checkpoint_path)
+                        logging.info("fit: resumed from %s at step %d",
+                                     checkpoint_path, self.step)
+                    except Exception as e:
+                        if not is_remote:
+                            raise
+                        logging.info(
+                            "fit: no restorable checkpoint at %s (%s); "
+                            "starting fresh", checkpoint_path, e)
+                else:
+                    logging.info("fit: no checkpoint at %s; starting fresh",
+                                 checkpoint_path)
+        metrics = None
+        while self.step < steps:
+            step = self.step
+            metrics = self.run(batch_fn(step))
+            done = self.step
+            if log_every and done % log_every == 0:
+                logging.info("step %d: loss=%s", done, float(metrics["loss"]))
+            if saver and save_every and done % save_every == 0:
+                saver.save(checkpoint_path)
+        if saver:
+            saver.save(checkpoint_path)
+        return metrics
+
+    def memory_stats(self):
+        """Per-device live/peak memory (bytes) when the backend reports it
+        (TPU does; CPU returns None entries)."""
+        return {str(d): d.memory_stats() if hasattr(d, "memory_stats") else None
+                for d in self._mesh.devices.flat}
 
     # -- fetches (reference remapper._remap_fetch analog) ------------------
 

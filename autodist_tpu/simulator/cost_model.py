@@ -41,9 +41,38 @@ class CostEstimate:
         lo, hi = sorted((self.compute_s, self.comm_s))
         return hi + 0.7 * lo
 
+    def calibrated_total(self, calibration):
+        """Measured-data-corrected step time: the analytic terms scaled by
+        coefficients fit from RuntimeRecords (see :func:`calibrate`)."""
+        return (calibration["compute_scale"] * self.compute_s
+                + calibration["comm_scale"] * self.comm_s
+                + calibration.get("overhead_s", 0.0))
+
     def to_json(self):
         return {"compute_s": self.compute_s, "comm_s": self.comm_s,
                 "total_s": self.total_s, **self.breakdown}
+
+
+def calibrate(pairs):
+    """Fit correction coefficients from measured runs (the AutoSync loop:
+    measured (strategy, runtime) tuples ground the analytic model).
+
+    ``pairs``: list of ``(CostEstimate, measured_step_s)``.  Least-squares
+    fit of ``measured ~= a*compute_s + b*comm_s + c``; returns the
+    calibration dict :meth:`CostEstimate.calibrated_total` consumes.  With
+    fewer than 2 pairs the identity calibration is returned.
+    """
+    import numpy as np
+
+    if len(pairs) < 2:
+        return {"compute_scale": 1.0, "comm_scale": 1.0, "overhead_s": 0.0}
+    A = np.array([[e.compute_s, e.comm_s, 1.0] for e, _ in pairs])
+    y = np.array([m for _, m in pairs])
+    coef, *_ = np.linalg.lstsq(A, y, rcond=None)
+    a, b, c = coef
+    return {"compute_scale": float(max(a, 0.0)),
+            "comm_scale": float(max(b, 0.0)),
+            "overhead_s": float(max(c, 0.0))}
 
 
 def _ring_time(bytes_, n, bw_bytes_per_s):
@@ -141,13 +170,17 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         "num_replicas": R})
 
 
-def rank_strategies(builders, model_item, resource_spec, **kw):
-    """Rank candidate builders by estimated step time (cheapest first)."""
+def rank_strategies(builders, model_item, resource_spec, calibration=None, **kw):
+    """Rank candidate builders by estimated step time (cheapest first);
+    with ``calibration`` (from :func:`calibrate`) the measured-corrected
+    totals are used instead of the analytic overlap heuristic."""
     scored = []
     for b in builders:
         s = b.build(model_item, resource_spec)
         est = estimate(s, model_item, resource_spec, **kw)
-        scored.append((est.total_s, type(b).__name__, b, est, s))
+        total = (est.calibrated_total(calibration) if calibration
+                 else est.total_s)
+        scored.append((total, type(b).__name__, b, est, s))
     scored.sort(key=lambda t: t[0])
     return scored
 

@@ -67,6 +67,10 @@ def _bench():
     jax.block_until_ready(m["loss"])
 
     steps = int(os.environ.get("BENCH_STEPS", "30"))
+    trace_dir = os.environ.get("BENCH_TRACE", "")
+    if trace_dir:  # one traced window for MFU analysis (jax.profiler)
+        m = sess.run(gbatch, trace_dir=trace_dir)
+        jax.block_until_ready(m["loss"])
     best = float("inf")
     for _ in range(2):  # two timed windows; keep the best (noise guard)
         t0 = time.perf_counter()

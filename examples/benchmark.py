@@ -68,6 +68,19 @@ def build(model_name, seq_len, image_size):
         return dict(loss_fn=loss_fn, params=params, mutable_state=None,
                     sparse_vars=sparse, has_rng=False,
                     optimizer=optax.adam(1e-3), batch_fn=batch_fn)
+    if model_name in ("gpt_small", "gpt_tiny"):
+        from autodist_tpu.models import GPT_SMALL, GPT_TINY
+
+        cfg = GPT_SMALL if model_name == "gpt_small" else GPT_TINY
+        loss_fn, params, sparse = train_lib.gpt_capture(cfg, seq_len)
+
+        def batch_fn(B):
+            toks = r.randint(0, cfg.vocab_size, (B, seq_len + 1)).astype(np.int32)
+            return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+        return dict(loss_fn=loss_fn, params=params, mutable_state=None,
+                    sparse_vars=sparse, has_rng=True,
+                    optimizer=optax.adamw(1e-4), batch_fn=batch_fn)
     if model_name == "lm1b":
         from autodist_tpu.models import train_lib as tl
 
@@ -90,6 +103,7 @@ FLOPS_PER_EXAMPLE = {
     "resnet50": 4.1e9, "resnet101": 7.8e9, "vgg16": 15.5e9,
     "densenet121": 2.9e9, "inception_v3": 5.7e9,
     "bert_base": 2.8e10, "bert_large": 9.8e10,  # ~2 * params * seq_len(128)
+    "gpt_small": 3.2e10,                        # ~2 * 124M * seq_len(128)
 }
 
 
@@ -126,12 +140,12 @@ def sweep(args):
     cost model's ranking against measured step times."""
     import json
 
-    from autodist_tpu.simulator.cost_model import estimate
+    from autodist_tpu.simulator.cost_model import calibrate, estimate
 
     os.environ["AUTODIST_IS_TESTING"] = "True"  # several AutoDist instances
     n_chips = jax.device_count()
     strategies = [s.strip() for s in args.strategies.split(",") if s.strip()]
-    measured, estimated = {}, {}
+    measured, estimated, pairs = {}, {}, []
     records_dir = args.records_dir
     if records_dir:
         os.makedirs(records_dir, exist_ok=True)
@@ -143,6 +157,7 @@ def sweep(args):
                        flops_per_example=FLOPS_PER_EXAMPLE.get(args.model, 0.0),
                        batch_per_chip=args.batch_per_chip)
         estimated[name] = est.total_s
+        pairs.append((est, record.step_time_s))
         if records_dir:
             record.dump(os.path.join(
                 records_dir, f"{args.model}_{name}.json"))
@@ -156,6 +171,8 @@ def sweep(args):
         "measured_step_s": measured, "estimated_step_s": estimated,
         "measured_rank": measured_rank, "estimated_rank": estimated_rank,
         "top_choice_agrees": measured_rank[0] == estimated_rank[0],
+        # measured-grounded correction for future AutoStrategy rankings
+        "calibration": calibrate(pairs),
     }
     print(json.dumps(summary))
     if records_dir:

@@ -104,11 +104,15 @@ struct AdioLoader {
   std::vector<uint64_t> perm;
   size_t cursor = 0;
   std::mt19937_64 rng;
+  // multi-host sharding: this loader only yields records with
+  // index % shard_count == shard_index (each host feeds its slice)
+  uint64_t shard_index = 0;
+  uint64_t shard_count = 1;
 
   void refill_perm() {
     if (perm.empty()) {
-      perm.resize(ds->num_records);
-      for (size_t i = 0; i < perm.size(); ++i) perm[i] = i;
+      for (uint64_t i = shard_index; i < ds->num_records; i += shard_count)
+        perm.push_back(i);
     }
     if (shuffle) {
       for (size_t i = perm.size(); i > 1; --i)
@@ -144,9 +148,14 @@ struct AdioLoader {
   }
 };
 
-AdioLoader* adio_loader_new(AdioDataset* ds, uint64_t batch, uint64_t threads,
-                            int shuffle, uint64_t seed, uint64_t prefetch) {
+AdioLoader* adio_loader_new_sharded(AdioDataset* ds, uint64_t batch,
+                                    uint64_t threads, int shuffle,
+                                    uint64_t seed, uint64_t prefetch,
+                                    uint64_t shard_index,
+                                    uint64_t shard_count) {
   if (!ds || batch == 0 || ds->num_records == 0) return nullptr;
+  if (shard_count == 0 || shard_index >= shard_count) return nullptr;
+  if (shard_index >= ds->num_records) return nullptr;  // empty shard
   auto* ld = new AdioLoader();
   ld->ds = ds;
   ld->batch = batch;
@@ -154,6 +163,8 @@ AdioLoader* adio_loader_new(AdioDataset* ds, uint64_t batch, uint64_t threads,
   ld->seed = seed;
   ld->rng.seed(seed);
   ld->prefetch = prefetch ? prefetch : 2;
+  ld->shard_index = shard_index;
+  ld->shard_count = shard_count;
   ld->refill_perm();
   const size_t slab_bytes = batch * ds->record_bytes;
   for (size_t i = 0; i < ld->prefetch + 1; ++i) {
@@ -165,6 +176,12 @@ AdioLoader* adio_loader_new(AdioDataset* ds, uint64_t batch, uint64_t threads,
   for (uint64_t t = 0; t < nthreads; ++t)
     ld->workers.emplace_back([ld] { ld->worker(); });
   return ld;
+}
+
+AdioLoader* adio_loader_new(AdioDataset* ds, uint64_t batch, uint64_t threads,
+                            int shuffle, uint64_t seed, uint64_t prefetch) {
+  return adio_loader_new_sharded(ds, batch, threads, shuffle, seed, prefetch,
+                                 0, 1);
 }
 
 const uint8_t* adio_loader_next(AdioLoader* ld) {

@@ -65,13 +65,28 @@ import autodist_tpu.autodist as admod  # noqa: E402
 
 importlib.reload(admod)
 
+uneven = strategy_name.endswith(":uneven")
+strategy_name = strategy_name.split(":")[0]
+
 spec = ResourceSpec.from_num_chips(R)
 builder = getattr(S, strategy_name)()
 ad = admod.AutoDist(resource_spec=spec, strategy_builder=builder)
 
+if uneven:
+    # mask-aware loss: uneven per-host feeds are padded + masked; the
+    # engine weights each device by its real-example count
+    from autodist_tpu.const import BATCH_MASK_KEY
 
-def loss_fn(p, batch):
-    return jnp.mean((batch @ p["w"]) ** 2)
+    def loss_fn(p, batch):
+        per_ex = (batch["x"] @ p["w"]) ** 2
+        m = batch.get(BATCH_MASK_KEY)
+        if m is None:
+            return jnp.mean(per_ex)
+        m = m.astype(per_ex.dtype)
+        return jnp.sum(per_ex * m) / jnp.maximum(jnp.sum(m), 1.0)
+else:
+    def loss_fn(p, batch):
+        return jnp.mean((batch @ p["w"]) ** 2)
 
 
 params = {"w": jnp.asarray(np.linspace(1, 2, 6, dtype=np.float32))}
@@ -90,11 +105,17 @@ if pid == 0:
 
     ad._build_or_load_strategy = publishing_build
 
-sess = ad.distribute(loss_fn, params, optax.sgd(0.1))
+sess = ad.distribute(loss_fn, params, optax.sgd(0.1), batch_mask=uneven)
 
 # global batch is seeded and identical across processes; each feeds its slice
-full = np.random.RandomState(0).randn(4 * R, 6).astype(np.float32)
-local = full[pid * (len(full) // nproc):(pid + 1) * (len(full) // nproc)]
+if uneven:
+    # 8 real rows split 5/3 across the two hosts (reference np.array_split
+    # weighted-feed semantics) — hosts pad+mask to a common per-device count
+    full = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    local = {"x": full[:5] if pid == 0 else full[5:]}
+else:
+    full = np.random.RandomState(0).randn(4 * R, 6).astype(np.float32)
+    local = full[pid * (len(full) // nproc):(pid + 1) * (len(full) // nproc)]
 for _ in range(3):
     metrics = sess.run(local)
 

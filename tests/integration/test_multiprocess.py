@@ -69,3 +69,27 @@ def test_two_process_training_matches_oracle(strategy, tmp_path):
         np.testing.assert_allclose(np.asarray(res["w"]), want, atol=1e-5,
                                    err_msg=f"{strategy} pid={res['pid']}")
     assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6
+
+
+def test_two_process_uneven_feed_matches_oracle(tmp_path):
+    """Hosts feed 5 and 3 rows of an 8-row global batch (reference
+    remapper's uneven np.array_split, cases/c0.py weighted average): the
+    multi-host pad+mask path must equal single-device training on the 8
+    real rows."""
+    port = 15870
+    results = _run_cluster("AllReduce:uneven", tmp_path, port)
+
+    full = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    p = {"w": jnp.asarray(np.linspace(1, 2, 6, dtype=np.float32))}
+    opt = optax.sgd(0.1)
+    st = opt.init(p)
+    loss = lambda p_, b: jnp.mean((b @ p_["w"]) ** 2)
+    for _ in range(3):
+        g = jax.grad(loss)(p, jnp.asarray(full))
+        u, st = opt.update(g, st, p)
+        p = jax.tree.map(lambda a, b: a + b, p, u)
+    want = np.asarray(p["w"])
+
+    for res in results:
+        np.testing.assert_allclose(np.asarray(res["w"]), want, atol=1e-5,
+                                   err_msg=f"uneven pid={res['pid']}")

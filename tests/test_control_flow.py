@@ -1,0 +1,133 @@
+"""Structured control flow inside user losses under every strategy family.
+
+Reference integration cases exercise graph-mode control flow: c2 (sparse
+embeddings + tf.cond), c4 (tf.while_loop via autodist.function), c6
+(dynamic LSTM).  The TPU-native equivalents are ``lax.cond`` /
+``lax.while_loop`` / ``lax.scan`` inside the jitted SPMD step — these must
+trace and synchronize correctly under AR, PS, and partitioned strategies.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.ops.sparse import embedding_lookup
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import PS, AllReduce, Parallax, PartitionedPS
+
+SPEC = ResourceSpec.from_num_chips(8)
+BUILDERS = [AllReduce(), PS(), PartitionedPS(max_shards=8)]
+
+
+def _train(loss_fn, params, batch, builder, steps=2, **kw):
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=builder)
+    sess = ad.distribute(loss_fn, params, optax.sgd(0.1), **kw)
+    for _ in range(steps):
+        m = sess.run(batch)
+    return sess.params(), float(m["loss"])
+
+
+def _oracle(loss_fn, params, batch, steps=2):
+    opt = optax.sgd(0.1)
+    st = opt.init(params)
+    p = params
+    for _ in range(steps):
+        g = jax.grad(loss_fn)(p, jax.tree.map(jnp.asarray, batch))
+        u, st = opt.update(g, st, p)
+        p = optax.apply_updates(p, u)
+    return p
+
+
+@pytest.mark.parametrize("builder", BUILDERS, ids=lambda b: type(b).__name__)
+def test_cond_in_loss(builder):
+    """lax.cond on a data-dependent predicate (reference c2's tf.cond)."""
+    def loss_fn(p, batch):
+        x = batch["x"]
+        mean = jnp.mean(x)
+        y = jax.lax.cond(mean > 0,
+                         lambda v: v @ p["w_pos"],
+                         lambda v: v @ p["w_neg"],
+                         x)
+        return jnp.mean(y ** 2)
+
+    r = np.random.RandomState(0)
+    params = {"w_pos": jnp.asarray(r.randn(6, 3), jnp.float32),
+              "w_neg": jnp.asarray(r.randn(6, 3), jnp.float32)}
+    batch = {"x": np.abs(r.randn(16, 6)).astype(np.float32)}  # mean > 0
+    got, _ = _train(loss_fn, params, batch, builder)
+    exp = _oracle(loss_fn, params, batch)
+    np.testing.assert_allclose(got["w_pos"], exp["w_pos"], atol=2e-5)
+    np.testing.assert_allclose(got["w_neg"], exp["w_neg"], atol=2e-5)
+
+
+@pytest.mark.parametrize("builder", BUILDERS, ids=lambda b: type(b).__name__)
+def test_scan_unrolled_net(builder):
+    """lax.scan over layers (reference c4/c6: while_loop / dynamic RNN)."""
+    L = 3
+
+    def loss_fn(p, batch):
+        def body(x, w):
+            return jnp.tanh(x @ w), None
+
+        y, _ = jax.lax.scan(body, batch["x"], p["ws"])
+        return jnp.mean(y ** 2)
+
+    r = np.random.RandomState(1)
+    params = {"ws": jnp.asarray(r.randn(L, 6, 6) * 0.5, jnp.float32)}
+    batch = {"x": r.randn(16, 6).astype(np.float32)}
+    got, _ = _train(loss_fn, params, batch, builder)
+    exp = _oracle(loss_fn, params, batch)
+    np.testing.assert_allclose(got["ws"], exp["ws"], atol=2e-5)
+
+
+def test_while_loop_fori_in_loss():
+    """fori_loop-style iterative computation in the loss still trains."""
+    def loss_fn(p, batch):
+        def body(_, x):
+            return jnp.tanh(x @ p["w"])
+
+        y = jax.lax.fori_loop(0, 3, body, batch["x"])
+        return jnp.mean(y ** 2)
+
+    r = np.random.RandomState(2)
+    params = {"w": jnp.asarray(r.randn(6, 6) * 0.5, jnp.float32)}
+    batch = {"x": r.randn(16, 6).astype(np.float32)}
+    # fori_loop is not reverse-differentiable; jax unrolls static bounds via
+    # scan equivalence — verify it trains (grads flow) and stays finite
+    got, loss = _train(loss_fn, params, batch, AllReduce())
+    exp = _oracle(loss_fn, params, batch)
+    np.testing.assert_allclose(got["w"], exp["w"], atol=2e-5)
+    assert np.isfinite(loss)
+
+
+def test_cond_with_sparse_embedding():
+    """Reference c2: sparse embeddings + cond + adaptive optimizer."""
+    V, D = 20, 4
+
+    def loss_fn(p, batch):
+        e = embedding_lookup(p["emb"], batch["ids"])
+        out = jax.lax.cond(jnp.sum(batch["ids"]) % 2 == 0,
+                           lambda v: v * 2.0, lambda v: v * 0.5, e)
+        return jnp.mean(out ** 2)
+
+    r = np.random.RandomState(3)
+    params = {"emb": jnp.asarray(r.randn(V, D), jnp.float32)}
+    ids = r.randint(0, V, (16,)).astype(np.int32)
+
+    opt = optax.adam(0.05)
+    p, st = params, opt.init(params)
+    for _ in range(2):
+        g = jax.grad(loss_fn)(p, {"ids": jnp.asarray(ids)})
+        u, st = opt.update(g, st, p)
+        p = optax.apply_updates(p, u)
+
+    for builder in [Parallax(), PartitionedPS(max_shards=8)]:
+        ad = AutoDist(resource_spec=SPEC, strategy_builder=builder)
+        sess = ad.distribute(loss_fn, params, optax.adam(0.05),
+                             sparse_vars=["emb"])
+        for _ in range(2):
+            sess.run({"ids": ids})
+        np.testing.assert_allclose(sess.params()["emb"], p["emb"], atol=1e-5,
+                                   err_msg=type(builder).__name__)

@@ -135,3 +135,49 @@ def test_pp_reference_matches_loop():
         want = _block(stage, want)
     got = pipeline_reference(_block, p["blocks"], x)
     np.testing.assert_allclose(got, want, atol=0)
+
+
+def test_virtual_pipeline_two_stages_per_device():
+    """8 stages over a 4-wide pipe axis (stages_per_device=2): each device
+    applies its contiguous 2-stage block; value-exact vs sequential."""
+    r = np.random.RandomState(9)
+    stages = [{"w": jnp.asarray(r.randn(D, D) * 0.3, jnp.float32),
+               "b": jnp.zeros((D,), jnp.float32)} for _ in range(8)]
+    params = {"blocks": stack_stages(stages),
+              "head": jnp.asarray(r.randn(D) * 0.5, jnp.float32)}
+
+    def vp_loss(p, b):
+        x = pipeline_apply(_block, p["blocks"], b, AXIS_PIPELINE,
+                           num_microbatches=4, stages_per_device=2)
+        return jnp.mean((x @ p["head"]) ** 2)
+
+    def dense_loss(p, b):
+        x = pipeline_reference(_block, p["blocks"], b)
+        return jnp.mean((x @ p["head"]) ** 2)
+
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=AllReduce())
+    sess = ad.distribute(vp_loss, params, optax.sgd(0.1),
+                         data_axes=("replica",),
+                         param_specs={"blocks/w": P(AXIS_PIPELINE),
+                                      "blocks/b": P(AXIS_PIPELINE)})
+    sess.run(BATCH)
+    g = jax.grad(dense_loss)(params, jnp.asarray(BATCH))
+    exp = jax.tree.map(lambda a, b_: a - 0.1 * b_, params, g)
+    got = sess.params()
+    np.testing.assert_allclose(got["blocks"]["w"], exp["blocks"]["w"], atol=1e-6)
+    np.testing.assert_allclose(got["head"], exp["head"], atol=1e-6)
+
+
+def test_unsharded_stage_params_raise():
+    """Forgotten param_specs entry (stacked tree replicated) must be a loud
+    error, not silent stage-0-everywhere training."""
+    def loss(p, b):
+        x = pipeline_apply(_block, p["blocks"], b, AXIS_PIPELINE,
+                           num_microbatches=4)
+        return jnp.mean((x @ p["head"]) ** 2)
+
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=AllReduce())
+    sess = ad.distribute(loss, _params(), optax.sgd(0.1),
+                         data_axes=("replica",))  # <- no param_specs!
+    with pytest.raises(Exception, match="stages_per_device|shard-local"):
+        sess.run(BATCH)

@@ -63,3 +63,28 @@ def test_auto_strategy_builds_winner():
 def test_total_overlap_model():
     e = CostEstimate(compute_s=1.0, comm_s=0.5, breakdown={})
     assert 1.0 < e.total_s < 1.5
+
+
+def test_calibration_recovers_coefficients():
+    """calibrate() fits measured ~= a*compute + b*comm + c and
+    calibrated_total applies it (AutoSync loop: measurements ground the
+    analytic model)."""
+    from autodist_tpu.simulator.cost_model import CostEstimate, calibrate
+
+    ests = [CostEstimate(compute_s=c, comm_s=m, breakdown={})
+            for c, m in [(1.0, 0.1), (1.0, 0.5), (2.0, 0.2), (3.0, 1.0)]]
+    a, b, c0 = 2.0, 5.0, 0.01
+    pairs = [(e, a * e.compute_s + b * e.comm_s + c0) for e in ests]
+    cal = calibrate(pairs)
+    assert abs(cal["compute_scale"] - a) < 1e-6
+    assert abs(cal["comm_scale"] - b) < 1e-6
+    assert abs(cal["overhead_s"] - c0) < 1e-6
+    got = ests[0].calibrated_total(cal)
+    assert abs(got - pairs[0][1]) < 1e-9
+
+
+def test_calibration_degenerate():
+    from autodist_tpu.simulator.cost_model import calibrate
+
+    cal = calibrate([])
+    assert cal == {"compute_scale": 1.0, "comm_scale": 1.0, "overhead_s": 0.0}
