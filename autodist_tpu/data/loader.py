@@ -33,15 +33,37 @@ def _load_native():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
+
+        def build():
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True)
+
+        try:
+            if not os.path.exists(_SO_PATH):
+                build()
+            lib = ctypes.CDLL(_SO_PATH)
             try:
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                               capture_output=True)
-            except Exception as e:
-                logging.warning("native IO build failed (%s); using numpy fallback", e)
-                _lib = False
-                return _lib
-        lib = ctypes.CDLL(_SO_PATH)
+                lib.adio_loader_new_sharded  # probe: stale prebuilt .so?
+            except AttributeError:
+                # a .so from an older source tree survived (it is
+                # untracked): rebuild and load the fresh binary under a
+                # unique path (dlopen caches by pathname)
+                logging.warning("native IO library is stale; rebuilding")
+                subprocess.run(["make", "-C", _NATIVE_DIR, "clean"],
+                               check=True, capture_output=True)
+                build()
+                import shutil
+                import tempfile
+
+                tmp = tempfile.NamedTemporaryFile(
+                    suffix=".so", delete=False)
+                shutil.copyfile(_SO_PATH, tmp.name)
+                lib = ctypes.CDLL(tmp.name)
+                lib.adio_loader_new_sharded  # must resolve now
+        except Exception as e:
+            logging.warning("native IO unavailable (%s); using numpy fallback", e)
+            _lib = False
+            return _lib
         lib.adio_open.restype = ctypes.c_void_p
         lib.adio_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.adio_num_records.restype = ctypes.c_uint64
@@ -133,6 +155,49 @@ class RecordDataset:
             self.close()
         except Exception:
             pass
+
+
+class DevicePrefetcher:
+    """Keep ``depth`` upcoming batches already sharded onto the device(s).
+
+    JAX transfers are asynchronous: issuing the ``device_put`` for batch
+    N+1..N+depth while step N runs overlaps host->device traffic with
+    compute — the device half of the double buffering whose host half is
+    :class:`BatchLoader`'s prefetch ring (together they replace the
+    reference's delegation to TF's C++ input pipeline).
+
+    ``source``: any iterator of host batches (a :class:`BatchLoader`, a
+    generator, ...).  ``session``: the DistributedSession whose sharding the
+    batches take.
+    """
+
+    def __init__(self, source, session, depth=2):
+        import collections
+
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._it = iter(source)
+        self._sess = session
+        self._q = collections.deque()
+        for _ in range(depth):
+            self._push()
+
+    def _push(self):
+        try:
+            host_batch = next(self._it)
+        except StopIteration:
+            return
+        self._q.append(self._sess._shard_batch(host_batch))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._q:
+            raise StopIteration
+        out = self._q.popleft()
+        self._push()
+        return out
 
 
 class BatchLoader:

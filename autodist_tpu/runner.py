@@ -166,6 +166,8 @@ class DistributedSession:
             # (replica, seq) spec) shard only their leading dims
             leaf_spec = P(*spec[:x.ndim])
             if self._multi_host:
+                if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                    return x  # already a global array (e.g. prefetched)
                 # host-local slices: divisibility/layout is validated by the
                 # global-array conversion against per-host shard shapes
                 from jax.experimental import multihost_utils
@@ -234,23 +236,24 @@ class DistributedSession:
             saver = Saver(self)
             if resume:
                 # remote stores (gs:// etc.) aren't visible to os.path —
-                # attempt the restore and treat failure as "no checkpoint"
+                # attempt the restore; ONLY a missing checkpoint means
+                # "start fresh" (a transient store error must fail loudly,
+                # not silently restart at step 0 and overwrite progress)
                 is_remote = "://" in checkpoint_path
                 if is_remote or os.path.exists(checkpoint_path):
                     try:
                         saver.restore(checkpoint_path)
                         logging.info("fit: resumed from %s at step %d",
                                      checkpoint_path, self.step)
-                    except Exception as e:
-                        if not is_remote:
-                            raise
+                    except FileNotFoundError:
                         logging.info(
-                            "fit: no restorable checkpoint at %s (%s); "
-                            "starting fresh", checkpoint_path, e)
+                            "fit: no checkpoint at %s; starting fresh",
+                            checkpoint_path)
                 else:
                     logging.info("fit: no checkpoint at %s; starting fresh",
                                  checkpoint_path)
         metrics = None
+        last_saved = -1
         while self.step < steps:
             step = self.step
             metrics = self.run(batch_fn(step))
@@ -259,7 +262,8 @@ class DistributedSession:
                 logging.info("step %d: loss=%s", done, float(metrics["loss"]))
             if saver and save_every and done % save_every == 0:
                 saver.save(checkpoint_path)
-        if saver:
+                last_saved = done
+        if saver and self.step != last_saved and metrics is not None:
             saver.save(checkpoint_path)
         return metrics
 

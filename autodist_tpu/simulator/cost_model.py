@@ -60,11 +60,13 @@ def calibrate(pairs):
     ``pairs``: list of ``(CostEstimate, measured_step_s)``.  Least-squares
     fit of ``measured ~= a*compute_s + b*comm_s + c``; returns the
     calibration dict :meth:`CostEstimate.calibrated_total` consumes.  With
-    fewer than 2 pairs the identity calibration is returned.
+    fewer than 3 pairs (one per coefficient) the system is underdetermined
+    — lstsq's min-norm answer would be arbitrary — so the identity
+    calibration is returned instead.
     """
     import numpy as np
 
-    if len(pairs) < 2:
+    if len(pairs) < 3:
         return {"compute_scale": 1.0, "comm_scale": 1.0, "overhead_s": 0.0}
     A = np.array([[e.compute_s, e.comm_s, 1.0] for e, _ in pairs])
     y = np.array([m for _, m in pairs])

@@ -29,10 +29,22 @@ def default_candidates():
 
 
 class AutoStrategy(StrategyBuilder):
-    def __init__(self, candidates=None, flops_per_example=0.0, batch_per_chip=32):
+    def __init__(self, candidates=None, flops_per_example=0.0,
+                 batch_per_chip=32, calibration=None):
+        """``calibration``: a dict from :func:`simulator.cost_model.calibrate`
+        or a path to a benchmark sweep summary JSON (``examples/benchmark.py
+        --strategies ... --records_dir``) — grounds the analytic ranking in
+        measured step times (the AutoSync loop)."""
         self._candidates = candidates
         self._flops = flops_per_example
         self._batch = batch_per_chip
+        if isinstance(calibration, str):
+            import json
+
+            with open(calibration) as f:
+                data = json.load(f)
+            calibration = data.get("calibration", data)
+        self._calibration = calibration
         self.last_ranking = None
 
     def build(self, model_item, resource_spec) -> Strategy:
@@ -41,7 +53,8 @@ class AutoStrategy(StrategyBuilder):
         cands = self._candidates or default_candidates()
         ranking = rank_strategies(cands, model_item, resource_spec,
                                   flops_per_example=self._flops,
-                                  batch_per_chip=self._batch)
+                                  batch_per_chip=self._batch,
+                                  calibration=self._calibration)
         self.last_ranking = [(name, cost) for cost, name, *_ in ranking]
         cost, name, _builder, _est, strategy = ranking[0]
         logging.info("AutoStrategy picked %s (est %.2fms/step); ranking: %s",

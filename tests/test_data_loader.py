@@ -98,3 +98,28 @@ def test_bad_shard_args(dataset):
     ds, _ = dataset
     with pytest.raises(ValueError):
         BatchLoader(ds, 4, shard_index=3, shard_count=2)
+
+
+def test_device_prefetcher_preserves_order_and_values(dataset):
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.data.loader import DevicePrefetcher
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    ds, data = dataset
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(8),
+                  strategy_builder=AllReduce())
+    sess = ad.distribute(lambda p, b: jnp.mean((b @ p["w"]) ** 2),
+                         {"w": jnp.ones((4,))}, optax.sgd(0.1))
+    host_batches = [data[i * 8:(i + 1) * 8] for i in range(4)]
+    pf = DevicePrefetcher(iter(host_batches), sess, depth=2)
+    got = [np.asarray(b) for b in pf]
+    assert len(got) == 4
+    for h, g in zip(host_batches, got):
+        np.testing.assert_array_equal(h, g)
+    # prefetched batches run through the session directly
+    m = sess.run(sess._shard_batch(host_batches[0]))
+    assert np.isfinite(float(m["loss"]))

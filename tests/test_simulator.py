@@ -88,3 +88,23 @@ def test_calibration_degenerate():
 
     cal = calibrate([])
     assert cal == {"compute_scale": 1.0, "comm_scale": 1.0, "overhead_s": 0.0}
+
+
+def test_auto_strategy_with_calibration_file(tmp_path):
+    """AutoStrategy loads a sweep summary JSON and ranks with the
+    measured-grounded coefficients."""
+    import json
+
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    summary = {"calibration": {"compute_scale": 2.0, "comm_scale": 4.0,
+                               "overhead_s": 0.001}}
+    path = tmp_path / "summary.json"
+    path.write_text(json.dumps(summary))
+    item = _item(sparse=True)
+    auto = AutoStrategy(calibration=str(path))
+    s = auto.build(item, SPEC8)
+    assert len(s.node_config) == 2
+    assert auto.last_ranking
+    # calibrated totals include the fixed overhead term
+    assert all(c >= 0.001 for _, c in auto.last_ranking)
