@@ -39,6 +39,7 @@ GPT_TINY = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
 
 class CausalSelfAttention(nn.Module):
     config: GPTConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic):
@@ -53,7 +54,39 @@ class CausalSelfAttention(nn.Module):
         shape = (B, S, c.num_heads, head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
         seq_axis = current_seq_axis()
-        if seq_axis is not None:
+        if self.decode:
+            # autoregressive KV cache (flax "cache" collection): x is the
+            # single new token (S == 1); attend over all cached positions
+            if seq_axis is not None:
+                raise NotImplementedError("decode under sequence parallelism")
+            if S != 1:
+                raise ValueError(f"decode expects one token per call, got {S}")
+            # flax init runs this code too: only touch the cache when it
+            # already exists, so init leaves counters at zero
+            cache_initialized = self.has_variable("cache", "k")
+            k_cache = self.variable("cache", "k", jnp.zeros,
+                                    (B, c.max_position, c.num_heads, head_dim),
+                                    c.dtype)
+            v_cache = self.variable("cache", "v", jnp.zeros,
+                                    (B, c.max_position, c.num_heads, head_dim),
+                                    c.dtype)
+            idx = self.variable("cache", "idx",
+                                lambda: jnp.zeros((), jnp.int32))
+            if cache_initialized:
+                t = idx.value
+                k_cache.value = jax.lax.dynamic_update_slice_in_dim(
+                    k_cache.value, k.astype(c.dtype), t, axis=1)
+                v_cache.value = jax.lax.dynamic_update_slice_in_dim(
+                    v_cache.value, v.astype(c.dtype), t, axis=1)
+                idx.value = t + 1
+                visible = (jnp.arange(c.max_position) <= t)
+                bias = jnp.where(visible, 0.0,
+                                 -1e9)[None, None, None].astype(c.dtype)
+                y = jax.nn.dot_product_attention(
+                    q, k_cache.value, v_cache.value, bias=bias)
+            else:  # init trace: shape-correct single-token attention
+                y = jax.nn.dot_product_attention(q, k, v)
+        elif seq_axis is not None:
             # causal masking over GLOBAL positions while K/V blocks stream
             # around the seq ring
             y = ring_attention(q, k, v, seq_axis, causal=True)
@@ -68,12 +101,14 @@ class CausalSelfAttention(nn.Module):
 
 class GPTBlock(nn.Module):
     config: GPTConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic):
         c = self.config
         y = nn.LayerNorm(dtype=c.dtype, name="ln_1")(x)
-        y = CausalSelfAttention(c, name="attn")(y, deterministic)
+        y = CausalSelfAttention(c, decode=self.decode, name="attn")(
+            y, deterministic)
         y = nn.Dropout(c.dropout_rate)(y, deterministic=deterministic)
         x = x + y
         y = nn.LayerNorm(dtype=c.dtype, name="ln_2")(x)
@@ -85,9 +120,12 @@ class GPTBlock(nn.Module):
 
 
 class GPT(nn.Module):
-    """Returns next-token logits (B, S, V)."""
+    """Returns next-token logits (B, S, V).  ``decode=True`` switches to
+    single-token autoregressive mode with per-layer KV caches (flax
+    "cache" collection) — see :func:`generate`."""
 
     config: GPTConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, deterministic=True):
@@ -101,14 +139,81 @@ class GPT(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.02),
                          (c.max_position, c.hidden_size), jnp.float32)
         x = embedding_lookup(wte, tokens, sync=False)
-        pos0 = global_position_offset(S)  # seq-parallel: global block start
-        x = x + jax.lax.dynamic_slice_in_dim(wpe, pos0, S)[None]
+        if self.decode:
+            # current decode position = the attention caches' write index
+            cache_initialized = self.has_variable("cache", "pos")
+            t = self.variable("cache", "pos",
+                              lambda: jnp.zeros((), jnp.int32))
+            x = x + jax.lax.dynamic_slice_in_dim(wpe, t.value, 1)[None]
+            if cache_initialized:
+                t.value = t.value + 1
+        else:
+            pos0 = global_position_offset(S)  # seq-parallel: block start
+            x = x + jax.lax.dynamic_slice_in_dim(wpe, pos0, S)[None]
         x = nn.Dropout(c.dropout_rate)(x.astype(c.dtype),
                                        deterministic=deterministic)
         for i in range(c.num_layers):
-            x = GPTBlock(c, name=f"h_{i}")(x, deterministic)
+            x = GPTBlock(c, decode=self.decode, name=f"h_{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
         return x.astype(jnp.float32) @ wte.T
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _make_rollout(config, B, P, total, temperature):
+    """Jitted decode loop, cached per static shape/config so repeated
+    generate() calls reuse the compiled program instead of re-tracing the
+    whole scan."""
+    model = GPT(config, decode=True)
+
+    @jax.jit
+    def rollout(params, cache, prompt, rng):
+        buf = jnp.zeros((B, total), jnp.int32)
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, prompt, 0, axis=1)
+
+        def step(carry, t):
+            buf, cache, rng = carry
+            tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
+            logits, mut = model.apply({"params": params, "cache": cache},
+                                      tok, mutable=["cache"])
+            logits = logits[:, 0]
+            rng, sub = jax.random.split(rng)
+            if temperature > 0:
+                nxt = jax.random.categorical(sub, logits / temperature)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            # only write past the prompt (prompt tokens stay authoritative)
+            write = jnp.where(t + 1 < P,
+                              jax.lax.dynamic_slice_in_dim(buf, jnp.minimum(t + 1, total - 1), 1, axis=1)[:, 0],
+                              nxt.astype(jnp.int32))
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, write[:, None], jnp.minimum(t + 1, total - 1), axis=1)
+            return (buf, mut["cache"], rng), None
+
+        (buf, cache, rng), _ = jax.lax.scan(
+            step, (buf, cache, rng), jnp.arange(total - 1))
+        return buf
+
+    return rollout
+
+
+def generate(config, params, prompt, max_new_tokens, temperature=0.0,
+             rng=None):
+    """Autoregressive generation with per-layer KV caches (one forward per
+    token, O(T) total instead of O(T^2)).  ``prompt``: (B, P) int32;
+    returns (B, P + max_new_tokens).  ``temperature=0`` is greedy."""
+    model = GPT(config, decode=True)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    if total > config.max_position:
+        raise ValueError(f"{total} tokens exceed max_position={config.max_position}")
+    cache = model.init(jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32))["cache"]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rollout = _make_rollout(config, B, P, total, float(temperature))
+    return rollout(params, cache, prompt, rng)
 
 
 def gpt_loss(logits, targets, mask=None):

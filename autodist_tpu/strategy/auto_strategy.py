@@ -41,9 +41,16 @@ class AutoStrategy(StrategyBuilder):
         if isinstance(calibration, str):
             import json
 
-            with open(calibration) as f:
+            path = calibration
+            with open(path) as f:
                 data = json.load(f)
             calibration = data.get("calibration", data)
+            missing = {"compute_scale", "comm_scale"} - set(calibration)
+            if missing:
+                raise ValueError(
+                    f"{path} is not a calibration (missing {sorted(missing)}); "
+                    f"expected a benchmark sweep summary or a "
+                    f"cost_model.calibrate() dict")
         self._calibration = calibration
         self.last_ranking = None
 
