@@ -52,24 +52,22 @@ def _load_native():
                 subprocess.run(["make", "-C", _NATIVE_DIR, "clean"],
                                check=True, capture_output=True)
                 build()
-                import glob
                 import shutil
                 import tempfile
 
-                # sweep copies leaked by earlier runs (they can't be
-                # removed while loaded, so clean on the NEXT run)
-                for old in glob.glob(os.path.join(tempfile.gettempdir(),
-                                                  "autodist_io_*.so")):
-                    try:
-                        os.unlink(old)
-                    except OSError:
-                        pass
                 fd, tmp_path = tempfile.mkstemp(prefix="autodist_io_",
                                                 suffix=".so")
                 os.close(fd)
                 shutil.copyfile(_SO_PATH, tmp_path)
                 lib = ctypes.CDLL(tmp_path)
                 lib.adio_loader_new_sharded  # must resolve now
+                try:
+                    # the mapped inode persists after unlink (Linux), so the
+                    # temp copy never leaks and no cross-process sweep is
+                    # needed
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
         except Exception as e:
             logging.warning("native IO unavailable (%s); using numpy fallback", e)
             _lib = False

@@ -162,17 +162,32 @@ import functools
 
 
 @functools.lru_cache(maxsize=32)
-def _make_rollout(config, B, P, total, temperature):
-    """Jitted decode loop, cached per static shape/config so repeated
-    generate() calls reuse the compiled program instead of re-tracing the
-    whole scan."""
+def _fresh_cache_shapes(config, B):
+    """Zero KV-cache template per (config, batch) WITHOUT materializing (and
+    discarding) a full random parameter init: eval_shape gives the cache
+    structure abstractly."""
+    model = GPT(config, decode=True)
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                            jnp.zeros((B, 1), jnp.int32))["cache"]
+    return jax.tree.map(lambda s: (tuple(s.shape), s.dtype), shapes,
+                        is_leaf=lambda s: hasattr(s, "shape"))
+
+
+def _fresh_cache(config, B):
+    return jax.tree.map(lambda sd: jnp.zeros(*sd),
+                        _fresh_cache_shapes(config, B),
+                        is_leaf=lambda x: isinstance(x, tuple))
+
+
+@functools.lru_cache(maxsize=32)
+def _make_rollout(config, B, total, temperature):
+    """Jitted decode loop, cached per (batch, TOTAL length, config); the
+    prompt length is a traced scalar, so variable-length prompts share one
+    executable instead of recompiling the whole scan."""
     model = GPT(config, decode=True)
 
     @jax.jit
-    def rollout(params, cache, prompt, rng):
-        buf = jnp.zeros((B, total), jnp.int32)
-        buf = jax.lax.dynamic_update_slice_in_dim(buf, prompt, 0, axis=1)
-
+    def rollout(params, cache, buf0, prompt_len, rng):
         def step(carry, t):
             buf, cache, rng = carry
             tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
@@ -185,15 +200,17 @@ def _make_rollout(config, B, P, total, temperature):
             else:
                 nxt = jnp.argmax(logits, axis=-1)
             # only write past the prompt (prompt tokens stay authoritative)
-            write = jnp.where(t + 1 < P,
-                              jax.lax.dynamic_slice_in_dim(buf, jnp.minimum(t + 1, total - 1), 1, axis=1)[:, 0],
-                              nxt.astype(jnp.int32))
+            write_at = jnp.minimum(t + 1, total - 1)
+            write = jnp.where(
+                t + 1 < prompt_len,
+                jax.lax.dynamic_slice_in_dim(buf, write_at, 1, axis=1)[:, 0],
+                nxt.astype(jnp.int32))
             buf = jax.lax.dynamic_update_slice_in_dim(
-                buf, write[:, None], jnp.minimum(t + 1, total - 1), axis=1)
+                buf, write[:, None], write_at, axis=1)
             return (buf, mut["cache"], rng), None
 
         (buf, cache, rng), _ = jax.lax.scan(
-            step, (buf, cache, rng), jnp.arange(total - 1))
+            step, (buf0, cache, rng), jnp.arange(total - 1))
         return buf
 
     return rollout
@@ -204,16 +221,19 @@ def generate(config, params, prompt, max_new_tokens, temperature=0.0,
     """Autoregressive generation with per-layer KV caches (one forward per
     token, O(T) total instead of O(T^2)).  ``prompt``: (B, P) int32;
     returns (B, P + max_new_tokens).  ``temperature=0`` is greedy."""
-    model = GPT(config, decode=True)
-    prompt = jnp.asarray(prompt, jnp.int32)
+    import numpy as np
+
+    prompt = np.asarray(prompt, np.int32)
     B, P = prompt.shape
     total = P + max_new_tokens
     if total > config.max_position:
         raise ValueError(f"{total} tokens exceed max_position={config.max_position}")
-    cache = model.init(jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32))["cache"]
+    buf0 = np.zeros((B, total), np.int32)
+    buf0[:, :P] = prompt
+    cache = _fresh_cache(config, B)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    rollout = _make_rollout(config, B, P, total, float(temperature))
-    return rollout(params, cache, prompt, rng)
+    rollout = _make_rollout(config, B, total, float(temperature))
+    return rollout(params, cache, jnp.asarray(buf0), jnp.int32(P), rng)
 
 
 def gpt_loss(logits, targets, mask=None):

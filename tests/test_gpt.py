@@ -122,3 +122,18 @@ def test_generate_sampled_shapes_and_budget():
 
     with pytest.raises(ValueError, match="max_position"):
         generate(CFG, params, prompt, CFG.max_position)
+
+
+def test_generate_shares_executable_across_prompt_lengths():
+    """Prompt length is a traced scalar: same (B, total) means one compiled
+    rollout regardless of P."""
+    from autodist_tpu.models.gpt import _make_rollout, generate
+
+    model = GPT(CFG)
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    _make_rollout.cache_clear()
+    a = generate(CFG, params, np.zeros((1, 2), np.int32), 3)  # total 5
+    b = generate(CFG, params, np.zeros((1, 3), np.int32), 2)  # total 5
+    assert a.shape == b.shape == (1, 5)
+    assert _make_rollout.cache_info().currsize == 1
