@@ -1,0 +1,22 @@
+#!/bin/sh
+# On-chip validation checklist — run when TPU hardware is reachable
+# (STATUS.md "Next round" items 1-3).  Artifacts land in ./onchip_results/.
+set -x
+mkdir -p onchip_results
+
+# 1. North-star bench (driver metric) + profiler trace
+BENCH_TRACE=onchip_results/trace python bench.py | tee onchip_results/bench.json
+
+# 2. BERT-base per-strategy sweep + cost-model ranking validation
+python examples/benchmark.py --model bert_base \
+    --strategies "AllReduce,PS,PartitionedPS,Parallax" \
+    --records_dir onchip_results/records --batch_per_chip 32 --steps 20 \
+    | tee onchip_results/bert_sweep.log
+
+# 3. Pallas int8 kernels vs the jnp path on real hardware
+JAX_PLATFORMS='' python -m pytest tests/test_pallas_quantize.py -v \
+    | tee onchip_results/pallas.log
+
+# 4. GPT throughput (long-context flagship)
+python examples/benchmark.py --model gpt_small --batch_per_chip 16 \
+    --seq_len 512 --steps 10 | tee onchip_results/gpt.log
