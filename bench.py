@@ -119,7 +119,7 @@ def main():
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
                 env=env, capture_output=True, text=True,
-                timeout=int(os.environ.get("BENCH_TIMEOUT", "1800")))
+                timeout=int(os.environ.get("BENCH_TIMEOUT", "900")))
         except subprocess.TimeoutExpired:
             proc = None
             last_err = f"attempt {attempt + 1}: timed out"
