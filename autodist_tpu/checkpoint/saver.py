@@ -46,6 +46,31 @@ class Saver:
     def _comp_sidecar(path):
         return path + ".comp"
 
+    @staticmethod
+    def _norm(path):
+        """Absolute path for local stores; remote URLs (gs:// etc.) pass
+        through untouched — abspath would mangle them into ./gs:/..."""
+        return path if "://" in path else os.path.abspath(path)
+
+    @staticmethod
+    def exists(path):
+        """Whether a checkpoint exists at ``path`` — local or remote store —
+        WITHOUT attempting a restore.  ``fit()`` decides "start fresh" from
+        this, not from the restore's exception type: remote stores
+        (``gs://`` etc.) raise backend-specific errors, not
+        ``FileNotFoundError``, for an absent path, while a genuine store
+        error during restore must stay loud."""
+        try:
+            from etils import epath  # orbax dependency; handles gs:// etc.
+
+            return epath.Path(path).exists()
+        except ImportError:
+            if "://" in path:
+                raise ValueError(
+                    f"Cannot probe remote checkpoint path {path!r}: etils "
+                    f"is unavailable") from None
+            return os.path.exists(os.path.abspath(path))
+
     def _stateful_comp(self, comp):
         """Buckets with actual state (EF residuals, PowerSGD factors);
         stateless buckets carry () and need no persistence."""
@@ -59,7 +84,7 @@ class Saver:
         ``<path>.comp`` sidecar so the MAIN checkpoint keeps the exact
         single-device structure (``restore_single_device`` contract).
         """
-        path = os.path.abspath(path)
+        path = self._norm(path)
         canonical = jax.device_get(self._canonical_state())
         self._ckptr.save(path, canonical, force=True)
         sidecar = self._comp_sidecar(path)
@@ -69,15 +94,28 @@ class Saver:
             # sidecar is a single-host convenience — skip it there (the main
             # checkpoint is unaffected) rather than crash on device_get
             comp = self._stateful_comp(jax.device_get(self._sess.state["comp"]))
+        elif self._stateful_comp(self._sess.state["comp"]):
+            logging.warning(
+                "Multi-host save: compressor state (error-feedback "
+                "residuals) is NOT persisted; a resume reinitializes it")
         if comp:
             self._ckptr.save(sidecar, comp, force=True)
-        elif os.path.exists(sidecar):
-            # never leave a stale sidecar from an earlier run at this path:
-            # a later stateful restore would pair new params with old
-            # residuals
-            import shutil
+        elif jax.process_index() == 0 and self.exists(sidecar):
+            # never leave a stale sidecar from an earlier run at this path
+            # (a later stateful restore would pair new params with old
+            # residuals); process 0 only — concurrent rmtree from every
+            # host races against peers mid-save on a shared filesystem
+            try:
+                if "://" in sidecar:
+                    from etils import epath
 
-            shutil.rmtree(sidecar, ignore_errors=True)
+                    epath.Path(sidecar).rmtree()
+                else:
+                    import shutil
+
+                    shutil.rmtree(sidecar, ignore_errors=True)
+            except Exception:
+                logging.warning("Could not remove stale sidecar %s", sidecar)
         logging.info("Saved checkpoint to %s (step %d)", path, int(canonical["step"]))
         return path
 
@@ -91,7 +129,7 @@ class Saver:
         """
         sess = self._sess
         t = sess._t
-        path = os.path.abspath(path)
+        path = self._norm(path)
         template = jax.device_get(self._canonical_state())
         restored = self._ckptr.restore(path, item=template)
 
@@ -99,7 +137,7 @@ class Saver:
         comp = fresh
         sidecar = self._comp_sidecar(path)
         fresh_stateful = self._stateful_comp(jax.device_get(fresh))
-        if os.path.exists(sidecar) and fresh_stateful:
+        if fresh_stateful and self.exists(sidecar):
             try:
                 saved = self._ckptr.restore(sidecar, item=fresh_stateful)
             except Exception:  # different bucket structure on disk
@@ -144,7 +182,7 @@ class Saver:
         no autodist_tpu involvement (the reference's key contract).  Pass
         ``item`` (e.g. ``{"params": ..., "opt_state": optax_opt.init(...)}``)
         to restore into typed containers such as optax namedtuples."""
-        return ocp.PyTreeCheckpointer().restore(os.path.abspath(path), item=item)
+        return ocp.PyTreeCheckpointer().restore(Saver._norm(path), item=item)
 
 
 class SavedModelBuilder:

@@ -201,12 +201,22 @@ class AsyncPSSession:
         for t in threads:
             t.join(max(0.0, timeout - (time.time() - t0)))
         stop.set()
+        # workers poll `stop` at the barrier/step boundary: re-join briefly
+        # so they observe it and quiesce BEFORE any exception propagates —
+        # otherwise the caller handles TimeoutError while threads keep
+        # mutating self._params/history underneath it.  One shared 5 s
+        # deadline (not 5 s per thread — W wedged workers must not stack
+        # W x 5 s on top of the user's timeout).
+        grace_end = time.time() + 5.0
+        for t in threads:
+            t.join(max(0.0, grace_end - time.time()))
         if errors:
             raise errors[0][1]
         alive = [t for t in threads if t.is_alive()]
         if alive:
             raise TimeoutError(f"{len(alive)} async workers still running "
-                               f"after {timeout}s")
+                               f"after {timeout}s (stop flag set; they quiesce "
+                               f"at the next step boundary)")
         logging.info("AsyncPS run done: version=%d, max_lead=%d, stale_pushes=%d",
                      self.version, self.barrier.max_lead_seen, self.stale_pushes)
         return self.params

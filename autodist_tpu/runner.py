@@ -132,7 +132,7 @@ class DistributedSession:
         if (all_b < 0).any():
             # some host's batch is ineligible (mask already present / mixed
             # leading dims): every host skips so structures stay consistent
-            return batch
+            return batch, 0
         spec = tuple(self._batch_spec)
         n0_local = self._spec_dim_size(spec[0]) // jax.process_count()
         # per-device rows must also divide into accum_steps microbatches
@@ -141,7 +141,7 @@ class DistributedSession:
         k = -(-k // A) * A
         target = k * n0_local
         if int(all_b.min()) == int(all_b.max()) and target == B:
-            return batch
+            return batch, 0
         pad = target - B
         if pad < 0:
             raise ValueError(f"local batch {B} exceeds computed target {target}")
@@ -151,14 +151,17 @@ class DistributedSession:
                 "Uneven multi-host feed (local %d, host sizes %s): padding "
                 "to %d rows + '%s' mask per host.", B, all_b.tolist(),
                 target, BATCH_MASK_KEY)
-        return self._pad_to(batch, B, target)
+        return self._pad_to(batch, B, target), pad
 
-    def _shard_batch(self, batch):
+    def _shard_batch(self, batch, _prepadded=False):
         spec = tuple(self._batch_spec)
-        if self._batch_mask and not self._multi_host:
-            batch, _ = self._pad_uneven(batch)
-        elif self._batch_mask and self._multi_host:
-            batch = self._pad_uneven_multihost(batch)
+        if self._batch_mask and not _prepadded:
+            # (_prepadded: predict() already padded — skip, in particular
+            # the multi-host path's cross-host allgather barrier)
+            if self._multi_host:
+                batch, _ = self._pad_uneven_multihost(batch)
+            else:
+                batch, _ = self._pad_uneven(batch)
 
         def put(x):
             x = np.asarray(x) if not isinstance(x, jax.Array) else x
@@ -235,20 +238,15 @@ class DistributedSession:
 
             saver = Saver(self)
             if resume:
-                # remote stores (gs:// etc.) aren't visible to os.path —
-                # attempt the restore; ONLY a missing checkpoint means
-                # "start fresh" (a transient store error must fail loudly,
-                # not silently restart at step 0 and overwrite progress)
-                is_remote = "://" in checkpoint_path
-                if is_remote or os.path.exists(checkpoint_path):
-                    try:
-                        saver.restore(checkpoint_path)
-                        logging.info("fit: resumed from %s at step %d",
-                                     checkpoint_path, self.step)
-                    except FileNotFoundError:
-                        logging.info(
-                            "fit: no checkpoint at %s; starting fresh",
-                            checkpoint_path)
+                # "start fresh" is decided by an existence PROBE, not by
+                # the restore's exception type: remote stores raise
+                # backend-specific errors (not FileNotFoundError) for an
+                # absent path, and a genuine store error during restore
+                # must fail loudly, not silently restart at step 0
+                if Saver.exists(checkpoint_path):
+                    saver.restore(checkpoint_path)
+                    logging.info("fit: resumed from %s at step %d",
+                                 checkpoint_path, self.step)
                 else:
                     logging.info("fit: no checkpoint at %s; starting fresh",
                                  checkpoint_path)
@@ -311,25 +309,34 @@ class DistributedSession:
 
             self._eval_cache[key] = (apply_fn, jax.jit(eval_step))
         # padding gates on the same opt-in as training: a batch-reduced
-        # apply_fn (e.g. a mean metric) would silently include pad rows
+        # apply_fn (e.g. a mean metric) would silently include pad rows.
+        # Pad BEFORE _shard_batch on both paths so the local pad count is
+        # known and per-example outputs can be trimmed symmetrically
+        # (multi-host trims its host-local slice after fetch contraction).
         pad = 0
-        if self._batch_mask and not self._multi_host:
-            batch, pad = self._pad_uneven(batch)
-        out = self._eval_cache[key][1](self.state["params"], self.state["mutable"],
-                                       self._shard_batch(batch))
-        if pad:
-            padded_b = np.shape(batch[BATCH_MASK_KEY])[0]
-            out = jax.tree.map(
-                lambda x: x[:padded_b - pad]
-                if np.ndim(x) >= 1 and np.shape(x)[0] == padded_b else x, out)
+        if self._batch_mask:
+            if self._multi_host:
+                batch, pad = self._pad_uneven_multihost(batch)
+            else:
+                batch, pad = self._pad_uneven(batch)
+        out = self._eval_cache[key][1](
+            self.state["params"], self.state["mutable"],
+            self._shard_batch(batch, _prepadded=self._batch_mask))
         if self._multi_host:
             from jax.experimental import multihost_utils
 
             spec = tuple(self._batch_spec)
             out_specs = jax.tree.map(lambda x: P(*spec[:x.ndim]), out)
-            return multihost_utils.global_array_to_host_local_array(
+            out = multihost_utils.global_array_to_host_local_array(
                 out, self._mesh, out_specs)
-        return jax.device_get(out)
+        else:
+            out = jax.device_get(out)
+        if pad:
+            padded_b = np.shape(batch[BATCH_MASK_KEY])[0]
+            out = jax.tree.map(
+                lambda x: x[:padded_b - pad]
+                if np.ndim(x) >= 1 and np.shape(x)[0] == padded_b else x, out)
+        return out
 
     def check_replication(self, atol=0.0):
         """Debug guard: verify all REPLICATED storage really is identical

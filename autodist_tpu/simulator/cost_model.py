@@ -190,10 +190,14 @@ def rank_strategies(builders, model_item, resource_spec, calibration=None, **kw)
 def measure_and_record(session, batch, resource_yaml="", steps=10, warmup=2):
     """Measure a session's step time and produce an AutoSync-style
     :class:`RuntimeRecord` — the reference dataset's (model, resource,
-    strategy, runtime) tuple (``simulator/dataset/README.md``)."""
-    import time
+    strategy, runtime) tuple (``simulator/dataset/README.md``).
 
-    import jax
+    Timing uses :func:`autodist_tpu.utils.timing.measure_per_step`
+    (chain-differenced, one scalar fetch per window) so the number stays
+    honest on async/tunneled backends where ``block_until_ready`` does
+    not actually block.  ``steps`` bounds the total executed step count:
+    the two differenced windows run ~steps/3 and ~2*steps/3 steps."""
+    from autodist_tpu.utils.timing import fetch_scalar, measure_per_step
 
     if steps < 1:
         raise ValueError("steps must be >= 1")
@@ -201,12 +205,15 @@ def measure_and_record(session, batch, resource_yaml="", steps=10, warmup=2):
     for _ in range(warmup):
         last = session.run(batch)
     if last is not None:
-        jax.block_until_ready(last["loss"])  # don't time in-flight warmup
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m = session.run(batch)
-    jax.block_until_ready(m["loss"])
-    dt = (time.perf_counter() - t0) / steps
+        fetch_scalar(last["loss"])  # don't time in-flight warmup
+
+    def run_steps(n):
+        m = None
+        for _ in range(n):
+            m = session.run(batch)
+        return m["loss"]
+
+    dt, _ = measure_per_step(run_steps, k=max(1, steps // 3), repeats=1)
     t = session._t
     return RuntimeRecord(
         model_def=t.model_item.serialize(),

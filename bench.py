@@ -1,51 +1,105 @@
-"""Benchmark: ResNet-50 training throughput per chip (the BASELINE.json
-north-star metric), run on real hardware by the driver.
+"""Benchmark: ResNet-50 training throughput per chip + MFU, run on real
+hardware by the driver.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — always,
-even on failure (an {"error": ...} diagnostic with value 0), and always
-exits 0 so the driver can parse the result.  A transient backend failure is
-retried once in a fresh subprocess.
+Prints ONE JSON line — always — and exits 0, structured so it cannot fail
+silently (VERDICT r2 item 1):
 
-Throughput methodology: the synthetic global batch is sharded onto the
-device(s) ONCE and reused (the reference benchmark harness's synthetic-data
-mode, ``examples/benchmark/imagenet.py``); steps are dispatched back-to-back
-and blocked at the end, so the number measures the compiled SPMD step, not
-host->device transfer of the same bytes every step.  Real input pipelines
-overlap transfers via ``autodist_tpu.data.loader`` double-buffering.
+  1. a ~60 s subprocess PROBE of ``jax.devices()`` first: if backend init
+     hangs or errors, the error JSON is printed immediately;
+  2. the measurement runs in a child with a <=240 s timeout, one retry
+     (half batch only on a narrowly-matched OOM);
+  3. total wall-clock is capped (default 600 s) by the parent, with a
+     watchdog that prints a diagnostic JSON line BEFORE any external
+     deadline it cannot control.
 
-Baseline note: the reference publishes no ResNet-50 single-accelerator
-number; the closest published row is ResNet-101 @1x T4 = ~62 images/sec
-(BASELINE.md, figure1 row 2).  vs_baseline uses that 62 img/s conservatively
-(ResNet-101 is ~1.7x the FLOPs of ResNet-50, so this understates the gap).
+Timing methodology (``autodist_tpu/utils/timing.py``): K dependent steps
+then ONE host scalar fetch, differenced against 2K steps so the constant
+tunnel round-trip cancels.  ``block_until_ready`` is a no-op on tunneled
+TPU backends — the r2 bench "measured" 160k img/s/chip (~10x over the
+chip's peak FLOPs) with the naive recipe; the differenced method measures
+a known 8192^3 bf16 matmul chain at 97% of v5e peak.
+
+Quality bar (VERDICT r2 item 2): **MFU**, not the cross-hardware
+``vs_baseline`` ratio.  MFU = model train FLOPs/image x images/sec/chip /
+chip bf16 peak; ``mfu_pass`` asserts >= 0.35.  ``vs_baseline`` is kept for
+the driver's record schema and is the ratio to the reference's closest
+published number (ResNet-101 @1x T4 = ~62 img/s, BASELINE.md figure1
+row 2 — different hardware; documented as such).
 """
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
-import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-REFERENCE_IMAGES_PER_SEC = 62.0  # ResNet-101 @ 1x T4, docs/usage/figure1.png
 METRIC = "resnet50_train_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
-DEFAULT_BATCH = 256  # per chip; the OOM retry halves this
-_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
-                "OOM", "Allocator")
+DEFAULT_BATCH = 256           # per chip; the OOM retry halves this
+REFERENCE_IMAGES_PER_SEC = 62.0   # ResNet-101 @ 1x T4 (cross-hardware, see above)
+# ResNet-50 @224: fwd ~4.089 GFLOPs/image (standard count, 2 FLOPs per MAC);
+# training ~3x fwd (bwd ~2x).  The MFU numerator.
+TRAIN_FLOPS_PER_IMAGE = 3 * 4.089e9
+MFU_PASS_BAR = 0.35
+# narrow OOM markers only — a bare "Allocator" matches generic XLA error
+# text and would silently halve the headline batch (ADVICE r2)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+_PRINT_LOCK = threading.Lock()
+_PRINTED = False
+
+
+def _emit(rec):
+    """Print the single result line exactly once (watchdog-safe)."""
+    global _PRINTED
+    with _PRINT_LOCK:
+        if _PRINTED:
+            return
+        _PRINTED = True
+        print(json.dumps(rec), flush=True)
+
+
+def _error_rec(cause, detail=""):
+    return {"metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
+            "mfu": 0.0, "error": cause, "detail": str(detail)[:2000]}
+
+
+# ---------------------------------------------------------------- probe --
+
+def _probe():
+    import jax
+
+    ds = jax.devices()
+    print(json.dumps({
+        "probe_ok": True, "backend": jax.default_backend(),
+        "n_devices": len(ds),
+        "device_kind": getattr(ds[0], "device_kind", "?"),
+    }), flush=True)
+
+
+# ---------------------------------------------------------------- child --
+
+def _stage(name):
+    print(f"BENCH_STAGE {name} t={time.perf_counter():.1f}", file=sys.stderr,
+          flush=True)
 
 
 def _bench():
+    _stage("import")
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.models import ResNet50, train_lib
     from autodist_tpu.resource_spec import ResourceSpec
     from autodist_tpu.strategy import AllReduce
-    from autodist_tpu.models import ResNet50
-    from autodist_tpu.models import train_lib
+    from autodist_tpu.utils.timing import (fetch_scalar, measure_per_step,
+                                           peak_flops)
 
+    _stage("init")
     n_chips = jax.device_count()
     batch_per_chip = int(os.environ.get("BENCH_BATCH", str(DEFAULT_BATCH)))
     B = batch_per_chip * n_chips
@@ -65,86 +119,147 @@ def _bench():
     gbatch = sess._shard_batch(batch)
     gbatch["image"] = jnp.asarray(gbatch["image"], jnp.bfloat16)
 
-    for _ in range(5):  # warmup + compile
+    _stage("compile")
+    for _ in range(3):  # warmup + compile
         m = sess.run(gbatch)
-    jax.block_until_ready(m["loss"])
+    fetch_scalar(m["loss"])  # real sync (block_until_ready may be a no-op)
 
-    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    _stage("measure")
+
+    def run_steps(n):
+        mm = None
+        for _ in range(n):
+            mm = sess.run(gbatch)
+        return mm["loss"]
+
     trace_dir = os.environ.get("BENCH_TRACE", "")
-    if trace_dir:  # one traced window for MFU analysis (jax.profiler)
+    if trace_dir:  # one traced window for profile analysis (jax.profiler)
         m = sess.run(gbatch, trace_dir=trace_dir)
-        jax.block_until_ready(m["loss"])
-    best = float("inf")
-    for _ in range(2):  # two timed windows; keep the best (noise guard)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            m = sess.run(gbatch)
-        jax.block_until_ready(m["loss"])
-        best = min(best, time.perf_counter() - t0)
+        fetch_scalar(m["loss"])
+    k = int(os.environ.get("BENCH_STEPS", "15"))
+    per_step, diag = measure_per_step(run_steps, k=k)
 
-    images_per_sec = steps * B / best
+    images_per_sec = B / per_step
     per_chip = images_per_sec / n_chips
-    return {
+    peak, peak_assumed = peak_flops()
+    mfu = TRAIN_FLOPS_PER_IMAGE * per_chip / peak
+    rec = {
         "metric": METRIC,
         "value": round(per_chip, 2),
         "unit": UNIT,
         "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 3),
+        "mfu": round(mfu, 4),
+        "mfu_pass": bool(mfu >= MFU_PASS_BAR),
+        "peak_bf16_tflops": round(peak / 1e12, 1),
+        "peak_assumed": peak_assumed,
         "backend": jax.default_backend(),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
         "n_chips": n_chips,
         "batch_per_chip": batch_per_chip,
-        "step_ms": round(1000 * best / steps, 2),
+        "step_ms": round(1000 * per_step, 2),
+        "timing": {"method": "chain-diff",
+                   "t_k_s": round(diag["t_k_s"], 3),
+                   "t_2k_s": round(diag["t_2k_s"], 3), "k": diag["k"],
+                   "naive_fallback": diag["naive_fallback"]},
     }
+    if mfu > 1.0:
+        # physically impossible => the sync point itself is broken; never
+        # report a >peak number as a win
+        rec["timing_suspect"] = True
+        rec["mfu_pass"] = False
+    return rec
+
+
+# --------------------------------------------------------------- parent --
+
+def _run_child(env_extra, timeout_s):
+    """Run this file in a mode-tagged subprocess.
+
+    Returns ``(rec|None, info, combined_output)`` — the FULL child output
+    comes back separately from the 8-line ``info`` tail because OOM
+    markers often sit above a long allocation breakdown that would push
+    them out of the tail."""
+    env = dict(os.environ, **env_extra)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        stderr = (e.stderr or b"")
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        stages = [ln for ln in stderr.splitlines() if ln.startswith("BENCH_STAGE")]
+        return None, f"timeout after {timeout_s}s (last stage: " + (
+            stages[-1] if stages else "none") + ")", stderr
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and (rec.get("metric") == METRIC
+                                      or rec.get("probe_ok")):
+            return rec, "", ""
+    combined = (proc.stderr or "") + (proc.stdout or "")
+    tail = " | ".join(combined.strip().splitlines()[-8:])
+    return None, f"rc={proc.returncode}: {tail}", combined
 
 
 def main():
+    if os.environ.get("_BENCH_PROBE"):
+        _probe()
+        return
     if os.environ.get("_BENCH_CHILD"):
-        # child mode: run once, print result or traceback, exit accordingly
         try:
             print(json.dumps(_bench()), flush=True)
         except BaseException:
+            import traceback
+
             traceback.print_exc()
             sys.exit(1)
         return
 
-    last_err = None
-    oom_seen = False
-    for attempt in range(2):
-        env = dict(os.environ, _BENCH_CHILD="1")
-        if attempt == 1 and oom_seen and "BENCH_BATCH" not in os.environ:
-            # retry at half batch ONLY for memory pressure; other failures
-            # retry at the standard batch so the headline metric stays
-            # comparable (batch_per_chip is recorded either way)
-            env["BENCH_BATCH"] = str(DEFAULT_BATCH // 2)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True,
-                timeout=int(os.environ.get("BENCH_TIMEOUT", "900")))
-        except subprocess.TimeoutExpired:
-            proc = None
-            last_err = f"attempt {attempt + 1}: timed out"
-        if proc is not None:
-            for line in reversed(proc.stdout.strip().splitlines()):
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(rec, dict) and rec.get("metric") == METRIC:
-                    print(json.dumps(rec))
-                    return
-            combined = (proc.stderr or "") + (proc.stdout or "")
-            oom_seen = any(m in combined for m in _OOM_MARKERS)
-            tail = combined.strip().splitlines()[-8:]
-            last_err = (f"attempt {attempt + 1} rc={proc.returncode}: "
-                        + " | ".join(tail))
-        if attempt == 0:
-            time.sleep(10)  # settle before the single retry
+    budget = int(os.environ.get("BENCH_BUDGET", "600"))
+    t_start = time.monotonic()
+    # watchdog: a parseable line lands BEFORE any external deadline, no
+    # matter what the children do
+    watchdog = threading.Timer(max(30, budget - 20), lambda: (
+        _emit(_error_rec("watchdog_deadline",
+                         f"no result within {budget - 20}s")),
+        os._exit(0)))
+    watchdog.daemon = True
+    watchdog.start()
 
-    # never exit non-zero without a parseable line (VERDICT r1 item 1)
-    print(json.dumps({
-        "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
-        "error": (last_err or "unknown failure")[:2000],
-    }))
+    # 1) backend probe: fail fast + loud when the TPU is unreachable
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
+    rec, info, _ = _run_child({"_BENCH_PROBE": "1"}, probe_timeout)
+    if rec is None:
+        _emit(_error_rec("backend_probe_failed", info))
+        return
+    probe = rec
+
+    # 2) measurement: <=240s per attempt, one retry; half batch only on OOM
+    oom_seen = False
+    last_err = ""
+    for attempt in range(2):
+        remaining = budget - (time.monotonic() - t_start) - 30
+        child_timeout = int(min(240, remaining))
+        if child_timeout < 60:
+            last_err += " | no wall-clock left for another attempt"
+            break
+        env = {"_BENCH_CHILD": "1"}
+        if attempt == 1 and oom_seen and "BENCH_BATCH" not in os.environ:
+            env["BENCH_BATCH"] = str(DEFAULT_BATCH // 2)
+        rec, info, combined = _run_child(env, child_timeout)
+        if rec is not None:
+            rec["probe"] = probe
+            _emit(rec)
+            return
+        oom_seen = oom_seen or any(m in combined for m in _OOM_MARKERS)
+        last_err = f"attempt {attempt + 1}: {info}"
+        time.sleep(5)
+
+    _emit(_error_rec("all_attempts_failed", f"probe={probe} | {last_err}"))
 
 
 if __name__ == "__main__":
