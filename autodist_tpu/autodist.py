@@ -29,6 +29,18 @@ from autodist_tpu.utils import logging
 _DEFAULT_AUTODIST = {}
 
 
+def _strategy_requests_async(proto):
+    """True when any node (or partition shard) carries an async
+    PSSynchronizer (sync=False) — the strategy-level switch into the
+    host-PS async runtime."""
+    for n in proto.node_config:
+        for src in (n, *n.part_config):
+            if (src.WhichOneof("synchronizer") == "PSSynchronizer"
+                    and not src.PSSynchronizer.sync):
+                return True
+    return False
+
+
 def set_default_autodist(o):
     """One AutoDist per process (reference autodist.py:43-57)."""
     if _DEFAULT_AUTODIST and ENV.AUTODIST_IS_TESTING.val is False:
@@ -153,6 +165,29 @@ class AutoDist:
 
         verify_agreement(raw.proto.SerializeToString(), "strategy")
         strategy = StrategyCompiler(item, self._resource_spec).compile(raw)
+        if _strategy_requests_async(strategy.proto):
+            # PS(sync=False, ...) selects TRUE asynchrony through the user
+            # API (reference: staleness/async is a strategy field,
+            # ``proto/synchronizers.proto:25-35``) — an SPMD program is
+            # bulk-synchronous, so this runs the host-PS async runtime
+            # instead of the shard_map engine.  Options only the SPMD
+            # engine implements are REJECTED loudly, never dropped.
+            unsupported = {
+                k: v for k, v in dict(
+                    batch_mask=batch_mask or None, rng=rng,
+                    **{kk: vv for kk, vv in transformer_kwargs.items()
+                       if vv is not None
+                       and not (kk == "accum_steps" and vv == 1)},
+                ).items() if v is not None}
+            if unsupported:
+                raise NotImplementedError(
+                    f"async PS runtime (sync=False) does not support "
+                    f"{sorted(unsupported)}; use the synchronous engine "
+                    f"or drop these options")
+            from autodist_tpu.kernel.synchronization.async_ps import (
+                AsyncPSEngineSession)
+
+            return AsyncPSEngineSession(strategy, item)
         transformer = GraphTransformer(strategy, item, self.mesh,
                                        **transformer_kwargs)
         return DistributedSession(transformer, rng=rng, donate=donate,

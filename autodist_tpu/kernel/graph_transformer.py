@@ -131,15 +131,32 @@ class GraphTransformer:
             self.custom_groups.setdefault(key, ([], frozenset(spec_axes)))
             self.custom_groups[key][0].append(name)
 
-        # fused-PS groups (static): dtype -> ordered names of dense
-        # replicated PS vars whose reduce-scatter/all-gather are merged
+        # PS mesh-axis subsets: a plan's "mesh:<axes>" reduction destination
+        # confines its scatter/gather to those axes (ICI-only on a
+        # dcn x ici mesh); remaining data axes see only the scattered
+        # shards via psum.  Validate against the mesh/data axes up front.
+        for name in self.names:
+            plan = self.plans[name]
+            if plan.sync != part.SyncKind.PS or not plan.ps_axes:
+                continue
+            bad = set(plan.ps_axes) - set(self.data_axes)
+            if bad:
+                raise ValueError(
+                    f"{name!r}: ps_axes {sorted(bad)} are not data axes "
+                    f"{self.data_axes} of the mesh {mesh.axis_names}")
+            if tuple(plan.ps_axes) == tuple(self.data_axes):
+                plan.ps_axes = None  # full set == default realization
+
+        # fused-PS groups (static): (dtype, ps_axes) -> ordered names of
+        # dense replicated PS vars whose reduce-scatter/all-gather merge
         self.ps_groups = {}
         for name in self.names:
             plan = self.plans[name]
             if (plan.sync == part.SyncKind.PS
                     and plan.placement == Placement.REPLICATED
                     and not plan.sparse):
-                self.ps_groups.setdefault(str(np.dtype(plan.dtype)), []).append(name)
+                key = (str(np.dtype(plan.dtype)), plan.ps_axes or ())
+                self.ps_groups.setdefault(key, []).append(name)
         logging.info(
             "Transform plan: %d vars, %d AR buckets, placements=%s",
             len(self.names), len(self.buckets),
@@ -147,18 +164,78 @@ class GraphTransformer:
              for p in Placement},
         )
 
+    def plan_summary(self):
+        """Human-readable transform plan — dump stage 0 of the 4-stage
+        program-evolution artifacts (reference logs its graph after each
+        transform pass, ``kernel/graph_transformer.py:62-90``)."""
+        lines = [f"mesh: {dict(self.mesh.shape)}  data_axes: {self.data_axes}"
+                 f"  batch_spec: {self.batch_spec}",
+                 f"accum_steps: {self.accum_steps}  "
+                 f"clip_global_norm: {self.clip_global_norm}",
+                 f"AR buckets: {len(self.buckets)}  "
+                 f"fused PS groups: {len(self.ps_groups)}  "
+                 f"custom groups: {len(self.custom_groups)}", ""]
+        for name in self.names:
+            p = self.plans[name]
+            extra = ""
+            if p.placement == Placement.SHARDED:
+                extra = f" axis={p.partition_axis} padded={p.padded_dim}"
+            if p.sync == part.SyncKind.PS and p.ps_axes:
+                extra += f" ps_axes={p.ps_axes}"
+            if p.staleness:
+                extra += f" staleness={p.staleness}"
+            lines.append(f"{name}: shape={tuple(p.shape)} "
+                         f"{p.placement.value}/{p.sync.value}"
+                         f"{' sparse' if p.sparse else ''}{extra}")
+        return "\n".join(lines) + "\n"
+
+    # -- per-plan PS axis helpers -----------------------------------------
+
+    def _ps_axis(self, plan):
+        """Axis name (or tuple) the plan's PS scatter/gather runs over."""
+        if plan.ps_axes:
+            axes = tuple(a for a in self.data_axes if a in plan.ps_axes)
+            return axes if len(axes) > 1 else axes[0]
+        return self.axis
+
+    def _ps_other_axes(self, plan):
+        """Data axes OUTSIDE the plan's PS subset (the shard-psum axes)."""
+        if not plan.ps_axes:
+            return ()
+        return tuple(a for a in self.data_axes if a not in plan.ps_axes)
+
+    def _R_for(self, plan):
+        """Device count the plan's (flat-shard) PS update space shards
+        over; every other placement shards over the full data axes."""
+        if (plan.sync == part.SyncKind.PS and plan.ps_axes
+                and plan.placement == Placement.REPLICATED):
+            return int(np.prod([self.mesh.shape[a] for a in plan.ps_axes]))
+        return self.num_replicas
+
     # -- spec trees --------------------------------------------------------
 
     def _params_spec_leaves(self, space):
-        fn = part.storage_spec if space == "storage" else part.update_space_spec
-        return [fn(self.plans[n], self.axis) for n in self.names]
+        if space == "storage":
+            return [part.storage_spec(self.plans[n], self.axis)
+                    for n in self.names]
+        def axis_for(plan):
+            # only the flat-shard PS update space moves to the subset axis;
+            # SHARDED/DIVERGENT storage stays on the full data axes
+            if (plan.sync == part.SyncKind.PS
+                    and plan.placement == Placement.REPLICATED):
+                return self._ps_axis(plan)
+            return self.axis
+
+        return [part.update_space_spec(self.plans[n], axis_for(self.plans[n]))
+                for n in self.names]
 
     def params_spec_tree(self, space="storage"):
         return self.treedef.unflatten(self._params_spec_leaves(space))
 
     def _opt_spec_tree(self, opt_state_shapes):
         specs = self._params_spec_leaves("update")
-        shapes = [part.update_space_shape(self.plans[n], self.num_replicas)
+        shapes = [part.update_space_shape(self.plans[n],
+                                          self._R_for(self.plans[n]))
                   for n in self.names]
         boxed = self.treedef.unflatten(
             [_SpecBox(s, shp) for s, shp in zip(specs, shapes)]
@@ -216,8 +293,9 @@ class GraphTransformer:
             if plan.placement in (Placement.SHARDED, Placement.DIVERGENT):
                 return to_storage(leaf, plan)
             if plan.sync == SyncKind.PS:
+                r = self._R_for(plan)
                 n = leaf.size
-                npad = -(-n // R) * R
+                npad = -(-n // r) * r
                 return jnp.zeros((npad,), leaf.dtype).at[:n].set(leaf.ravel())
             return leaf
 
@@ -417,28 +495,40 @@ class GraphTransformer:
                     for k, v in comp_new_local.items()}
 
         # 4a. fused reduce-scatter for the dense PS family: every PS var's
-        # flat padding reshapes to (R, shard); concatenating along dim 1
-        # lets ONE psum_scatter per dtype deliver every device exactly its
-        # row — its shard of every variable — instead of a collective per
-        # variable (hundreds, for transformer-sized models).
+        # flat padding reshapes to (R_ps, shard); concatenating along dim 1
+        # lets ONE psum_scatter per (dtype, ps_axes) group deliver every
+        # device exactly its row — its shard of every variable — instead of
+        # a collective per variable (hundreds, for transformer-sized
+        # models).  With a mesh-axis SUBSET (e.g. ici of a dcn x ici mesh)
+        # the scatter stays inside the subset and only the 1/R_ps-sized
+        # shards cross the remaining axes via psum — DCN sees shard-sized
+        # traffic, never full gradients (the reference shapes this with
+        # load-balanced PS placement, ``ps_synchronizer.py:635-656``).
         def _ps_shard_len(plan):
+            r = self._R_for(plan)
             n = int(np.prod(plan.shape)) if plan.shape else 1
-            return (-(-n // R) * R) // R
+            return (-(-n // r) * r) // r
 
         ps_fused = self.ps_groups
         ps_grad_shards = {}
-        for dtype, names_d in ps_fused.items():
+        for (dtype, _axes_key), names_d in ps_fused.items():
+            plan0 = self.plans[names_d[0]]
+            ps_axis = self._ps_axis(plan0)
+            other = self._ps_other_axes(plan0)
+            r_ps = self._R_for(plan0)
             mats = []
             for name in names_d:
                 plan = self.plans[name]
                 g = g_by_name[name]
                 ss = _ps_shard_len(plan)
-                flatg = jnp.zeros((ss * R,), g.dtype).at[:g.size].set(g.ravel())
-                mats.append(flatg.reshape(R, ss))
+                flatg = jnp.zeros((ss * r_ps,), g.dtype).at[:g.size].set(g.ravel())
+                mats.append(flatg.reshape(r_ps, ss))
             bucket = jnp.concatenate(mats, axis=1) if len(mats) > 1 else mats[0]
-            red = jax.lax.psum_scatter(bucket, axis, scatter_dimension=0,
-                                       tiled=True) / R        # (1, S) -> (S,)
-            red = red.reshape(-1)
+            red = jax.lax.psum_scatter(bucket, ps_axis, scatter_dimension=0,
+                                       tiled=True)            # (1, S) -> (S,)
+            if other:  # cross-slice sum of the already-scattered shards
+                red = jax.lax.psum(red, other)
+            red = red.reshape(-1) / R
             off = 0
             for name in names_d:
                 ss = _ps_shard_len(self.plans[name])
@@ -499,14 +589,18 @@ class GraphTransformer:
                 u_params.append(s_leaf)
                 u_grads.append(g[None])
             elif plan.sync == SyncKind.PS:
+                r_ps = self._R_for(plan)
+                my_ps = my if r_ps == R else axis_index(self._ps_axis(plan))
                 n = int(np.prod(plan.shape)) if plan.shape else 1
                 ss = _ps_shard_len(plan)
-                npad = ss * R
+                npad = ss * r_ps
                 flatp = jnp.zeros((npad,), s_leaf.dtype).at[:n].set(s_leaf.ravel())
-                u_params.append(jax.lax.dynamic_slice_in_dim(flatp, my * ss, ss))
+                u_params.append(jax.lax.dynamic_slice_in_dim(flatp, my_ps * ss, ss))
                 if plan.sparse:
+                    # sparse grads arrive pre-synced (full-mesh mean), so
+                    # the subset shard is identical across the other axes
                     flatg = jnp.zeros((npad,), g.dtype).at[:n].set(g.ravel())
-                    ug = jax.lax.dynamic_slice_in_dim(flatg, my * ss, ss)
+                    ug = jax.lax.dynamic_slice_in_dim(flatg, my_ps * ss, ss)
                 else:
                     ug = ps_grad_shards[name]
                 u_grads.append(ug)
@@ -541,7 +635,11 @@ class GraphTransformer:
                     sq_sharded = sq_sharded + s / R
                 elif (plan.placement == Placement.SHARDED
                         or plan.sync == SyncKind.PS):
-                    sq_sharded = sq_sharded + s  # disjoint shards: sum = true
+                    # disjoint shards: full-axis psum = true sum.  A
+                    # subset-axis PS shard is replicated over the other
+                    # data axes, so pre-divide by that multiplicity.
+                    mult = R // self._R_for(plan)
+                    sq_sharded = sq_sharded + (s / mult if mult > 1 else s)
                 else:
                     sq = sq + s
             total = sq + jax.lax.psum(sq_sharded, axis)
@@ -563,15 +661,21 @@ class GraphTransformer:
         new_u_leaves = self.treedef.flatten_up_to(new_u)
 
         # 6a. fused all-gather of updated PS shards (mirror of 4a): one
-        # all_gather per dtype rebuilds every PS variable's full value.
+        # all_gather per (dtype, ps_axes) group rebuilds every PS
+        # variable's full value — over the subset axis only; shards are
+        # identical across the other axes (same grads -> same update), so
+        # no cross-slice gather is needed at all.
         new_by_name = dict(zip(self.names, new_u_leaves))
         ps_full = {}
-        for dtype, names_d in ps_fused.items():
+        for (dtype, _axes_key), names_d in ps_fused.items():
+            plan0 = self.plans[names_d[0]]
+            ps_axis = self._ps_axis(plan0)
+            r_ps = self._R_for(plan0)
             cat = (jnp.concatenate([new_by_name[n] for n in names_d])
                    if len(names_d) > 1 else new_by_name[names_d[0]])
             S = cat.shape[0]
-            gathered = jax.lax.all_gather(cat, axis, axis=0, tiled=True)
-            gathered = gathered.reshape(R, S)
+            gathered = jax.lax.all_gather(cat, ps_axis, axis=0, tiled=True)
+            gathered = gathered.reshape(r_ps, S)
             off = 0
             for name in names_d:
                 plan = self.plans[name]
@@ -602,7 +706,8 @@ class GraphTransformer:
                     new_storage.append(ps_full[name])
                 else:  # sparse PS var: gather its own shard ring
                     n = int(np.prod(plan.shape)) if plan.shape else 1
-                    flat = jax.lax.all_gather(nu, axis, axis=0, tiled=True)
+                    flat = jax.lax.all_gather(nu, self._ps_axis(plan),
+                                              axis=0, tiled=True)
                     new_storage.append(jnp.reshape(flat[:n], plan.shape))
             else:
                 new_storage.append(nu)
@@ -636,7 +741,7 @@ class GraphTransformer:
         """update-space array -> original param shape (global arrays).
         Leaves that are not update-space-shaped (e.g. a per-param scalar
         statistic) pass through unchanged."""
-        if tuple(leaf.shape) != part.update_space_shape(plan, self.num_replicas):
+        if tuple(leaf.shape) != part.update_space_shape(plan, self._R_for(plan)):
             return leaf
         if plan.placement == Placement.SHARDED:
             dim = plan.shape[plan.partition_axis]
@@ -666,8 +771,9 @@ class GraphTransformer:
         if plan.placement == Placement.DIVERGENT:
             return jnp.broadcast_to(leaf[None], (R,) + leaf.shape)
         if plan.sync == SyncKind.PS:
+            r = self._R_for(plan)
             n = leaf.size
-            npad = -(-n // R) * R
+            npad = -(-n // r) * r
             return jnp.zeros((npad,), leaf.dtype).at[:n].set(leaf.ravel())
         return leaf
 

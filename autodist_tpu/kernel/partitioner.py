@@ -77,6 +77,13 @@ class VarPlan:
     # to the identical program (documented in docs/usage.md)
     local_replication: bool = False
     reduction_destination: str = ""
+    # TPU-native reading of reduction_destination: "mesh:<axis>[,<axis>]"
+    # confines the PS family's reduce-scatter/all-gather to that mesh-axis
+    # subset (e.g. the ICI axis of each slice), with only the already-
+    # scattered shards crossing the remaining (DCN) axes via psum — the
+    # multi-slice traffic shaping the reference achieves with load-balanced
+    # PS placement (``ps_synchronizer.py:635-656``).  None = all data axes.
+    ps_axes: Optional[tuple] = None
     # CUSTOM placement: the user-supplied PartitionSpec
     custom_spec: Optional[object] = None
     # logical metadata (cost model / parity with reference part_config)
@@ -160,6 +167,10 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
             plan.staleness = ps.staleness
             plan.local_replication = ps.local_replication
             plan.reduction_destination = ps.reduction_destination
+            if ps.reduction_destination.startswith("mesh:"):
+                axes = tuple(a for a in
+                             ps.reduction_destination[5:].split(",") if a)
+                plan.ps_axes = axes or None
         elif which == "AllReduceSynchronizer":
             ar = sync_src.AllReduceSynchronizer
             plan.sync = SyncKind.ALL_REDUCE
@@ -187,6 +198,11 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
             plan.padded_dim = -(-dim // num_replicas) * num_replicas
         elif plan.sync == SyncKind.PS and (not plan.ps_sync or plan.staleness > 0):
             plan.placement = Placement.DIVERGENT
+        if plan.placement is not Placement.REPLICATED:
+            # the engine realizes ps_axes only for flat-shard (REPLICATED)
+            # PS vars; clear it elsewhere so every consumer (engine, cost
+            # model, dumps) sees one consistent truth
+            plan.ps_axes = None
         plans[v.name] = plan
     unmatched = set(param_specs) - matched_patterns
     if unmatched:

@@ -69,6 +69,91 @@ class TokenBarrier:
             return list(self._steps)
 
 
+class AsyncPSEngineSession:
+    """Strategy-DRIVEN async session: the user API selects asynchrony.
+
+    ``AutoDist.distribute()`` routes here when the compiled strategy
+    contains a ``PSSynchronizer`` with ``sync=False`` — matching the
+    reference, where staleness/async is a strategy field
+    (``/root/reference/autodist/proto/synchronizers.proto:25-35``,
+    ``ps_synchronizer.py:388-458``), not a side API.  Consumes the
+    ModelItem + compiled Strategy:
+
+    - the staleness bound = max staleness over the async PS nodes (the
+      reference's per-variable token queues share one global barrier here;
+      the max is the loosest bound that satisfies every variable's)
+    - the variable plans stay inspectable (``.plans``) — a mixed
+      Parallax-style plan routes sparse variables to PS and dense to AR;
+      in the async runtime every variable is host-served (a worker that
+      runs ahead cannot rendezvous for collectives), so the AR label's
+      per-step synchronous semantics degrade to async application, which
+      is exactly the reference's behavior when async mode is selected.
+
+    The actual worker/server machinery is :class:`AsyncPSSession`
+    (composition, not a third implementation).
+    """
+
+    def __init__(self, strategy, model_item, *, devices=None,
+                 num_workers=None):
+        from autodist_tpu.kernel.partitioner import (SyncKind,
+                                                     build_var_plans)
+
+        if model_item.optimizer is None:
+            raise ValueError("ModelItem has no optimizer")
+        for feature, flag in (("has_rng", model_item.has_rng),
+                              ("has_aux", model_item.has_aux),
+                              ("mutable_state",
+                               model_item.mutable_state is not None)):
+            if flag:
+                raise NotImplementedError(
+                    f"async PS runtime does not support {feature} yet; "
+                    f"use the synchronous engine (sync=True)")
+        self.strategy = strategy
+        self.model_item = model_item
+        self.plans = build_var_plans(strategy, model_item, num_replicas=1)
+        stale = [p.staleness for p in self.plans.values()
+                 if p.sync == SyncKind.PS and not p.ps_sync]
+        if not stale:
+            raise ValueError(
+                "strategy has no async (sync=False) PS node; the "
+                "synchronous engine handles it")
+        self.staleness = max(stale)
+        self._inner = AsyncPSSession(
+            model_item.loss_fn, model_item.params, model_item.optimizer,
+            staleness=self.staleness, devices=devices,
+            num_workers=num_workers)
+
+    # thin delegation (the session surface tests/users drive).  params is
+    # a METHOD, matching DistributedSession.params() — code written against
+    # the distribute() contract must not crash when a strategy goes async
+    def params(self):
+        return self._inner.params
+
+    @property
+    def version(self):
+        return self._inner.version
+
+    @property
+    def stale_pushes(self):
+        return self._inner.stale_pushes
+
+    @property
+    def barrier(self):
+        return self._inner.barrier
+
+    @property
+    def history(self):
+        return self._inner.history
+
+    @property
+    def num_workers(self):
+        return len(self._inner._devices)
+
+    def run(self, batches_per_worker, steps, delays=None, timeout=300.0):
+        return self._inner.run(batches_per_worker, steps, delays=delays,
+                               timeout=timeout)
+
+
 class AsyncPSSession:
     """Asynchronous bounded-staleness training session.
 

@@ -34,6 +34,7 @@ class DistributedSession:
         # pad rows silently bias the update — a loud error beats that
         self._batch_mask = batch_mask
         self._warned_uneven = False
+        self._dumped_artifacts = False
 
     # -- feeds (reference remapper._remap_feed analog) ---------------------
 
@@ -202,6 +203,16 @@ class DistributedSession:
     def run(self, batch, trace_dir=None):
         """One training step on a global batch; returns metrics dict."""
         gbatch = self._shard_batch(batch)
+        if not self._dumped_artifacts:
+            # 4-stage program-evolution dump (no-op unless
+            # AUTODIST_DUMP_HLO): plan -> StableHLO -> optimized HLO ->
+            # executable stats, the analog of the reference's per-pass
+            # TensorBoard graph logging
+            self._dumped_artifacts = True
+            from autodist_tpu.utils.visualization_util import (
+                dump_step_artifacts)
+
+            dump_step_artifacts(self._t, self._step, self.state, gbatch)
         if trace_dir:
             os.makedirs(trace_dir, exist_ok=True)
             with jax.profiler.trace(trace_dir):

@@ -116,6 +116,15 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
     if flops_per_example:
         compute_s = 3.0 * flops_per_example * batch_per_chip / (peak_flops * mxu_eff)
 
+    # mesh-axis-subset PS ("mesh:<axes>" reduction destinations): the
+    # scatter/gather stays INSIDE the subset (ICI), and only shard-sized
+    # pieces cross the remaining axes (DCN) — so those vars' PS bytes are
+    # priced at ICI bandwidth plus a shard-sized cross-slice ring, instead
+    # of pricing the full gradient at the DCN-bottlenecked ring.
+    mesh_req = resource_spec.mesh_request or {}
+    subset_ps_bytes = 0
+    subset_R = subset_other = 1
+
     ar_bytes = ps_bytes = gather_bytes = sparse_bytes = 0
     for v in model_item.var_infos:
         plan = plans.get(v.name)
@@ -133,6 +142,13 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         elif plan.sync == SyncKind.PS:
             if plan.placement == Placement.DIVERGENT:
                 ar_bytes += nbytes / plan.sync_period  # amortized averaging
+            elif plan.ps_axes and mesh_req:
+                r_ps = 1
+                for a in plan.ps_axes:
+                    r_ps *= int(mesh_req.get(a, 1))
+                subset_ps_bytes += nbytes
+                subset_R = max(subset_R, r_ps)
+                subset_other = max(subset_other, R // max(1, r_ps))
             else:
                 ps_bytes += nbytes
                 gather_bytes += nbytes
@@ -166,9 +182,19 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
               + _gather_time(ps_bytes, R, bw)      # reduce-scatter of grads
               + _gather_time(gather_bytes, R, bw)  # all-gather of params
               + sparse_bytes / bw)
+    subset_s = 0.0
+    if subset_ps_bytes:
+        ici_bw = ici_gbps * 1e9 / 8
+        # scatter + gather within the subset at ICI speed, plus a ring
+        # psum of the 1/R_ps-sized shards across the remaining axes at
+        # the bottleneck (DCN) bandwidth
+        subset_s = (2.0 * _gather_time(subset_ps_bytes, subset_R, ici_bw)
+                    + _ring_time(subset_ps_bytes / subset_R, subset_other, bw))
+        comm_s += subset_s
     return CostEstimate(compute_s, comm_s, {
         "ar_bytes": ar_bytes, "ps_bytes": ps_bytes,
         "gather_bytes": gather_bytes, "sparse_bytes": sparse_bytes,
+        "subset_ps_bytes": subset_ps_bytes, "subset_ps_s": subset_s,
         "num_replicas": R})
 
 

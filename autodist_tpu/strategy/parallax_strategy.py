@@ -12,11 +12,16 @@ from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing, byte_size_load
 
 class Parallax(AllReduce):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor",
-                 local_proxy_variable=False, sync=True, staleness=0):
+                 local_proxy_variable=False, sync=True, staleness=0,
+                 ps_axes=None):
         super().__init__(chunk_size, all_reduce_spec, compressor)
         self._local_replication = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
+        self._ps_axes = tuple(ps_axes) if ps_axes else None
+
+    def _dest(self, anchor):
+        return ("mesh:" + ",".join(self._ps_axes)) if self._ps_axes else anchor
 
     def build(self, model_item, resource_spec):
         s = Strategy()
@@ -33,7 +38,7 @@ class Parallax(AllReduce):
                 n.sparse = True
                 dest = min(loads, key=loads.get)
                 loads[dest] += byte_size_load_fn(v)
-                n.PSSynchronizer.reduction_destination = dest
+                n.PSSynchronizer.reduction_destination = self._dest(dest)
                 n.PSSynchronizer.local_replication = self._local_replication
                 n.PSSynchronizer.sync = self._sync
                 n.PSSynchronizer.staleness = self._staleness

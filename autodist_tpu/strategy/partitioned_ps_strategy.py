@@ -21,8 +21,9 @@ def get_num_shards(dim0, max_shards):
 
 
 class PartitionedPS(PSLoadBalancing):
-    def __init__(self, local_proxy_variable=False, sync=True, staleness=0, max_shards=None):
-        super().__init__(local_proxy_variable, sync, staleness)
+    def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
+                 max_shards=None, ps_axes=None):
+        super().__init__(local_proxy_variable, sync, staleness, ps_axes=ps_axes)
         self._max_shards = max_shards
 
     def _num_shards(self, v, num_anchors, num_accelerators):
@@ -50,7 +51,7 @@ class PartitionedPS(PSLoadBalancing):
             if k <= 1:
                 dest = min(self.loads, key=self.loads.get)
                 self.loads[dest] += byte_size_load_fn(v)
-                n.PSSynchronizer.reduction_destination = dest
+                n.PSSynchronizer.reduction_destination = self._dest(dest)
                 n.PSSynchronizer.local_replication = self._local_replication
                 n.PSSynchronizer.sync = self._sync
                 n.PSSynchronizer.staleness = self._staleness
@@ -63,7 +64,7 @@ class PartitionedPS(PSLoadBalancing):
                 p.sparse = v.sparse
                 dest = min(self.loads, key=self.loads.get)
                 self.loads[dest] += per_shard
-                p.PSSynchronizer.reduction_destination = dest
+                p.PSSynchronizer.reduction_destination = self._dest(dest)
                 p.PSSynchronizer.local_replication = self._local_replication
                 p.PSSynchronizer.sync = self._sync
                 p.PSSynchronizer.staleness = self._staleness

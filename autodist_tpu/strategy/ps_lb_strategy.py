@@ -16,11 +16,18 @@ def byte_size_load_fn(var_info):
 
 
 class PSLoadBalancing(StrategyBuilder):
-    def __init__(self, local_proxy_variable=False, sync=True, staleness=0):
+    def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
+                 ps_axes=None):
         self._local_replication = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
+        self._ps_axes = tuple(ps_axes) if ps_axes else None
         self.loads = {}
+
+    def _dest(self, anchor):
+        # mesh-axis subset beats a device anchor on TPU: the subset IS the
+        # reduction destination (see kernel/partitioner VarPlan.ps_axes)
+        return ("mesh:" + ",".join(self._ps_axes)) if self._ps_axes else anchor
 
     def _anchors(self, resource_spec):
         """One candidate PS anchor per node: first accelerator of each."""
@@ -42,7 +49,7 @@ class PSLoadBalancing(StrategyBuilder):
             n.sparse = v.sparse
             dest = min(self.loads, key=self.loads.get)
             self.loads[dest] += byte_size_load_fn(v)
-            n.PSSynchronizer.reduction_destination = dest
+            n.PSSynchronizer.reduction_destination = self._dest(dest)
             n.PSSynchronizer.local_replication = self._local_replication
             n.PSSynchronizer.sync = self._sync
             n.PSSynchronizer.staleness = self._staleness

@@ -9,12 +9,22 @@ from autodist_tpu.strategy.base import Strategy, StrategyBuilder
 
 
 class PS(StrategyBuilder):
-    def __init__(self, local_proxy_variable=False, sync=True, staleness=0):
+    def __init__(self, local_proxy_variable=False, sync=True, staleness=0,
+                 ps_axes=None):
         self._local_replication = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
-        if staleness > 0:
-            assert sync, "staleness > 0 is the stale-sync mode and requires sync=True"
+        # ps_axes: mesh-axis subset (e.g. ("ici",)) the PS scatter/gather
+        # is confined to, emitted as the TPU-native reduction destination
+        # "mesh:<axes>"; shards cross the remaining data axes via psum.
+        self._ps_axes = tuple(ps_axes) if ps_axes else None
+        # staleness>0 is meaningful in BOTH modes: with sync=True it is the
+        # stale-sync (DIVERGENT + periodic averaging) engine path; with
+        # sync=False it is the async runtime's bounded-lead token barrier
+        # (reference ps_synchronizer.py:388-458 token queues)
+
+    def _dest(self, anchor):
+        return ("mesh:" + ",".join(self._ps_axes)) if self._ps_axes else anchor
 
     def build(self, model_item, resource_spec):
         s = Strategy()
@@ -29,7 +39,7 @@ class PS(StrategyBuilder):
             n = s.node_config.add()
             n.var_name = v.name
             n.sparse = v.sparse
-            n.PSSynchronizer.reduction_destination = anchor
+            n.PSSynchronizer.reduction_destination = self._dest(anchor)
             n.PSSynchronizer.local_replication = self._local_replication
             n.PSSynchronizer.sync = self._sync
             n.PSSynchronizer.staleness = self._staleness

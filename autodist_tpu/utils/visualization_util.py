@@ -11,6 +11,66 @@ from autodist_tpu.const import DEFAULT_HLO_DUMP_DIR, ENV
 from autodist_tpu.utils import logging
 
 
+def dump_step_artifacts(transformer, step_fn, state, batch, name="train_step"):
+    """Four-stage program-evolution dump (reference parity: the TF
+    transformer logs the graph to TensorBoard after each of its four passes,
+    ``kernel/graph_transformer.py:62-90``).  TPU analog, written to
+    ``DEFAULT_HLO_DUMP_DIR`` when ``AUTODIST_DUMP_HLO`` is set:
+
+      0_<name>.plan.txt            transform plan (placements, buckets)
+      1_<name>.stablehlo.txt       lowered StableHLO of the jitted step
+      2_<name>.optimized_hlo.txt   XLA-optimized HLO
+      3_<name>.executable.json     executable stats (flops, bytes, memory)
+
+    No-op unless AUTODIST_DUMP_HLO.  Returns the dump dir or None.
+    """
+    if not ENV.AUTODIST_DUMP_HLO.val:
+        return None
+    import json
+
+    os.makedirs(DEFAULT_HLO_DUMP_DIR, exist_ok=True)
+
+    with open(os.path.join(DEFAULT_HLO_DUMP_DIR, f"0_{name}.plan.txt"),
+              "w") as f:
+        f.write(transformer.plan_summary())
+
+    lowered = step_fn.lower(state, batch)
+    with open(os.path.join(DEFAULT_HLO_DUMP_DIR, f"1_{name}.stablehlo.txt"),
+              "w") as f:
+        f.write(lowered.as_text())
+    try:
+        compiled = lowered.compile()
+        with open(os.path.join(DEFAULT_HLO_DUMP_DIR,
+                               f"2_{name}.optimized_hlo.txt"), "w") as f:
+            f.write(compiled.as_text())
+        stats = {}
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            stats["cost_analysis"] = {k: float(v) for k, v in dict(ca).items()
+                                      if isinstance(v, (int, float))}
+        except Exception as e:
+            stats["cost_analysis_error"] = str(e)
+        try:
+            ma = compiled.memory_analysis()
+            for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                         "output_size_in_bytes", "alias_size_in_bytes",
+                         "generated_code_size_in_bytes"):
+                if hasattr(ma, attr):
+                    stats.setdefault("memory_analysis", {})[attr] = int(
+                        getattr(ma, attr))
+        except Exception as e:
+            stats["memory_analysis_error"] = str(e)
+        with open(os.path.join(DEFAULT_HLO_DUMP_DIR,
+                               f"3_{name}.executable.json"), "w") as f:
+            json.dump(stats, f, indent=1)
+    except Exception as e:  # compile may be deferred/unavailable
+        logging.debug("optimized HLO unavailable for %s: %s", name, e)
+    logging.info("Dumped 4-stage step artifacts for %s to %s", name,
+                 DEFAULT_HLO_DUMP_DIR)
+    return DEFAULT_HLO_DUMP_DIR
+
+
 def dump_hlo(fn_or_lowered, name, *args, **kwargs):
     """Write the lowered StableHLO (and compiled HLO when available) of a
     jitted function applied to `args`.  No-op unless AUTODIST_DUMP_HLO."""

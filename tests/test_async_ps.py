@@ -104,3 +104,88 @@ def test_token_barrier_unit():
     b.advance(2)
     t.join(2.0)
     assert passed.is_set()
+
+
+# --- strategy-driven async engine path (VERDICT r2 item 5) ----------------
+
+def _mixed_model():
+    """Mixed Parallax-style plan: sparse embedding -> PS, dense -> AR."""
+    from autodist_tpu.ops.sparse import embedding_lookup
+
+    r = np.random.RandomState(3)
+    params = {"emb": jnp.asarray(r.randn(40, 6) * 0.3, jnp.float32),
+              "w": jnp.asarray(r.randn(6, 1) * 0.3, jnp.float32)}
+
+    def loss(p, b):
+        e = embedding_lookup(p["emb"], b["ids"])
+        return jnp.mean((e @ p["w"])[..., 0] ** 2)
+
+    return loss, params
+
+
+def _mixed_batches(workers, n=4):
+    r = np.random.RandomState(4)
+    return [[{"ids": r.randint(0, 40, (8,))} for _ in range(n)]
+            for _ in range(workers)]
+
+
+def test_async_selected_through_distribute():
+    """PS(sync=False, staleness=s) through AutoDist.distribute() yields the
+    async runtime — the USER API selects asynchrony (reference:
+    synchronizers.proto staleness field), not a side API."""
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.kernel.synchronization.async_ps import (
+        AsyncPSEngineSession)
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import Parallax
+
+    loss, params = _mixed_model()
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(2),
+                  strategy_builder=Parallax(sync=False, staleness=2))
+    sess = ad.distribute(loss, params, optax.sgd(0.02), sparse_vars=["emb"])
+    assert isinstance(sess, AsyncPSEngineSession)
+    assert sess.staleness == 2
+    # the plan is a genuine Parallax mix: sparse -> PS, dense -> AR
+    from autodist_tpu.kernel.partitioner import SyncKind
+
+    assert sess.plans["emb"].sync == SyncKind.PS
+    assert not sess.plans["emb"].ps_sync
+    assert sess.plans["w"].sync == SyncKind.ALL_REDUCE
+
+    before = np.asarray(sess.params()["w"]).copy()
+    delays = [0.0] * sess.num_workers
+    delays[-1] = 0.04  # one induced straggler (c9 rig)
+    sess.run(_mixed_batches(sess.num_workers), steps=6, delays=delays)
+    # progress + bounded lead (c9 semantics through the engine path)
+    assert sess.version == 6 * sess.num_workers
+    assert sess.barrier.max_lead_seen <= 2
+    assert not np.allclose(np.asarray(sess.params()["w"]), before)
+    assert all(np.isfinite(l) for _, _, l in sess.history)
+
+
+def test_sync_strategy_still_uses_spmd_engine():
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.runner import DistributedSession
+    from autodist_tpu.strategy import Parallax
+
+    loss, params = _mixed_model()
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(2),
+                  strategy_builder=Parallax(sync=True, staleness=1))
+    sess = ad.distribute(loss, params, optax.sgd(0.02), sparse_vars=["emb"])
+    assert isinstance(sess, DistributedSession)
+
+
+def test_async_runtime_rejects_unsupported_features():
+    import pytest as _pytest
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import PS
+
+    loss, params = _mixed_model()
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(2),
+                  strategy_builder=PS(sync=False))
+    with _pytest.raises(NotImplementedError, match="has_rng"):
+        ad.distribute(lambda p, b, r: 0.0, params, optax.sgd(0.02),
+                      has_rng=True)

@@ -1,0 +1,176 @@
+"""PS over a mesh-axis SUBSET (VERDICT r2 item 6): on a dcn x ici mesh,
+``PS(ps_axes=("ici",))`` confines the weight-update sharding's
+reduce-scatter/all-gather to the ici axis; only the 1/R_ici-sized shards
+cross the dcn axis (via psum).  Asserted two ways: the collectives in the
+step jaxpr name only the expected axes, and training stays value-exact vs
+the dense single-device oracle.
+
+Reference analog: load-balanced PS placement shapes exactly this
+multi-node traffic (``/root/reference/autodist/kernel/synchronization/
+ps_synchronizer.py:635-656``, ``strategy/ps_lb_strategy.py:60-117``).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import PS, Parallax, PartitionedPS
+
+MESH_SPEC = ResourceSpec(resource_info={
+    "nodes": [{"address": "localhost", "chips": list(range(8))}],
+    "mesh": {"dcn": 2, "ici": 4}})
+BATCH = {"x": np.random.RandomState(0).randn(16, 8).astype(np.float32),
+         "y": np.random.RandomState(1).randn(16).astype(np.float32)}
+
+
+def _loss(p, b):
+    h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+    return jnp.mean(((h @ p["w2"])[:, 0] - b["y"]) ** 2)
+
+
+def _params():
+    r = np.random.RandomState(3)
+    return {"w1": jnp.asarray(r.randn(8, 16) * 0.3, jnp.float32),
+            "b1": jnp.zeros((16,), jnp.float32),
+            "w2": jnp.asarray(r.randn(16, 1) * 0.3, jnp.float32)}
+
+
+def _session(builder, **kw):
+    ad = AutoDist(resource_spec=MESH_SPEC, strategy_builder=builder)
+    return ad.distribute(_loss, _params(), optax.sgd(0.1),
+                         data_axes=("dcn", "ici"), **kw)
+
+
+def _collect_collectives(jaxpr, inside=False, acc=None):
+    """(primitive_name, axes) for every collective inside shard_map."""
+    acc = [] if acc is None else acc
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        here = inside or name == "shard_map"
+        if inside and name in ("psum", "reduce_scatter", "psum_scatter",
+                               "all_gather", "all_reduce", "pmin", "pmax"):
+            axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+            if not isinstance(axes, tuple):
+                axes = (axes,)
+            acc.append((name, tuple(str(a) for a in axes)))
+        for val in eqn.params.values():
+            # params hold either a raw Jaxpr (shard_map) or a ClosedJaxpr
+            sub = val if hasattr(val, "eqns") else getattr(val, "jaxpr", None)
+            if sub is not None and hasattr(sub, "eqns"):
+                _collect_collectives(sub, here, acc)
+    return acc
+
+
+def test_subset_ps_collectives_name_only_ici():
+    """The PS scatter/gather must name ONLY the ici axis; dcn appears only
+    in psums (shard-sized cross-slice sums + loss metrics)."""
+    sess = _session(PS(ps_axes=("ici",)))
+    gbatch = sess._shard_batch(BATCH)
+    jaxpr = jax.make_jaxpr(lambda s, b: sess._step(s, b))(sess.state, gbatch)
+    colls = _collect_collectives(jaxpr.jaxpr)
+    assert colls, "no collectives found in step jaxpr"
+    scatter_gather = [c for c in colls
+                      if c[0] in ("reduce_scatter", "psum_scatter", "all_gather")]
+    assert scatter_gather, f"no scatter/gather in {colls}"
+    for name, axes in scatter_gather:
+        assert "dcn" not in axes, (
+            f"{name} rides the dcn axis: {axes} (all: {colls})")
+        assert axes == ("ici",), f"{name} axes {axes} != ('ici',)"
+
+
+def test_full_axis_ps_uses_both_axes():
+    """Default PS (no subset) scatters over the full data-axis set — the
+    control for the assertion above."""
+    sess = _session(PS())
+    gbatch = sess._shard_batch(BATCH)
+    jaxpr = jax.make_jaxpr(lambda s, b: sess._step(s, b))(sess.state, gbatch)
+    scatter_gather = [c for c in _collect_collectives(jaxpr.jaxpr)
+                      if c[0] in ("reduce_scatter", "psum_scatter", "all_gather")]
+    assert scatter_gather
+    assert any(set(axes) == {"dcn", "ici"} for _, axes in scatter_gather), (
+        scatter_gather)
+
+
+@pytest.mark.parametrize("builder_fn", [
+    lambda: PS(ps_axes=("ici",)),
+    lambda: PartitionedPS(ps_axes=("ici",), max_shards=4),
+    lambda: Parallax(ps_axes=("ici",)),
+])
+def test_subset_ps_value_exact(builder_fn):
+    """Subset-axis realization must not change the math: one SGD step
+    equals dense single-device training exactly."""
+    sess = _session(builder_fn())
+    sess.run(BATCH)
+    p = _params()
+    g = jax.grad(lambda q: _loss(q, {k: jnp.asarray(v)
+                                     for k, v in BATCH.items()}))(p)
+    want = jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+    got = sess.params()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   atol=1e-5, err_msg=k)
+
+
+def test_subset_ps_multi_step_adam_checkpoint(tmp_path):
+    """Sharded-over-subset optimizer state canonicalizes to single-device
+    shapes (checkpoint contract holds under ps_axes)."""
+    from autodist_tpu.checkpoint.saver import Saver
+
+    sess = _session(PS(ps_axes=("ici",)))
+    for _ in range(2):
+        sess.run(BATCH)
+    want = sess.params()
+    path = Saver(sess).save(str(tmp_path / "ck"))
+    raw = Saver.restore_single_device(path)
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(raw["params"][k]),
+                                      np.asarray(want[k]))
+
+
+def test_unknown_ps_axes_raise():
+    with pytest.raises(ValueError, match="not data axes"):
+        _session(PS(ps_axes=("nope",)))
+
+
+def test_cost_model_prices_subset_ps_cheaper_over_slow_dcn():
+    """The cost-model term (VERDICT r2 item 6): with a slow DCN between
+    slices, confining PS scatter/gather to the ici axis must price the
+    strategy cheaper than the full-axis realization — only shard-sized
+    pieces cross the DCN ring."""
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.simulator.cost_model import estimate
+
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "10.0.0.1", "chips": [0, 1, 2, 3],
+                   "chief": True, "network_bandwidth": 10},
+                  {"address": "10.0.0.2", "chips": [0, 1, 2, 3],
+                   "network_bandwidth": 10}],
+        "mesh": {"dcn": 2, "ici": 4}})
+    item = ModelItem(lambda p, b: 0.0,
+                     {"w": jnp.zeros((4096, 4096), jnp.float32)})
+    full = estimate(PS().build(item, spec), item, spec)
+    subset = estimate(PS(ps_axes=("ici",)).build(item, spec), item, spec)
+    assert subset.breakdown["subset_ps_bytes"] > 0
+    assert full.breakdown["subset_ps_bytes"] == 0
+    assert subset.comm_s < full.comm_s, (subset.to_json(), full.to_json())
+
+
+def test_grad_norm_clip_exact_under_subset():
+    """Global-norm clipping must count each subset-PS shard once despite
+    its replication over dcn."""
+    sess = _session(PS(ps_axes=("ici",)), clip_global_norm=0.05)
+    m = sess.run(BATCH)
+    p = _params()
+    g = jax.grad(lambda q: _loss(q, {k: jnp.asarray(v)
+                                     for k, v in BATCH.items()}))(p)
+    true_norm = float(optax.global_norm(g))
+    np.testing.assert_allclose(float(m["grad_norm"]), true_norm, rtol=1e-5)
+    scale = min(1.0, 0.05 / true_norm)
+    want = jax.tree.map(lambda a, b: a - 0.1 * scale * b, p, g)
+    got = sess.params()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   atol=1e-6, err_msg=k)
