@@ -79,9 +79,10 @@ class AsyncPSEngineSession:
     ``ps_synchronizer.py:388-458``), not a side API.  Consumes the
     ModelItem + compiled Strategy:
 
-    - the staleness bound = max staleness over the async PS nodes (the
-      reference's per-variable token queues share one global barrier here;
-      the max is the loosest bound that satisfies every variable's)
+    - the staleness bound = MIN staleness over the async PS nodes: the
+      reference's per-variable token queues collapse into one global
+      barrier here, and only the tightest bound satisfies every
+      variable's contract
     - the variable plans stay inspectable (``.plans``) — a mixed
       Parallax-style plan routes sparse variables to PS and dense to AR;
       in the async runtime every variable is host-served (a worker that
@@ -102,6 +103,7 @@ class AsyncPSEngineSession:
             raise ValueError("ModelItem has no optimizer")
         for feature, flag in (("has_rng", model_item.has_rng),
                               ("has_aux", model_item.has_aux),
+                              ("eval_fn", model_item.eval_fn is not None),
                               ("mutable_state",
                                model_item.mutable_state is not None)):
             if flag:
@@ -117,7 +119,7 @@ class AsyncPSEngineSession:
             raise ValueError(
                 "strategy has no async (sync=False) PS node; the "
                 "synchronous engine handles it")
-        self.staleness = max(stale)
+        self.staleness = min(stale)
         self._inner = AsyncPSSession(
             model_item.loss_fn, model_item.params, model_item.optimizer,
             staleness=self.staleness, devices=devices,

@@ -146,9 +146,17 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                 r_ps = 1
                 for a in plan.ps_axes:
                     r_ps *= int(mesh_req.get(a, 1))
-                subset_ps_bytes += nbytes
-                subset_R = max(subset_R, r_ps)
-                subset_other = max(subset_other, R // max(1, r_ps))
+                if r_ps >= R:
+                    # subset covering the whole mesh == default realization
+                    # (the engine normalizes exactly this case); price it
+                    # identically so a search cannot "prefer" a byte-for-
+                    # byte identical strategy
+                    ps_bytes += nbytes
+                    gather_bytes += nbytes
+                else:
+                    subset_ps_bytes += nbytes
+                    subset_R = max(subset_R, r_ps)
+                    subset_other = max(subset_other, R // max(1, r_ps))
             else:
                 ps_bytes += nbytes
                 gather_bytes += nbytes

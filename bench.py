@@ -120,6 +120,20 @@ def _bench():
     gbatch["image"] = jnp.asarray(gbatch["image"], jnp.bfloat16)
 
     _stage("compile")
+    # XLA's own FLOP count for the compiled step: includes the real extra
+    # work the compiler emits (dilated stride-2 backward convs, BN stats)
+    # that the model-FLOPs MFU numerator deliberately excludes.  Lower +
+    # compile FIRST so the warmup's jit compile hits the persistent
+    # compilation cache (JAX_COMPILATION_CACHE_DIR, set by the parent)
+    # instead of paying a second full compile.  cost_analysis is on the
+    # post-GSPMD PER-DEVICE module, so flops is per-chip work.
+    xla_flops_per_chip = 0.0
+    try:
+        ca = sess._step.lower(sess.state, gbatch).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        xla_flops_per_chip = float(dict(ca).get("flops", 0.0))
+    except Exception:
+        pass
     for _ in range(3):  # warmup + compile
         m = sess.run(gbatch)
     fetch_scalar(m["loss"])  # real sync (block_until_ready may be a no-op)
@@ -150,6 +164,10 @@ def _bench():
         "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 3),
         "mfu": round(mfu, 4),
         "mfu_pass": bool(mfu >= MFU_PASS_BAR),
+        # per-chip XLA-counted flops over per-chip peak: the "how busy is
+        # the MXU" view next to mfu's "useful model math per second" view
+        "hw_util_xla": (round(xla_flops_per_chip / per_step / peak, 4)
+                        if xla_flops_per_chip else None),
         "peak_bf16_tflops": round(peak / 1e12, 1),
         "peak_assumed": peak_assumed,
         "backend": jax.default_backend(),

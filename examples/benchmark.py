@@ -107,6 +107,57 @@ FLOPS_PER_EXAMPLE = {
 }
 
 
+def _real_pipeline(args, cap, B, sess):
+    """Disk -> C++ loader -> DevicePrefetcher input pipeline (reference
+    analog: ``examples/benchmark/imagenet.py`` trains from real input
+    pipelines, not device-resident tensors).  The dataset is materialized
+    once into the native record format; batches then flow through the mmap
+    loader's worker threads and the device double-buffer — so the measured
+    step includes (overlapped) host IO + H2D transfer.
+
+    Returns an endless iterator of device-resident global batches.
+    """
+    import tempfile
+
+    from autodist_tpu.data.loader import (BatchLoader, DevicePrefetcher,
+                                          RecordDataset, write_records)
+
+    sample = cap["batch_fn"](1)
+    keys = sorted(sample)  # one flat f32 record per example: concat leaves
+    sizes = {k: int(np.prod(np.asarray(sample[k]).shape[1:]) or 1)
+             for k in keys}
+    rec_len = sum(sizes.values())
+    n_records = max(4 * B, 1024)
+    host = cap["batch_fn"](n_records)
+    flat = np.concatenate(
+        [np.asarray(host[k]).reshape(n_records, -1).astype(np.float32)
+         for k in keys], axis=1)
+    workdir = tempfile.mkdtemp(prefix="adio_bench_")
+    import atexit
+    import shutil
+
+    atexit.register(shutil.rmtree, workdir, ignore_errors=True)
+    path = os.path.join(workdir, "data.adio")
+    write_records(path, flat)
+    ds = RecordDataset(path, (rec_len,), np.float32)
+    loader = BatchLoader(ds, B, shuffle=True, seed=0,
+                         threads=args.loader_threads, prefetch=2)
+
+    def rebuild():
+        for arr in loader:
+            out, off = {}, 0
+            for k in keys:
+                n = sizes[k]
+                leaf = arr[:, off:off + n].reshape(
+                    (B,) + np.asarray(sample[k]).shape[1:])
+                ref_dtype = np.asarray(host[k]).dtype
+                out[k] = leaf.astype(ref_dtype) if ref_dtype != np.float32 else leaf
+                off += n
+            yield out
+
+    return DevicePrefetcher(rebuild(), sess, depth=2)
+
+
 def run_one(args, strategy_name, cap, n_chips):
     """Build a session under one strategy; measure; return (eps, record)."""
     from autodist_tpu import strategy as S
@@ -126,9 +177,31 @@ def run_one(args, strategy_name, cap, n_chips):
     record = measure_and_record(sess, gbatch, steps=args.steps,
                                 warmup=args.warmup)
     eps = B / record.step_time_s
+    extra = ""
+    if args.data == "real":
+        # same step, batches arriving through the full input pipeline;
+        # compares against the device-resident number to report whether
+        # the run is input-bound (r2 verdict item 9)
+        from autodist_tpu.utils.timing import fetch_scalar, measure_per_step
+
+        pre = _real_pipeline(args, cap, B, sess)
+        fetch_scalar(sess.run(next(pre))["loss"])  # warm
+
+        def run_steps(n):
+            m = None
+            for _ in range(n):
+                m = sess.run(next(pre))
+            return m["loss"]
+
+        real_dt, _ = measure_per_step(
+            run_steps, k=max(1, args.steps // 3), repeats=1)
+        overhead = real_dt / record.step_time_s - 1.0
+        extra = (f" real_eps={B / real_dt:.1f} "
+                 f"input_overhead={100 * overhead:.1f}% "
+                 f"{'INPUT-BOUND' if overhead > 0.2 else 'compute-bound'}")
     print(f"model={args.model} strategy={strategy_name} chips={n_chips} "
           f"global_batch={B} examples/sec={eps:.1f} per_chip={eps / n_chips:.1f} "
-          f"step_ms={1000 * record.step_time_s:.2f}")
+          f"step_ms={1000 * record.step_time_s:.2f}{extra}")
     return eps, record, sess
 
 
@@ -199,6 +272,12 @@ def main():
                          "validation (e.g. 'AllReduce,PS,PartitionedPS,Parallax')")
     ap.add_argument("--records_dir", default="",
                     help="dump AutoSync-style RuntimeRecords + summary here")
+    ap.add_argument("--data", choices=("synthetic", "real"),
+                    default="synthetic",
+                    help="real: feed batches from the native mmap loader + "
+                         "DevicePrefetcher (reports input-bound vs "
+                         "compute-bound against the device-resident step)")
+    ap.add_argument("--loader_threads", type=int, default=2)
     ap.add_argument("--batch_per_chip", type=int, default=64)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)

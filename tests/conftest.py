@@ -7,18 +7,24 @@ reference's ``--run-integration`` gate (reference tests/conftest.py:4-16).
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("AUTODIST_IS_TESTING", "True")
 
-# The image's sitecustomize may import jax at interpreter start (before this
-# file runs), in which case the env vars above are too late; force the
-# platform through the live config as well.
-import jax  # noqa: E402
+if os.environ.get("AUTODIST_TEST_TPU"):
+    # on-chip validation mode (tools/on_chip_checklist.sh): leave the real
+    # backend alone so kernel tests exercise actual TPU hardware
+    pass
+else:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-jax.config.update("jax_platforms", "cpu")
+    # The image's sitecustomize may import jax at interpreter start (before
+    # this file runs), in which case the env vars above are too late; force
+    # the platform through the live config as well.
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -97,7 +97,6 @@ def test_full_axis_ps_uses_both_axes():
 @pytest.mark.parametrize("builder_fn", [
     lambda: PS(ps_axes=("ici",)),
     lambda: PartitionedPS(ps_axes=("ici",), max_shards=4),
-    lambda: Parallax(ps_axes=("ici",)),
 ])
 def test_subset_ps_value_exact(builder_fn):
     """Subset-axis realization must not change the math: one SGD step
@@ -108,6 +107,37 @@ def test_subset_ps_value_exact(builder_fn):
     g = jax.grad(lambda q: _loss(q, {k: jnp.asarray(v)
                                      for k, v in BATCH.items()}))(p)
     want = jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+    got = sess.params()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   atol=1e-5, err_msg=k)
+
+
+def test_parallax_subset_routes_sparse_var():
+    """Parallax only emits PSSynchronizer for SPARSE vars — the subset
+    plumbing must be exercised through one, not through a dense-only model
+    (which would compile to pure AllReduce and never consult ps_axes)."""
+    from autodist_tpu.ops.sparse import embedding_lookup
+
+    r = np.random.RandomState(5)
+    params = {"emb": jnp.asarray(r.randn(30, 8) * 0.3, jnp.float32),
+              "w": jnp.asarray(r.randn(8, 1) * 0.3, jnp.float32)}
+
+    def loss(p, b):
+        e = embedding_lookup(p["emb"], b["ids"])
+        return jnp.mean((e @ p["w"])[..., 0] ** 2)
+
+    batch = {"ids": np.random.RandomState(6).randint(0, 30, (16,))}
+    ad = AutoDist(resource_spec=MESH_SPEC,
+                  strategy_builder=Parallax(ps_axes=("ici",)))
+    sess = ad.distribute(loss, params, optax.sgd(0.1), sparse_vars=["emb"],
+                         data_axes=("dcn", "ici"))
+    assert sess._t.plans["emb"].ps_axes == ("ici",)
+    assert sess._t.plans["w"].ps_axes is None  # dense -> AllReduce
+    sess.run(batch)
+    p0 = {"emb": params["emb"], "w": params["w"]}
+    g = jax.grad(lambda q: loss(q, {"ids": jnp.asarray(batch["ids"])}))(p0)
+    want = jax.tree.map(lambda a, b: a - 0.1 * b, p0, g)
     got = sess.params()
     for k in want:
         np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
