@@ -14,9 +14,14 @@ python examples/benchmark.py --model bert_base \
     | tee onchip_results/bert_sweep.log
 
 # 3. Pallas int8 kernels vs the jnp path on real hardware
-JAX_PLATFORMS='' python -m pytest tests/test_pallas_quantize.py -v \
+# (AUTODIST_TEST_TPU=1 stops conftest from force-pinning the cpu platform)
+AUTODIST_TEST_TPU=1 python -m pytest tests/test_pallas_quantize.py -v \
     | tee onchip_results/pallas.log
 
 # 4. GPT throughput (long-context flagship)
 python examples/benchmark.py --model gpt_small --batch_per_chip 16 \
     --seq_len 512 --steps 10 | tee onchip_results/gpt.log
+
+# 5. Input pipeline at speed: native loader + device double-buffer
+python examples/benchmark.py --model resnet50 --data real \
+    --batch_per_chip 64 --steps 12 | tee onchip_results/real_data.log
