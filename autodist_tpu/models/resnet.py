@@ -60,12 +60,39 @@ class BottleneckResNetBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x, block=2):
+    """(B, H, W, C) -> (B, H/b, W/b, b*b*C), channel order (dr, dc, c)."""
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // block, block, W // block, block, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        B, H // block, W // block, block * block * C)
+
+
+def conv7_to_s2d_kernel(k7):
+    """Reparametrize a (7,7,C,F) stride-2 stem kernel into the equivalent
+    (4,4,4C,F) kernel for the space-to-depth stem: zero-pad to 8x8 at the
+    top-left, then fold each 2x2 tap block into the channel dim.  The two
+    stems compute the SAME function (asserted in tests/test_models.py), so
+    "space_to_depth" is a layout change, not an architecture change."""
+    k8 = jnp.pad(k7, [(1, 0), (1, 0), (0, 0), (0, 0)])
+    _, _, C, F = k8.shape
+    return k8.reshape(4, 2, 4, 2, C, F).transpose(0, 2, 1, 3, 4, 5).reshape(
+        4, 4, 4 * C, F)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # "conv": the paper's 7x7/s2 stem.  "space_to_depth": the equivalent
+    # MXU-friendly form (MLPerf-style): 2x2 space-to-depth packs the
+    # 3-channel input into 12 channels, and a 4x4/s1 conv — whose kernel
+    # is a pure reindexing of the zero-padded 8x8 stem kernel — computes
+    # the identical function with far better MXU lane utilization (3
+    # input channels waste 125/128 lanes).
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -73,8 +100,15 @@ class ResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
                        epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            x = space_to_depth(x, 2)
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=[(2, 1), (2, 1)], name="conv_init")(x)
+        elif self.stem == "conv":
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -104,7 +104,10 @@ def _bench():
     batch_per_chip = int(os.environ.get("BENCH_BATCH", str(DEFAULT_BATCH)))
     B = batch_per_chip * n_chips
 
-    model = ResNet50(num_classes=1000)  # bf16 compute (default dtype)
+    # bf16 compute (default dtype); BENCH_STEM=space_to_depth selects the
+    # exact MXU-friendly stem reparametrization (tests/test_models.py)
+    stem = os.environ.get("BENCH_STEM", "conv")
+    model = ResNet50(num_classes=1000, stem=stem)
     loss_fn, params, state = train_lib.classifier_capture(model, (224, 224, 3))
     ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
                   strategy_builder=AllReduce())
@@ -174,6 +177,7 @@ def _bench():
         "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
         "n_chips": n_chips,
         "batch_per_chip": batch_per_chip,
+        "stem": stem,
         "step_ms": round(1000 * per_step, 2),
         "timing": {"method": "chain-diff",
                    "t_k_s": round(diag["t_k_s"], 3),

@@ -110,3 +110,40 @@ def test_ncf():
          "label": (r.rand(32) < 0.5).astype(np.float32)}
     losses = [float(sess.run(b)["loss"]) for _ in range(8)]
     assert losses[-1] < losses[0]
+
+
+def test_space_to_depth_stem_is_exact_reparametrization():
+    """The s2d stem computes the IDENTICAL function to the 7x7/s2 stem
+    under the kernel reindexing — a layout change, not an architecture
+    change (the MXU-friendly MLPerf-style stem)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from autodist_tpu.models.resnet import (ResNet50, conv7_to_s2d_kernel,
+                                            space_to_depth)
+
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(2, 64, 64, 3), jnp.float32)
+
+    m_conv = ResNet50(num_classes=10, dtype=jnp.float32)
+    m_s2d = ResNet50(num_classes=10, dtype=jnp.float32,
+                     stem="space_to_depth")
+    v = m_conv.init(jax.random.PRNGKey(0), x, train=False)
+    v2 = m_s2d.init(jax.random.PRNGKey(0), x, train=False)
+    # copy every param; replace the stem kernel with its reindexing
+    p2 = jax.tree.map(lambda a: a, v["params"])
+    assert v2["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 64)
+    p2["conv_init"] = {"kernel": conv7_to_s2d_kernel(
+        v["params"]["conv_init"]["kernel"])}
+    y1 = m_conv.apply({"params": v["params"], **{k: w for k, w in v.items()
+                                                if k != "params"}}, x,
+                      train=False)
+    y2 = m_s2d.apply({"params": p2, **{k: w for k, w in v.items()
+                                       if k != "params"}}, x, train=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               atol=2e-4, rtol=2e-4)
+
+    # and the primitive round-trips shapes as documented
+    s = space_to_depth(x, 2)
+    assert s.shape == (2, 32, 32, 12)

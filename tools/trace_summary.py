@@ -1,0 +1,93 @@
+"""Summarize a jax.profiler trace: top ops by device time.
+
+Usage:  python tools/trace_summary.py <trace_dir> [--top 25]
+
+Reads the chrome-trace JSON (``*.trace.json.gz``) that
+``jax.profiler.trace`` writes under ``<dir>/plugins/profile/<run>/`` and
+aggregates complete events on device-side tracks (TPU/accelerator lanes)
+by event name — the quick "where do the milliseconds go" view for MFU work
+(STATUS.md round-3 item 2) without external profiler tooling.
+"""
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+
+
+def find_trace_file(trace_dir):
+    pats = [os.path.join(trace_dir, "**", "*.trace.json.gz"),
+            os.path.join(trace_dir, "**", "*.trace.json")]
+    hits = []
+    for p in pats:
+        hits.extend(glob.glob(p, recursive=True))
+    if not hits:
+        raise SystemExit(f"no *.trace.json(.gz) under {trace_dir}")
+    return max(hits, key=os.path.getmtime)
+
+
+def load_events(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        data = json.load(f)
+    return data.get("traceEvents", data if isinstance(data, list) else [])
+
+
+_DEVICE_PAT = re.compile(r"TPU|/device:|XLA Op|Accelerator|GPU", re.I)
+
+
+def summarize(events, device_only=True):
+    """name -> (total_us, count), restricted to device tracks when the
+    metadata allows telling them apart."""
+    # process-id -> process name from metadata events
+    pnames = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pnames[e.get("pid")] = e.get("args", {}).get("name", "")
+    device_pids = {pid for pid, n in pnames.items() if _DEVICE_PAT.search(n or "")}
+    agg = defaultdict(lambda: [0.0, 0])
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if device_only and device_pids and e.get("pid") not in device_pids:
+            continue
+        dur = float(e.get("dur", 0.0))
+        name = e.get("name", "?")
+        agg[name][0] += dur
+        agg[name][1] += 1
+        total += dur
+    return agg, total, pnames
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--all-tracks", action="store_true",
+                    help="include host-side tracks too")
+    args = ap.parse_args(argv)
+
+    path = find_trace_file(args.trace_dir)
+    events = load_events(path)
+    agg, total, pnames = summarize(events, device_only=not args.all_tracks)
+    if not agg:
+        # fall back to every track (some runs label devices differently)
+        agg, total, pnames = summarize(events, device_only=False)
+        print("(no recognizable device track; showing all tracks)")
+    print(f"trace: {path}")
+    print(f"tracks: {sorted(set(filter(None, pnames.values())))[:8]}")
+    print(f"total event time: {total / 1e3:.2f} ms over {len(agg)} op names")
+    print(f"{'total_ms':>10} {'count':>7} {'share':>6}  name")
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[: args.top]
+    for name, (us, count) in rows:
+        share = us / total if total else 0.0
+        print(f"{us / 1e3:10.2f} {count:7d} {share:6.1%}  {name[:90]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
