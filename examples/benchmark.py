@@ -178,6 +178,14 @@ def run_one(args, strategy_name, cap, n_chips):
                                 warmup=args.warmup)
     eps = B / record.step_time_s
     extra = ""
+    fpe = FLOPS_PER_EXAMPLE.get(args.model)
+    if fpe:
+        from autodist_tpu.utils.timing import peak_flops
+
+        peak, assumed = peak_flops()
+        mfu = 3.0 * fpe * (eps / n_chips) / peak
+        extra += (f" mfu={mfu:.3f}"
+                  f"{' (peak assumed)' if assumed else ''}")
     if args.data == "real":
         # same step, batches arriving through the full input pipeline;
         # compares against the device-resident number to report whether

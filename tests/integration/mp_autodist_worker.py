@@ -66,10 +66,22 @@ import autodist_tpu.autodist as admod  # noqa: E402
 importlib.reload(admod)
 
 uneven = strategy_name.endswith(":uneven")
+subset = strategy_name.endswith(":subset")
 strategy_name = strategy_name.split(":")[0]
 
-spec = ResourceSpec.from_num_chips(R)
-builder = getattr(S, strategy_name)()
+dist_kwargs = {}
+if subset:
+    # dcn x ici mesh whose MAJOR axis is the process boundary: the PS
+    # scatter/gather must stay inside each process's ici pair, with only
+    # shard-sized psums crossing the inter-process (dcn) axis
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": list(range(R))}],
+        "mesh": {"dcn": nproc, "ici": R // nproc}})
+    builder = getattr(S, strategy_name)(ps_axes=("ici",))
+    dist_kwargs["data_axes"] = ("dcn", "ici")
+else:
+    spec = ResourceSpec.from_num_chips(R)
+    builder = getattr(S, strategy_name)()
 ad = admod.AutoDist(resource_spec=spec, strategy_builder=builder)
 
 if uneven:
@@ -105,7 +117,8 @@ if pid == 0:
 
     ad._build_or_load_strategy = publishing_build
 
-sess = ad.distribute(loss_fn, params, optax.sgd(0.1), batch_mask=uneven)
+sess = ad.distribute(loss_fn, params, optax.sgd(0.1), batch_mask=uneven,
+                     **dist_kwargs)
 
 # global batch is seeded and identical across processes; each feeds its slice
 if uneven:

@@ -60,7 +60,7 @@ def _oracle(steps=3):
     return np.asarray(p["w"])
 
 
-@pytest.mark.parametrize("strategy", ["AllReduce", "PSLoadBalancing", "PartitionedPS"])
+@pytest.mark.parametrize("strategy", ["AllReduce", "PSLoadBalancing", "PartitionedPS", "PS:subset"])
 def test_two_process_training_matches_oracle(strategy, tmp_path):
     port = 15620 + abs(hash(strategy)) % 200
     results = _run_cluster(strategy, tmp_path, port)
