@@ -28,6 +28,9 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: Any = jnp.bfloat16
+    # "auto": Pallas flash attention on TPU, XLA elsewhere; "flash"/"xla"
+    # force (flash runs in interpreter mode off-TPU — the tests' CPU path)
+    attention_impl: str = "auto"
 
 
 BERT_BASE = BertConfig()
@@ -60,8 +63,14 @@ class SelfAttention(nn.Module):
             # would need a gathered mask — use full blocks under SP)
             y = ring_attention(q, k, v, seq_axis)
         else:
-            bias = jnp.where(mask[:, None, None, :], 0.0, -1e9).astype(c.dtype)
-            y = jax.nn.dot_product_attention(q, k, v, bias=bias)
+            from autodist_tpu.ops.pallas.flash_attention import (
+                flash_attention, use_flash)
+            if use_flash(c.attention_impl):
+                y = flash_attention(q, k, v, kv_mask=mask)
+            else:
+                bias = jnp.where(mask[:, None, None, :], 0.0,
+                                 -1e9).astype(c.dtype)
+                y = jax.nn.dot_product_attention(q, k, v, bias=bias)
         y = y.reshape(B, S, c.hidden_size)
         return nn.Dense(c.hidden_size, dtype=c.dtype, name="out")(y)
 

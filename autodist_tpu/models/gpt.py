@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from autodist_tpu.ops.pallas.flash_attention import flash_attention, use_flash
 from autodist_tpu.ops.sparse import embedding_lookup
 
 
@@ -29,6 +30,9 @@ class GPTConfig:
     max_position: int = 1024
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
+    # "auto": Pallas flash attention on TPU, XLA elsewhere; "flash"/"xla"
+    # force (flash runs in interpreter mode off-TPU — the tests' CPU path)
+    attention_impl: str = "auto"
 
 
 GPT_SMALL = GPTConfig()
@@ -90,6 +94,8 @@ class CausalSelfAttention(nn.Module):
             # causal masking over GLOBAL positions while K/V blocks stream
             # around the seq ring
             y = ring_attention(q, k, v, seq_axis, causal=True)
+        elif use_flash(c.attention_impl):
+            y = flash_attention(q, k, v, causal=True)
         else:
             pos = jnp.arange(S)
             bias = jnp.where(pos[:, None] >= pos[None, :], 0.0,

@@ -1,0 +1,383 @@
+"""Pallas TPU flash attention (tiled online-softmax) with a custom VJP.
+
+The XLA attention path (``jax.nn.dot_product_attention``) materializes the
+(S, S) score matrix in HBM — O(S^2) memory traffic that caps context
+length and starves the MXU at long S.  This kernel is the standard
+flash-attention recipe laid out for the TPU memory hierarchy:
+
+  * grid over (batch*heads, q-blocks, k-blocks) with the k dimension
+    innermost ("arbitrary" semantics) so VMEM scratch carries the running
+    max / denominator / output accumulator across k-blocks — scores never
+    leave VMEM;
+  * both matmuls per block hit the MXU with f32 accumulation
+    (``preferred_element_type``) over bf16 operands;
+  * causal masking over block-local iotas, with fully-masked k-blocks
+    skipped via ``pl.when`` (upper-triangular compute never runs); key
+    padding masks (the BERT case) ride a per-key additive bias row;
+  * backward = two kernels (dkdv with q innermost, dq with k innermost)
+    that recompute p from the saved logsumexp instead of stashing the
+    (S, S) probability matrix — the flash-attention memory contract.
+
+Reference parity note: the reference (petuum/autodist) has no attention
+kernels at all (its models ride stock TF layers); this is part of the
+"exceeds" long-context surface (SURVEY.md section 5) next to
+``parallel/ring_attention.py``, which streams K/V blocks *between* chips
+while this kernel tiles *within* a chip.
+
+Kernel playbook: /opt/skills/guides/pallas_guide.md (grid/BlockSpec,
+scratch persistence across the innermost grid dim, MXU
+preferred_element_type, 2D iota, ``pl.when`` predication).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30  # finite: -inf NaNs under (0 * -inf) in masked-row algebra
+# running-max floor: keeps exp(masked - m) == 0 when a whole block (or row)
+# is masked out, so fully-padded rows produce exact zeros fwd AND bwd
+_M_FLOOR = -1e20
+_LANES = 128      # broadcast width for the m/l scratch rows
+
+
+def _pick_block(s, want, multiple=1):
+    """Largest divisor of ``s`` that is <= want (and a multiple of
+    ``multiple``); 0 when no such divisor exists."""
+    b = min(want, s)
+    b -= b % multiple
+    while b >= multiple and s % b:
+        b -= multiple
+    return b if b >= multiple else 0
+
+
+def _on_tpu():
+    return jax.default_backend() == "tpu"
+
+
+def use_flash(impl):
+    """Resolve a model config's ``attention_impl`` value at trace time:
+    "auto" -> this kernel on TPU, the XLA path elsewhere."""
+    if impl == "flash":
+        return True
+    if impl == "xla":
+        return False
+    if impl != "auto":
+        raise ValueError(f"attention_impl must be auto|flash|xla, got {impl!r}")
+    return _on_tpu()
+
+
+def _xla_attention(q, k, v, causal, kv_mask, sm_scale):
+    """Fallback for shapes the compiled kernel cannot tile (Mosaic wants
+    128-lane-aligned blocks); also keeps odd-length prototypes working."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, _NEG_INF)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        m = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(m[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if kv_mask is not None:  # fully-masked rows: match the kernel's exact 0
+        p = jnp.where(jnp.any(kv_mask, axis=-1)[:, None, None, None], p, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _scores(q_ref, k_ref, bias_ref, i, j, *, sm_scale, causal,
+            block_q, block_k):
+    """Masked f32 score block (bq, bk); shared by fwd and both bwd kernels
+    so recomputation matches the forward bit-for-bit."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    s = s + bias_ref[0][None, :]
+    if causal:
+        rows = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    return s
+
+
+# ---------------------------------------------------------------- forward --
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, num_k):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _M_FLOOR)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip k-blocks that start past the last query row of this block
+    visible = (i + 1) * block_q - 1 >= j * block_k
+    should_compute = (not causal) or visible
+
+    @pl.when(should_compute)
+    def _():
+        s = _scores(q_ref, k_ref, bias_ref, i, j, sm_scale=sm_scale,
+                    causal=causal, block_q=block_q, block_k=block_k)
+        m_prev = m_scr[:, :1]                          # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                         # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)                 # (bq, 1)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        pv = jax.lax.dot_general(                      # (bq, D) f32
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+
+    @pl.when(j == num_k - 1)
+    def _():
+        l = l_scr[:, :1]
+        denom = jnp.where(l == 0.0, 1.0, l)            # fully-masked rows -> 0
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(denom)
+        lse_ref[0] = lse[:, 0]
+
+
+def _fwd_scratch(block_q, d):
+    from jax.experimental.pallas import tpu as pltpu
+    return [
+        pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+        pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denominator
+        pltpu.VMEM((block_q, d), jnp.float32),        # output accumulator
+    ]
+
+
+def _tpu_params(dimension_semantics):
+    from jax.experimental.pallas import tpu as pltpu
+    try:
+        return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
+    except TypeError:  # older jax spelling
+        return pltpu.TPUCompilerParams(dimension_semantics=dimension_semantics)
+
+
+def _flash_fwd(q, k, v, bias, h, sm_scale, causal, block_q, block_k,
+               interpret):
+    """q,k,v: (BH, S, D); bias: (B, Sk) f32.  Returns (out, lse)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    kern = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=nk)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=_fwd_scratch(block_q, d),
+        compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, bias)
+    return out, lse
+
+
+# --------------------------------------------------------------- backward --
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_scr, dv_scr,
+                 *, sm_scale, causal, block_q, block_k, num_q):
+    j, i = pl.program_id(1), pl.program_id(2)      # k-block outer, q inner
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    visible = (i + 1) * block_q - 1 >= j * block_k
+    should_compute = (not causal) or visible
+
+    @pl.when(should_compute)
+    def _():
+        s = _scores(q_ref, k_ref, bias_ref, i, j, sm_scale=sm_scale,
+                    causal=causal, block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse_ref[0][:, None])           # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)             # (bq, D)
+        dv_scr[:] += jax.lax.dot_general(              # p^T @ dO -> (bk, D)
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(                      # dO @ v^T -> (bq, bk)
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        dk_scr[:] += jax.lax.dot_general(              # ds^T @ q -> (bk, D)
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr,
+               *, sm_scale, causal, block_q, block_k, num_k):
+    i, j = pl.program_id(1), pl.program_id(2)      # q-block outer, k inner
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    visible = (i + 1) * block_q - 1 >= j * block_k
+    should_compute = (not causal) or visible
+
+    @pl.when(should_compute)
+    def _():
+        s = _scores(q_ref, k_ref, bias_ref, i, j, sm_scale=sm_scale,
+                    causal=causal, block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(              # ds @ k -> (bq, D)
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, bias, out, lse, do, h, sm_scale, causal,
+               block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    # delta_r = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, x, y: (b, x, 0))
+    row = pl.BlockSpec((1, block_q), lambda b, x, y: (b, x))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            qspec,
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j)),
+            qspec, row, row,
+        ],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, bias, do, lse, delta)
+
+    # k-block outer, q-block inner: grid indices are (b, j, i)
+    qspec_i = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    row_i = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    kspec_j = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=nq),
+        grid=(bh, nk, nq),
+        in_specs=[qspec_i, kspec_j, kspec_j,
+                  pl.BlockSpec((1, block_k), lambda b, j, i: (b // h, j)),
+                  qspec_i, row_i, row_i],
+        out_specs=[kspec_j, kspec_j],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, bias, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public API --
+
+@functools.lru_cache(maxsize=64)
+def _make_flash(h, sm_scale, causal, block_q, block_k, interpret):
+    @jax.custom_vjp
+    def attend(q, k, v, bias):
+        out, _ = _flash_fwd(q, k, v, bias, h, sm_scale, causal,
+                            block_q, block_k, interpret)
+        return out
+
+    def fwd(q, k, v, bias):
+        out, lse = _flash_fwd(q, k, v, bias, h, sm_scale, causal,
+                              block_q, block_k, interpret)
+        return out, (q, k, v, bias, out, lse)
+
+    def bwd(res, do):
+        q, k, v, bias, out, lse = res
+        dq, dk, dv = _flash_bwd(q, k, v, bias, out, lse, do, h, sm_scale,
+                                causal, block_q, block_k, interpret)
+        return dq, dk, dv, jnp.zeros_like(bias)
+
+    attend.defvjp(fwd, bwd)
+    return attend
+
+
+def flash_attention(q, k, v, causal=False, kv_mask=None, sm_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """Flash attention over (B, S, H, D) tensors (the model layout of
+    ``models/gpt.py`` / ``models/bert.py``).  Differentiable (custom VJP);
+    O(S) attention memory; causal masks over in-kernel iotas.
+
+    ``kv_mask``: optional (B, S_k) boolean key-validity mask (False = padded
+    key, the BERT ``attention_mask``).  Fully-masked rows return exact 0.
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter elsewhere
+    (the tests' CPU path).  Block sizes shrink to divisors of S.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    # compiled Mosaic wants 128-lane-aligned blocks (the lse/bias specs put
+    # block_q/block_k in the minor dim); the interpreter accepts anything
+    align = 1 if interpret else 128
+    bq = _pick_block(sq, block_q, align)
+    bk = _pick_block(sk, block_k, align)
+    if not bq or not bk:
+        return _xla_attention(q, k, v, causal, kv_mask, sm_scale)
+    if kv_mask is None:
+        bias = jnp.zeros((b, sk), jnp.float32)
+    else:
+        bias = jnp.where(kv_mask, 0.0, _NEG_INF).astype(jnp.float32)
+
+    def fold(t):      # (B, S, H, D) -> (B*H, S, D)
+        return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+
+    attend = _make_flash(h, float(sm_scale), bool(causal), bq, bk,
+                         bool(interpret))
+    out = attend(fold(q), fold(k), fold(v), bias)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
