@@ -61,7 +61,7 @@ class SelfAttention(nn.Module):
             # sequence-parallel: x holds this device's sequence block; K/V
             # stream around the ring (full-mask attention; padding masks
             # would need a gathered mask — use full blocks under SP)
-            y = ring_attention(q, k, v, seq_axis)
+            y = ring_attention(q, k, v, seq_axis, impl=c.attention_impl)
         else:
             from autodist_tpu.ops.pallas.flash_attention import (
                 flash_attention, use_flash)

@@ -93,7 +93,8 @@ class CausalSelfAttention(nn.Module):
         elif seq_axis is not None:
             # causal masking over GLOBAL positions while K/V blocks stream
             # around the seq ring
-            y = ring_attention(q, k, v, seq_axis, causal=True)
+            y = ring_attention(q, k, v, seq_axis, causal=True,
+                               impl=c.attention_impl)
         elif use_flash(c.attention_impl):
             y = flash_attention(q, k, v, causal=True)
         else:

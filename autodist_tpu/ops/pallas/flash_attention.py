@@ -88,20 +88,41 @@ def _xla_attention(q, k, v, causal, kv_mask, sm_scale):
 
 
 def _scores(q_ref, k_ref, bias_ref, i, j, *, sm_scale, causal,
-            block_q, block_k):
-    """Masked f32 score block (bq, bk); shared by fwd and both bwd kernels
-    so recomputation matches the forward bit-for-bit."""
+            block_q, block_k, q_off=0, k_off=0):
+    """Masked f32 score block (bq, bk); shared by the fwd, ring-update and
+    both bwd kernels so recomputation matches the forward bit-for-bit.
+    ``q_off``/``k_off`` shift the causal mask to GLOBAL positions (the
+    ring-attention case); ``bias_ref=None`` skips the key-padding bias."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale
-    s = s + bias_ref[0][None, :]
+    if bias_ref is not None:
+        s = s + bias_ref[0][None, :]
     if causal:
-        rows = i * block_q + jax.lax.broadcasted_iota(
+        rows = q_off + i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        cols = j * block_k + jax.lax.broadcasted_iota(
+        cols = k_off + j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(rows >= cols, s, _NEG_INF)
     return s
+
+
+def _online_update(s, v_ref, m_scr, l_scr, acc_scr):
+    """One online-softmax accumulation step over a score block — the single
+    shared implementation for the fwd kernel and the ring block-update
+    kernel (bit-exactness between them is asserted in the dryrun)."""
+    m_prev = m_scr[:, :1]                          # (bq, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                         # (bq, bk)
+    corr = jnp.exp(m_prev - m_new)                 # (bq, 1)
+    l_scr[:] = jnp.broadcast_to(
+        l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
+        l_scr.shape)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    pv = jax.lax.dot_general(                      # (bq, D) f32
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_scr[:] = acc_scr[:] * corr + pv
 
 
 # ---------------------------------------------------------------- forward --
@@ -125,18 +146,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     def _():
         s = _scores(q_ref, k_ref, bias_ref, i, j, sm_scale=sm_scale,
                     causal=causal, block_q=block_q, block_k=block_k)
-        m_prev = m_scr[:, :1]                          # (bq, 1)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                         # (bq, bk)
-        corr = jnp.exp(m_prev - m_new)                 # (bq, 1)
-        l_scr[:] = jnp.broadcast_to(
-            l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True),
-            l_scr.shape)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        pv = jax.lax.dot_general(                      # (bq, D) f32
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        acc_scr[:] = acc_scr[:] * corr + pv
+        _online_update(s, v_ref, m_scr, l_scr, acc_scr)
 
     @pl.when(j == num_k - 1)
     def _():
@@ -199,23 +209,25 @@ def _flash_fwd(q, k, v, bias, h, sm_scale, causal, block_q, block_k,
 
 # --------------------------------------------------------------- backward --
 
-def _dkdv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                 dk_ref, dv_ref, dk_scr, dv_scr,
+def _dkdv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                 lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                  *, sm_scale, causal, block_q, block_k, num_q):
     j, i = pl.program_id(1), pl.program_id(2)      # k-block outer, q inner
+    q_off, k_off = qoff_ref[0], koff_ref[0]
 
     @pl.when(i == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    visible = (i + 1) * block_q - 1 >= j * block_k
-    should_compute = (not causal) or visible
+    last_q = q_off + (i + 1) * block_q - 1
+    should_compute = jnp.logical_or(not causal, last_q >= k_off + j * block_k)
 
     @pl.when(should_compute)
     def _():
         s = _scores(q_ref, k_ref, bias_ref, i, j, sm_scale=sm_scale,
-                    causal=causal, block_q=block_q, block_k=block_k)
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    q_off=q_off, k_off=k_off)
         p = jnp.exp(s - lse_ref[0][:, None])           # (bq, bk)
         do = do_ref[0].astype(jnp.float32)             # (bq, D)
         dv_scr[:] += jax.lax.dot_general(              # p^T @ dO -> (bk, D)
@@ -235,22 +247,24 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr,
+def _dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+               lse_ref, delta_ref, dq_ref, dq_scr,
                *, sm_scale, causal, block_q, block_k, num_k):
     i, j = pl.program_id(1), pl.program_id(2)      # q-block outer, k inner
+    q_off, k_off = qoff_ref[0], koff_ref[0]
 
     @pl.when(j == 0)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    visible = (i + 1) * block_q - 1 >= j * block_k
-    should_compute = (not causal) or visible
+    last_q = q_off + (i + 1) * block_q - 1
+    should_compute = jnp.logical_or(not causal, last_q >= k_off + j * block_k)
 
     @pl.when(should_compute)
     def _():
         s = _scores(q_ref, k_ref, bias_ref, i, j, sm_scale=sm_scale,
-                    causal=causal, block_q=block_q, block_k=block_k)
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    q_off=q_off, k_off=k_off)
         p = jnp.exp(s - lse_ref[0][:, None])
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
@@ -266,57 +280,194 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, bias, out, lse, do, h, sm_scale, causal,
-               block_q, block_k, interpret):
+def _offsets(q_off, k_off):
+    return (jnp.asarray(q_off, jnp.int32).reshape(1),
+            jnp.asarray(k_off, jnp.int32).reshape(1))
+
+
+def _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
+             block_q, block_k, interpret, q_off=0, k_off=0):
+    """dq for one (q, k-block) pair; offsets place the blocks globally."""
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
-    # delta_r = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-
-    qspec = pl.BlockSpec((1, block_q, d), lambda b, x, y: (b, x, 0))
-    row = pl.BlockSpec((1, block_q), lambda b, x, y: (b, x))
-
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k=nk),
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, x, y, *_: (b, x, 0))
+    row = pl.BlockSpec((1, block_q), lambda b, x, y, *_: (b, x))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
         grid=(bh, nq, nk),
         in_specs=[
             qspec,
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_k), lambda b, i, j, *_: (b // h, j)),
             qspec, row, row,
         ],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+    )
+    qo, ko = _offsets(q_off, k_off)
+    return pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k=nk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, bias, do, lse, delta)
+    )(qo, ko, q, k, v, bias, do, lse, delta)
 
+
+def _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
+               block_q, block_k, interpret, q_off=0, k_off=0):
+    """(dk, dv) for one k-block from all local q blocks."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
     # k-block outer, q-block inner: grid indices are (b, j, i)
-    qspec_i = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    row_i = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
-    kspec_j = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkdv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q=nq),
+    qspec_i = pl.BlockSpec((1, block_q, d), lambda b, j, i, *_: (b, i, 0))
+    row_i = pl.BlockSpec((1, block_q), lambda b, j, i, *_: (b, i))
+    kspec_j = pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
         grid=(bh, nk, nq),
         in_specs=[qspec_i, kspec_j, kspec_j,
-                  pl.BlockSpec((1, block_k), lambda b, j, i: (b // h, j)),
+                  pl.BlockSpec((1, block_k), lambda b, j, i, *_: (b // h, j)),
                   qspec_i, row_i, row_i],
         out_specs=[kspec_j, kspec_j],
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
+    )
+    qo, ko = _offsets(q_off, k_off)
+    return pl.pallas_call(
+        functools.partial(_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=nq),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, bias, do, lse, delta)
+    )(qo, ko, q, k, v, bias, do, lse, delta)
+
+
+def _flash_bwd(q, k, v, bias, out, lse, do, h, sm_scale, causal,
+               block_q, block_k, interpret):
+    # delta_r = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dq = _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
+                  block_q, block_k, interpret)
+    dk, dv = _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
+                        block_q, block_k, interpret)
     return dq, dk, dv
+
+
+# ------------------------------------------------- ring-attention carry op --
+
+def _block_update_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                         m_in_ref, l_in_ref, o_in_ref,
+                         m_out_ref, l_out_ref, o_out_ref,
+                         m_scr, l_scr, acc_scr,
+                         *, sm_scale, causal, block_q, block_k, num_k):
+    """One ring-attention step: fold a remote K/V block into the running
+    (m, l, o) online-softmax carry.  Same tiling as the fwd kernel, but the
+    accumulator state enters and leaves through HBM (it is a lax.scan carry
+    in ``parallel/ring_attention.py``), and causal masking is over GLOBAL
+    positions (q_off / k_off scalars = ring block starts)."""
+    i, j = pl.program_id(1), pl.program_id(2)
+    q_off, k_off = qoff_ref[0], koff_ref[0]
+
+    @pl.when(j == 0)
+    def _():
+        # clamp at the floor: the XLA ring path seeds m with -inf, under
+        # which exp(m_prev - m_new) would NaN at the first real block
+        m_scr[:] = jnp.broadcast_to(
+            jnp.maximum(m_in_ref[0][:, None], _M_FLOOR), m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_in_ref[0][:, None], l_scr.shape)
+        acc_scr[:] = o_in_ref[0].astype(jnp.float32)
+
+    last_q = q_off + (i + 1) * block_q - 1
+    should_compute = jnp.logical_or(not causal, last_q >= k_off + j * block_k)
+
+    @pl.when(should_compute)
+    def _():
+        s = _scores(q_ref, k_ref, None, i, j, sm_scale=sm_scale,
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    q_off=q_off, k_off=k_off)
+        _online_update(s, v_ref, m_scr, l_scr, acc_scr)
+
+    @pl.when(j == num_k - 1)
+    def _():
+        m_out_ref[0] = m_scr[:, 0]
+        l_out_ref[0] = l_scr[:, 0]
+        o_out_ref[0] = acc_scr[:]
+
+
+def flash_block_update(q, k, v, m, l, o, q_off, k_off, causal=False,
+                       sm_scale=None, block_q=DEFAULT_BLOCK_Q,
+                       block_k=DEFAULT_BLOCK_K, interpret=None):
+    """Flash-tiled online-softmax block update for ring attention.
+
+    Args (all per-device local, inside shard_map):
+      q: (BH, Sq, D); k, v: (BH, Sk, D) — the K/V block currently streaming
+        through this device; m, l: (BH, Sq) f32 running max / denominator;
+      o: (BH, Sq, D) f32 UNNORMALIZED output accumulator;
+      q_off, k_off: traced int32 global start positions of the q block and
+        this ring step's K/V block (causal masks global positions).
+
+    Returns updated (m, l, o).  Returns None when the shapes cannot be
+    tiled for the compiled kernel — caller falls back to the XLA update.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    align = 1 if interpret else 128
+    bq = _pick_block(sq, block_q, align)
+    bk = _pick_block(sk, block_k, align)
+    if not bq or not bk:
+        return None
+    nq, nk = sq // bq, sk // bk
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j, *_: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j, *_: (b, i)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq), lambda b, i, j, *_: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j, *_: (b, i)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
+        ],
+        scratch_shapes=_fwd_scratch(bq, d),
+    )
+    kern = functools.partial(
+        _block_update_kernel, sm_scale=float(sm_scale), causal=bool(causal),
+        block_q=bq, block_k=bk, num_k=nk)
+    qo = jnp.asarray(q_off, jnp.int32).reshape(1)
+    ko = jnp.asarray(k_off, jnp.int32).reshape(1)
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        ],
+        compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qo, ko, q, k, v, m, l, o.astype(jnp.float32))
 
 
 # ------------------------------------------------------------- public API --

@@ -16,6 +16,8 @@ MXU matmuls at the cost of two all_to_alls.
 
 All functions run inside ``shard_map`` with the sequence axis sharded.
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -35,16 +37,136 @@ def _online_block(q, k_blk, v_blk, bias_blk, m, l, o, scale):
     return m_new, l_new, o_new
 
 
-def ring_attention(q, k, v, axis_name, causal=False):
+@functools.lru_cache(maxsize=32)
+def _make_ring_flash(axis_name, causal, b, h, sq, d, bq, bk, scale,
+                     interpret):
+    """Ring attention with the Pallas flash kernels doing the per-step block
+    math: fwd folds each visiting K/V block into the (m, l, o) carry via
+    ``flash_block_update`` (scores never leave VMEM); bwd is a second ring
+    pass — each device adds its local (dk, dv) contribution to the visiting
+    block's gradient, which travels the ring WITH the block and arrives home
+    fully summed after R hops, while dq accumulates locally.  Everything is
+    position-offset-aware so the causal mask is over GLOBAL positions."""
+    from autodist_tpu.ops.pallas import flash_attention as F
+
+    bh = b * h
+
+    def _ring(body, carry, r):
+        return jax.lax.scan(body, carry, jnp.arange(r))
+
+    @jax.custom_vjp
+    def attend(qf, kf, vf):
+        out, _ = _fwd(qf, kf, vf)
+        return out
+
+    def _fwd(qf, kf, vf):
+        r = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        q_off = idx * sq
+        perm = [(i, (i + 1) % r) for i in range(r)]
+        m0 = jnp.full((bh, sq), F._M_FLOOR, jnp.float32)
+        l0 = jnp.zeros((bh, sq), jnp.float32)
+        o0 = jnp.zeros((bh, sq, d), jnp.float32)
+
+        def body(carry, step):
+            k_blk, v_blk, m, l, o = carry
+            blk = jnp.mod(idx - step, r)
+            m, l, o = F.flash_block_update(
+                qf, k_blk, v_blk, m, l, o, q_off, blk * sq, causal=causal,
+                sm_scale=scale, block_q=bq, block_k=bk, interpret=interpret)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            return (k_blk, v_blk, m, l, o), None
+
+        (kf, vf, m, l, o), _ = _ring(body, (kf, vf, m0, l0, o0), r)
+        denom = jnp.where(l == 0.0, 1.0, l)
+        out = (o / denom[..., None]).astype(qf.dtype)
+        lse = m + jnp.log(denom)
+        return out, lse
+
+    def fwd(qf, kf, vf):
+        out, lse = _fwd(qf, kf, vf)
+        return out, (qf, kf, vf, out, lse)
+
+    def bwd(res, do):
+        qf, kf, vf, out, lse = res
+        r = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        q_off = idx * sq
+        perm = [(i, (i + 1) % r) for i in range(r)]
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
+        bias = jnp.zeros((b, sq), jnp.float32)
+        args = dict(sm_scale=scale, causal=causal, block_q=bq, block_k=bk,
+                    interpret=interpret)
+
+        def body(carry, step):
+            k_blk, v_blk, dk, dv, dq = carry
+            blk = jnp.mod(idx - step, r)
+            k_off = blk * sq
+            dq_p = F._dq_call(qf, k_blk, v_blk, bias, do, lse, delta, h,
+                              q_off=q_off, k_off=k_off, **args)
+            dk_p, dv_p = F._dkdv_call(qf, k_blk, v_blk, bias, do, lse,
+                                      delta, h, q_off=q_off, k_off=k_off,
+                                      **args)
+            dq = dq + dq_p.astype(jnp.float32)
+            dk = dk + dk_p.astype(jnp.float32)
+            dv = dv + dv_p.astype(jnp.float32)
+            # gradients travel the ring WITH their K/V block
+            k_blk, v_blk, dk, dv = (jax.lax.ppermute(t, axis_name, perm)
+                                    for t in (k_blk, v_blk, dk, dv))
+            return (k_blk, v_blk, dk, dv, dq), None
+
+        z = jnp.zeros((bh, sq, d), jnp.float32)
+        (_, _, dk, dv, dq), _ = _ring(body, (kf, vf, z, z, z), r)
+        return (dq.astype(qf.dtype), dk.astype(kf.dtype),
+                dv.astype(vf.dtype))
+
+    attend.defvjp(fwd, bwd)
+    return attend
+
+
+def _ring_flash(q, k, v, axis_name, causal):
+    """Flash-kernel ring path; None when the shapes cannot be tiled (caller
+    falls back to the XLA block update)."""
+    from autodist_tpu.ops.pallas import flash_attention as F
+
+    interpret = not F._on_tpu()
+    B, Sq, H, D = q.shape
+    align = 1 if interpret else 128
+    bq = F._pick_block(Sq, F.DEFAULT_BLOCK_Q, align)
+    bk = F._pick_block(Sq, F.DEFAULT_BLOCK_K, align)
+    if not bq or not bk:
+        return None
+    scale = 1.0 / (D ** 0.5)
+
+    def fold(t):
+        return t.transpose(0, 2, 1, 3).reshape(B * H, t.shape[1], D)
+
+    attend = _make_ring_flash(axis_name, bool(causal), B, H, Sq, D, bq, bk,
+                              float(scale), interpret)
+    out = attend(fold(q), fold(k), fold(v))
+    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, impl="auto"):
     """Blockwise ring attention.
 
     Args:
       q, k, v: local blocks (B, S_local, H, D) — the sequence dim is sharded
         over `axis_name` (device i holds positions [i*S_local, (i+1)*S_local)).
       causal: apply a causal mask over *global* positions.
+      impl: "auto" (flash kernels on TPU, XLA elsewhere) | "flash" | "xla" —
+        the per-step block math; the ring schedule is identical.
 
     Returns the local attention output block (B, S_local, H, D).
     """
+    from autodist_tpu.ops.pallas.flash_attention import use_flash
+
+    if use_flash(impl):
+        out = _ring_flash(q, k, v, axis_name, causal)
+        if out is not None:
+            return out
     R = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape

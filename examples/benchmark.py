@@ -36,7 +36,7 @@ def build(model_name, seq_len, image_size):
                     "label": r.randint(0, 1000, B)}
 
         return dict(loss_fn=loss_fn, params=params, mutable_state=state,
-                    sparse_vars=None, has_rng=False,
+                    sparse_vars=None, has_rng=False, cfg=None,
                     optimizer=train_lib.sgd_momentum(0.1), batch_fn=batch_fn)
     if model_name in ("bert_base", "bert_large"):
         cfg = BERT_BASE if model_name == "bert_base" else BERT_LARGE
@@ -52,7 +52,7 @@ def build(model_name, seq_len, image_size):
             }
 
         return dict(loss_fn=loss_fn, params=params, mutable_state=None,
-                    sparse_vars=sparse, has_rng=True,
+                    sparse_vars=sparse, has_rng=True, cfg=cfg,
                     optimizer=optax.adamw(1e-4), batch_fn=batch_fn)
     if model_name == "ncf":
         from autodist_tpu.models import train_lib as tl
@@ -66,7 +66,7 @@ def build(model_name, seq_len, image_size):
                     "label": (r.rand(B) < 0.5).astype(np.float32)}
 
         return dict(loss_fn=loss_fn, params=params, mutable_state=None,
-                    sparse_vars=sparse, has_rng=False,
+                    sparse_vars=sparse, has_rng=False, cfg=cfg,
                     optimizer=optax.adam(1e-3), batch_fn=batch_fn)
     if model_name in ("gpt_small", "gpt_tiny"):
         from autodist_tpu.models import GPT_SMALL, GPT_TINY
@@ -79,7 +79,7 @@ def build(model_name, seq_len, image_size):
             return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
 
         return dict(loss_fn=loss_fn, params=params, mutable_state=None,
-                    sparse_vars=sparse, has_rng=True,
+                    sparse_vars=sparse, has_rng=True, cfg=cfg,
                     optimizer=optax.adamw(1e-4), batch_fn=batch_fn)
     if model_name == "lm1b":
         from autodist_tpu.models import train_lib as tl
@@ -92,19 +92,54 @@ def build(model_name, seq_len, image_size):
                     "targets": r.randint(0, cfg.vocab_size, (B, seq_len)).astype(np.int32)}
 
         return dict(loss_fn=loss_fn, params=params, mutable_state=None,
-                    sparse_vars=sparse, has_rng=False,
+                    sparse_vars=sparse, has_rng=False, cfg=cfg,
                     optimizer=optax.adagrad(0.2), batch_fn=batch_fn)
     raise SystemExit(f"unknown model {model_name}")
 
 
-# rough forward FLOPs per example for the cost model's compute term
-# (ranking needs relative comm cost; compute is strategy-invariant)
+# forward FLOPs per example for conv families (standard 2-FLOPs-per-MAC
+# counts at 224px); transformer/LM families are computed from the actual
+# parameter count + seq_len by _fwd_flops_per_example (the table's fixed
+# seq=128 guesses under-counted attention and ignored --seq_len)
 FLOPS_PER_EXAMPLE = {
     "resnet50": 4.1e9, "resnet101": 7.8e9, "vgg16": 15.5e9,
     "densenet121": 2.9e9, "inception_v3": 5.7e9,
-    "bert_base": 2.8e10, "bert_large": 9.8e10,  # ~2 * params * seq_len(128)
-    "gpt_small": 3.2e10,                        # ~2 * 124M * seq_len(128)
 }
+
+
+def _matmul_param_count(params, exclude=()):
+    """Total size of leaves, skipping names matching ``exclude`` — position/
+    type embedding tables do no matmul work (pure lookups)."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = jax.tree_util.keystr(path)
+        if any(e in name for e in exclude):
+            continue
+        total += int(np.prod(leaf.shape))
+    return total
+
+
+def _fwd_flops_per_example(model_name, params, seq_len, cfg=None):
+    """Forward FLOPs/example.  Transformers: 2*N_matmul*S for the dense
+    matmuls (the tied input-embedding table counts once — its lookup is
+    free, its output projection is a matmul) + 4*L*S^2*hidden for the
+    QK^T / PV attention matmuls.  MFU numerator = 3x this (bwd ~ 2x fwd)."""
+    if model_name in FLOPS_PER_EXAMPLE:
+        return FLOPS_PER_EXAMPLE[model_name]
+    if model_name in ("bert_base", "bert_large"):
+        n = _matmul_param_count(params, ("position_embeddings",
+                                        "type_embeddings"))
+        return 2.0 * n * seq_len + 4.0 * cfg.num_layers * seq_len ** 2 * cfg.hidden_size
+    if model_name in ("gpt_small", "gpt_tiny"):
+        n = _matmul_param_count(params, ("wpe",))
+        # causal: the S^2 attention matmuls do half the work
+        return 2.0 * n * seq_len + 2.0 * cfg.num_layers * seq_len ** 2 * cfg.hidden_size
+    if model_name == "lm1b":
+        # the untied input table is lookup-only (the output head is a
+        # separate Dense) — exclude it like the other lookup tables
+        n = _matmul_param_count(params, ("embedding",))
+        return 2.0 * n * seq_len
+    return None
 
 
 def _real_pipeline(args, cap, B, sess):
@@ -178,7 +213,8 @@ def run_one(args, strategy_name, cap, n_chips):
                                 warmup=args.warmup)
     eps = B / record.step_time_s
     extra = ""
-    fpe = FLOPS_PER_EXAMPLE.get(args.model)
+    fpe = _fwd_flops_per_example(args.model, cap["params"], args.seq_len,
+                                 cap.get("cfg"))
     if fpe:
         from autodist_tpu.utils.timing import peak_flops
 
@@ -235,7 +271,9 @@ def sweep(args):
         eps, record, sess = run_one(args, name, cap, n_chips)
         measured[name] = record.step_time_s
         est = estimate(sess._t.strategy, sess._t.model_item, _spec(n_chips),
-                       flops_per_example=FLOPS_PER_EXAMPLE.get(args.model, 0.0),
+                       flops_per_example=_fwd_flops_per_example(
+                           args.model, cap["params"], args.seq_len,
+                           cap.get("cfg")) or 0.0,
                        batch_per_chip=args.batch_per_chip)
         estimated[name] = est.total_s
         pairs.append((est, record.step_time_s))

@@ -24,19 +24,43 @@ def _reference(q, k, v, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_attention_matches_full(causal):
+@pytest.mark.parametrize("impl", ["xla", "flash"])
+def test_ring_attention_matches_full(causal, impl):
     mesh = build_mesh()
     q, k, v = _qkv()
     want = _reference(q, k, v, causal)
 
     got = jax.jit(jax.shard_map(
-        lambda q_, k_, v_: ring_attention(q_, k_, v_, "replica", causal=causal),
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, "replica",
+                                          causal=causal, impl=impl),
         mesh=mesh,
         in_specs=(jax.P(None, "replica"),) * 3,
         out_specs=jax.P(None, "replica"),
         check_vma=False,
     ))(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_gradients_match_xla_ring(causal):
+    """The flash ring bwd (second ring pass: dk/dv travel with their block,
+    dq accumulates locally) must match differentiating the XLA ring."""
+    mesh = build_mesh()
+    q, k, v = _qkv(B=1, S=32, H=2)
+
+    def make(impl):
+        f = jax.shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, "replica",
+                                              causal=causal, impl=impl),
+            mesh=mesh, in_specs=(jax.P(None, "replica"),) * 3,
+            out_specs=jax.P(None, "replica"), check_vma=False)
+        return jax.grad(lambda q_, k_, v_: jnp.sum(jnp.sin(f(q_, k_, v_))),
+                        argnums=(0, 1, 2))
+
+    g_flash = make("flash")(q, k, v)
+    g_xla = make("xla")(q, k, v)
+    for a, b in zip(g_flash, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
 @pytest.mark.parametrize("causal", [False, True])
