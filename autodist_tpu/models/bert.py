@@ -31,6 +31,9 @@ class BertConfig:
     # "auto": Pallas flash attention on TPU, XLA elsewhere; "flash"/"xla"
     # force (flash runs in interpreter mode off-TPU — the tests' CPU path)
     attention_impl: str = "auto"
+    # rematerialize each layer's activations in the backward pass (peak
+    # activation memory O(S*hidden) instead of O(layers*S*hidden))
+    remat: bool = False
 
 
 BERT_BASE = BertConfig()
@@ -128,9 +131,11 @@ class Bert(nn.Module):
         x = x + pos[None] + jnp.take(type_emb, token_type_ids, axis=0)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_emb")(x.astype(c.dtype))
         x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
+        layer_cls = (nn.remat(TransformerLayer, static_argnums=(3,))
+                     if c.remat else TransformerLayer)
         for i in range(c.num_layers):
-            x = TransformerLayer(c, name=f"layer_{i}")(x, attention_mask,
-                                                       deterministic)
+            x = layer_cls(c, name=f"layer_{i}")(x, attention_mask,
+                                                deterministic)
         return x, word_emb
 
 

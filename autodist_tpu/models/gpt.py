@@ -33,6 +33,14 @@ class GPTConfig:
     # "auto": Pallas flash attention on TPU, XLA elsewhere; "flash"/"xla"
     # force (flash runs in interpreter mode off-TPU — the tests' CPU path)
     attention_impl: str = "auto"
+    # rematerialize each block's activations in the backward pass: peak
+    # activation memory drops from O(layers * S * hidden) to O(S * hidden)
+    # (+ one extra forward of FLOPs) — the long-context/deep-model lever
+    remat: bool = False
+    # grouped-query attention: kv heads < query heads (0 = MHA).  Shrinks
+    # the decode KV cache by num_heads/num_kv_heads x; the flash kernel
+    # reads shared K/V blocks straight from HBM (no repeat materialized)
+    num_kv_heads: int = 0
 
 
 GPT_SMALL = GPTConfig()
@@ -52,15 +60,31 @@ class CausalSelfAttention(nn.Module):
 
         c = self.config
         head_dim = c.hidden_size // c.num_heads
-        qkv = nn.Dense(3 * c.hidden_size, dtype=c.dtype, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        kv_heads = c.num_kv_heads or c.num_heads
+        if c.num_heads % kv_heads:
+            raise ValueError(f"num_heads {c.num_heads} not a multiple of "
+                             f"num_kv_heads {kv_heads}")
+        group = c.num_heads // kv_heads
+        kv_dim = kv_heads * head_dim
+        qkv = nn.Dense(c.hidden_size + 2 * kv_dim, dtype=c.dtype,
+                       name="qkv")(x)
+        q = qkv[..., :c.hidden_size]
+        k = qkv[..., c.hidden_size:c.hidden_size + kv_dim]
+        v = qkv[..., c.hidden_size + kv_dim:]
         B, S = x.shape[0], x.shape[1]
-        shape = (B, S, c.num_heads, head_dim)
-        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q = q.reshape(B, S, c.num_heads, head_dim)
+        k = k.reshape(B, S, kv_heads, head_dim)
+        v = v.reshape(B, S, kv_heads, head_dim)
+
+        def repeat_kv(t):   # GQA -> MHA for paths without native support
+            return jnp.repeat(t, group, axis=2) if group > 1 else t
+
         seq_axis = current_seq_axis()
         if self.decode:
             # autoregressive KV cache (flax "cache" collection): x is the
-            # single new token (S == 1); attend over all cached positions
+            # single new token (S == 1); attend over all cached positions.
+            # The cache stores KV HEADS only — the num_heads/kv_heads
+            # memory saving is the point of GQA at decode time
             if seq_axis is not None:
                 raise NotImplementedError("decode under sequence parallelism")
             if S != 1:
@@ -69,10 +93,10 @@ class CausalSelfAttention(nn.Module):
             # already exists, so init leaves counters at zero
             cache_initialized = self.has_variable("cache", "k")
             k_cache = self.variable("cache", "k", jnp.zeros,
-                                    (B, c.max_position, c.num_heads, head_dim),
+                                    (B, c.max_position, kv_heads, head_dim),
                                     c.dtype)
             v_cache = self.variable("cache", "v", jnp.zeros,
-                                    (B, c.max_position, c.num_heads, head_dim),
+                                    (B, c.max_position, kv_heads, head_dim),
                                     c.dtype)
             idx = self.variable("cache", "idx",
                                 lambda: jnp.zeros((), jnp.int32))
@@ -87,21 +111,25 @@ class CausalSelfAttention(nn.Module):
                 bias = jnp.where(visible, 0.0,
                                  -1e9)[None, None, None].astype(c.dtype)
                 y = jax.nn.dot_product_attention(
-                    q, k_cache.value, v_cache.value, bias=bias)
+                    q, repeat_kv(k_cache.value), repeat_kv(v_cache.value),
+                    bias=bias)
             else:  # init trace: shape-correct single-token attention
-                y = jax.nn.dot_product_attention(q, k, v)
+                y = jax.nn.dot_product_attention(q, repeat_kv(k),
+                                                 repeat_kv(v))
         elif seq_axis is not None:
             # causal masking over GLOBAL positions while K/V blocks stream
-            # around the seq ring
-            y = ring_attention(q, k, v, seq_axis, causal=True,
-                               impl=c.attention_impl)
+            # around the seq ring (ring streams full-head blocks)
+            y = ring_attention(q, repeat_kv(k), repeat_kv(v), seq_axis,
+                               causal=True, impl=c.attention_impl)
         elif use_flash(c.attention_impl):
+            # the kernel handles GQA natively (shared-block index maps)
             y = flash_attention(q, k, v, causal=True)
         else:
             pos = jnp.arange(S)
             bias = jnp.where(pos[:, None] >= pos[None, :], 0.0,
                              -1e9)[None, None].astype(c.dtype)
-            y = jax.nn.dot_product_attention(q, k, v, bias=bias)
+            y = jax.nn.dot_product_attention(q, repeat_kv(k), repeat_kv(v),
+                                             bias=bias)
         y = y.reshape(B, S, c.hidden_size)
         return nn.Dense(c.hidden_size, dtype=c.dtype, name="out")(y)
 
@@ -159,8 +187,12 @@ class GPT(nn.Module):
             x = x + jax.lax.dynamic_slice_in_dim(wpe, pos0, S)[None]
         x = nn.Dropout(c.dropout_rate)(x.astype(c.dtype),
                                        deterministic=deterministic)
+        block_cls = GPTBlock
+        if c.remat and not self.decode:   # decode caches are tiny; skip
+            block_cls = nn.remat(GPTBlock, static_argnums=(2,))
         for i in range(c.num_layers):
-            x = GPTBlock(c, decode=self.decode, name=f"h_{i}")(x, deterministic)
+            x = block_cls(c, decode=self.decode, name=f"h_{i}")(
+                x, deterministic)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
         return x.astype(jnp.float32) @ wte.T
 

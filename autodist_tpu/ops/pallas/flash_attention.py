@@ -57,6 +57,13 @@ def _on_tpu():
     return jax.default_backend() == "tpu"
 
 
+def _kv_index(b, h, group):
+    """Fold index of the K/V head shared by q-fold index ``b`` (GQA): the
+    q fold is batch-major over h query heads, the kv fold over h//group
+    kv heads; query head hq reads kv head hq // group."""
+    return (b // h) * (h // group) + (b % h) // group
+
+
 def use_flash(impl):
     """Resolve a model config's ``attention_impl`` value at trace time:
     "auto" -> this kernel on TPU, the XLA path elsewhere."""
@@ -175,8 +182,10 @@ def _tpu_params(dimension_semantics):
 
 
 def _flash_fwd(q, k, v, bias, h, sm_scale, causal, block_q, block_k,
-               interpret):
-    """q,k,v: (BH, S, D); bias: (B, Sk) f32.  Returns (out, lse)."""
+               interpret, group=1):
+    """q: (B*H, S, D); k, v: (B*H//group, S, D) — GQA reads the shared K/V
+    block straight from HBM via the index map, never materializing repeats;
+    bias: (B, Sk) f32.  Returns (out, lse)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
@@ -188,8 +197,10 @@ def _flash_fwd(q, k, v, bias, h, sm_scale, causal, block_q, block_k,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (_kv_index(b, h, group), j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (_kv_index(b, h, group), j, 0)),
             pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j)),
         ],
         out_specs=[
@@ -286,7 +297,7 @@ def _offsets(q_off, k_off):
 
 
 def _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
-             block_q, block_k, interpret, q_off=0, k_off=0):
+             block_q, block_k, interpret, q_off=0, k_off=0, group=1):
     """dq for one (q, k-block) pair; offsets place the blocks globally."""
     from jax.experimental.pallas import tpu as pltpu
 
@@ -300,8 +311,10 @@ def _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
         grid=(bh, nq, nk),
         in_specs=[
             qspec,
-            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, *_: (_kv_index(b, h, group), j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j, *_: (_kv_index(b, h, group), j, 0)),
             pl.BlockSpec((1, block_k), lambda b, i, j, *_: (b // h, j)),
             qspec, row, row,
         ],
@@ -320,8 +333,10 @@ def _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
 
 
 def _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
-               block_q, block_k, interpret, q_off=0, k_off=0):
-    """(dk, dv) for one k-block from all local q blocks."""
+               block_q, block_k, interpret, q_off=0, k_off=0, group=1):
+    """(dk, dv) for one k-block from all local q blocks.  Under GQA the
+    outputs are PER-Q-HEAD (grid writes must not alias across the parallel
+    b dimension); the caller group-sums them down to the kv heads."""
     from jax.experimental.pallas import tpu as pltpu
 
     bh, sq, d = q.shape
@@ -330,14 +345,16 @@ def _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
     # k-block outer, q-block inner: grid indices are (b, j, i)
     qspec_i = pl.BlockSpec((1, block_q, d), lambda b, j, i, *_: (b, i, 0))
     row_i = pl.BlockSpec((1, block_q), lambda b, j, i, *_: (b, i))
-    kspec_j = pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0))
+    kspec_in = pl.BlockSpec((1, block_k, d),
+                            lambda b, j, i, *_: (_kv_index(b, h, group), j, 0))
+    kspec_out = pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(bh, nk, nq),
-        in_specs=[qspec_i, kspec_j, kspec_j,
+        in_specs=[qspec_i, kspec_in, kspec_in,
                   pl.BlockSpec((1, block_k), lambda b, j, i, *_: (b // h, j)),
                   qspec_i, row_i, row_i],
-        out_specs=[kspec_j, kspec_j],
+        out_specs=[kspec_out, kspec_out],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
     )
@@ -346,21 +363,32 @@ def _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
         functools.partial(_dkdv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q=nq),
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        # group > 1: per-q-head partials stay f32 so the cross-head group
+        # sum keeps the kernel's f32 accumulation (cast once, after)
+        out_shape=[jax.ShapeDtypeStruct(
+                       (bh, sk, d), jnp.float32 if group > 1 else k.dtype),
+                   jax.ShapeDtypeStruct(
+                       (bh, sk, d), jnp.float32 if group > 1 else v.dtype)],
         compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qo, ko, q, k, v, bias, do, lse, delta)
 
 
 def _flash_bwd(q, k, v, bias, out, lse, do, h, sm_scale, causal,
-               block_q, block_k, interpret):
+               block_q, block_k, interpret, group=1):
     # delta_r = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     dq = _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
-                  block_q, block_k, interpret)
+                  block_q, block_k, interpret, group=group)
     dk, dv = _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
-                        block_q, block_k, interpret)
+                        block_q, block_k, interpret, group=group)
+    if group > 1:   # per-q-head contributions -> sum each kv-head group
+        bh, sk, d = dk.shape
+        b = bh // h
+        dk = dk.reshape(b, h // group, group, sk, d).sum(2)
+        dv = dv.reshape(b, h // group, group, sk, d).sum(2)
+        dk = dk.reshape(b * (h // group), sk, d).astype(k.dtype)
+        dv = dv.reshape(b * (h // group), sk, d).astype(v.dtype)
     return dq, dk, dv
 
 
@@ -473,22 +501,23 @@ def flash_block_update(q, k, v, m, l, o, q_off, k_off, causal=False,
 # ------------------------------------------------------------- public API --
 
 @functools.lru_cache(maxsize=64)
-def _make_flash(h, sm_scale, causal, block_q, block_k, interpret):
+def _make_flash(h, sm_scale, causal, block_q, block_k, interpret, group=1):
     @jax.custom_vjp
     def attend(q, k, v, bias):
         out, _ = _flash_fwd(q, k, v, bias, h, sm_scale, causal,
-                            block_q, block_k, interpret)
+                            block_q, block_k, interpret, group=group)
         return out
 
     def fwd(q, k, v, bias):
         out, lse = _flash_fwd(q, k, v, bias, h, sm_scale, causal,
-                              block_q, block_k, interpret)
+                              block_q, block_k, interpret, group=group)
         return out, (q, k, v, bias, out, lse)
 
     def bwd(res, do):
         q, k, v, bias, out, lse = res
         dq, dk, dv = _flash_bwd(q, k, v, bias, out, lse, do, h, sm_scale,
-                                causal, block_q, block_k, interpret)
+                                causal, block_q, block_k, interpret,
+                                group=group)
         return dq, dk, dv, jnp.zeros_like(bias)
 
     attend.defvjp(fwd, bwd)
@@ -511,6 +540,10 @@ def flash_attention(q, k, v, causal=False, kv_mask=None, sm_scale=None,
         interpret = not _on_tpu()
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {h_kv}")
+    group = h // h_kv
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     # compiled Mosaic wants 128-lane-aligned blocks (the lse/bias specs put
@@ -519,16 +552,19 @@ def flash_attention(q, k, v, causal=False, kv_mask=None, sm_scale=None,
     bq = _pick_block(sq, block_q, align)
     bk = _pick_block(sk, block_k, align)
     if not bq or not bk:
+        if group > 1:
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         return _xla_attention(q, k, v, causal, kv_mask, sm_scale)
     if kv_mask is None:
         bias = jnp.zeros((b, sk), jnp.float32)
     else:
         bias = jnp.where(kv_mask, 0.0, _NEG_INF).astype(jnp.float32)
 
-    def fold(t):      # (B, S, H, D) -> (B*H, S, D)
-        return t.transpose(0, 2, 1, 3).reshape(b * h, t.shape[1], d)
+    def fold(t):      # (B, S, H', D) -> (B*H', S, D)
+        return t.transpose(0, 2, 1, 3).reshape(b * t.shape[2], t.shape[1], d)
 
     attend = _make_flash(h, float(sm_scale), bool(causal), bq, bk,
-                         bool(interpret))
+                         bool(interpret), group)
     out = attend(fold(q), fold(k), fold(v), bias)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
