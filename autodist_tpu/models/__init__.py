@@ -13,5 +13,8 @@ from autodist_tpu.models.bert import (  # noqa: F401
 from autodist_tpu.models.gpt import (  # noqa: F401
     GPT, GPT_SMALL, GPT_TINY, GPTConfig,
 )
+from autodist_tpu.models.llama import (  # noqa: F401
+    LLAMA_TINY, Llama, LlamaConfig,
+)
 from autodist_tpu.models.lm import LMConfig, LSTMBody, LSTMLM  # noqa: F401
 from autodist_tpu.models.ncf import NCFConfig, NeuMF  # noqa: F401

@@ -110,12 +110,12 @@ class CausalSelfAttention(nn.Module):
                 visible = (jnp.arange(c.max_position) <= t)
                 bias = jnp.where(visible, 0.0,
                                  -1e9)[None, None, None].astype(c.dtype)
+                # dot_product_attention broadcasts kv heads natively — the
+                # repeated cache is never materialized
                 y = jax.nn.dot_product_attention(
-                    q, repeat_kv(k_cache.value), repeat_kv(v_cache.value),
-                    bias=bias)
+                    q, k_cache.value, v_cache.value, bias=bias)
             else:  # init trace: shape-correct single-token attention
-                y = jax.nn.dot_product_attention(q, repeat_kv(k),
-                                                 repeat_kv(v))
+                y = jax.nn.dot_product_attention(q, k, v)
         elif seq_axis is not None:
             # causal masking over GLOBAL positions while K/V blocks stream
             # around the seq ring (ring streams full-head blocks)
@@ -128,8 +128,7 @@ class CausalSelfAttention(nn.Module):
             pos = jnp.arange(S)
             bias = jnp.where(pos[:, None] >= pos[None, :], 0.0,
                              -1e9)[None, None].astype(c.dtype)
-            y = jax.nn.dot_product_attention(q, repeat_kv(k), repeat_kv(v),
-                                             bias=bias)
+            y = jax.nn.dot_product_attention(q, k, v, bias=bias)
         y = y.reshape(B, S, c.hidden_size)
         return nn.Dense(c.hidden_size, dtype=c.dtype, name="out")(y)
 

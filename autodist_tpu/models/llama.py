@@ -1,0 +1,269 @@
+"""Llama-family decoder LM: RMSNorm + rotary positions + SwiGLU + GQA.
+
+Beyond the reference's model zoo (its families are BERT / imagenet
+convnets / NCF / LSTM-LM — ``/root/reference/examples/benchmark``): the
+modern decoder recipe, assembled from this framework's own substrate —
+the Pallas flash-attention kernel (``ops/pallas/flash_attention.py``),
+causal ring attention under a ``seq`` mesh axis (rotary phases use GLOBAL
+positions, so rotation happens before K blocks stream), grouped-query KV
+caches for decode, and per-block rematerialization.
+
+TPU-native choices mirror ``models/gpt.py``: bf16 activations / f32
+params, fused QKV projection, pre-norm blocks.
+"""
+import dataclasses
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.ops.pallas.flash_attention import flash_attention, use_flash
+from autodist_tpu.ops.sparse import embedding_lookup
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 4
+    intermediate_size: int = 2048   # SwiGLU hidden
+    max_position: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"    # see models/gpt.py
+    remat: bool = False
+
+
+LLAMA_TINY = LlamaConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=4, num_kv_heads=2, intermediate_size=128,
+                         max_position=128, dtype=jnp.float32)
+
+
+def rope(x, positions, theta=10000.0):
+    """Rotary position embedding over the last dim of (..., S, H, D):
+    rotate feature pairs (d, d + D/2) by position-dependent phases.
+    ``positions``: (S,) GLOBAL token positions (sequence-parallel blocks
+    pass their offset positions; decode passes the cache write index)."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(d_half, dtype=jnp.float32) / d_half)
+    ang = positions[:, None].astype(jnp.float32) * freqs[None]   # (S, D/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :d_half], x[..., d_half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        from autodist_tpu.parallel.context import (current_seq_axis,
+                                                   global_position_offset)
+        from autodist_tpu.parallel.ring_attention import ring_attention
+
+        c = self.config
+        head_dim = c.hidden_size // c.num_heads
+        if c.num_heads % c.num_kv_heads:
+            raise ValueError(f"num_heads {c.num_heads} not a multiple of "
+                             f"num_kv_heads {c.num_kv_heads}")
+        group = c.num_heads // c.num_kv_heads
+        kv_dim = c.num_kv_heads * head_dim
+        qkv = nn.Dense(c.hidden_size + 2 * kv_dim, use_bias=False,
+                       dtype=c.dtype, name="qkv")(x)
+        q = qkv[..., :c.hidden_size]
+        k = qkv[..., c.hidden_size:c.hidden_size + kv_dim]
+        v = qkv[..., c.hidden_size + kv_dim:]
+        B, S = x.shape[0], x.shape[1]
+        q = q.reshape(B, S, c.num_heads, head_dim)
+        k = k.reshape(B, S, c.num_kv_heads, head_dim)
+        v = v.reshape(B, S, c.num_kv_heads, head_dim)
+
+        def repeat_kv(t):
+            return jnp.repeat(t, group, axis=2) if group > 1 else t
+
+        seq_axis = current_seq_axis()
+        if self.decode:
+            if seq_axis is not None:
+                raise NotImplementedError("decode under sequence parallelism")
+            cache_initialized = self.has_variable("cache", "k")
+            k_cache = self.variable(
+                "cache", "k", jnp.zeros,
+                (B, c.max_position, c.num_kv_heads, head_dim), c.dtype)
+            v_cache = self.variable(
+                "cache", "v", jnp.zeros,
+                (B, c.max_position, c.num_kv_heads, head_dim), c.dtype)
+            idx = self.variable("cache", "idx",
+                                lambda: jnp.zeros((), jnp.int32))
+            if cache_initialized:
+                t = idx.value
+                pos = t[None].astype(jnp.int32)
+                q = rope(q, pos, c.rope_theta)
+                k = rope(k, pos, c.rope_theta)   # rotated BEFORE caching
+                k_cache.value = jax.lax.dynamic_update_slice_in_dim(
+                    k_cache.value, k.astype(c.dtype), t, axis=1)
+                v_cache.value = jax.lax.dynamic_update_slice_in_dim(
+                    v_cache.value, v.astype(c.dtype), t, axis=1)
+                idx.value = t + 1
+                visible = (jnp.arange(c.max_position) <= t)
+                bias = jnp.where(visible, 0.0,
+                                 -1e9)[None, None, None].astype(c.dtype)
+                # dot_product_attention broadcasts kv heads natively — the
+                # repeated cache is never materialized
+                y = jax.nn.dot_product_attention(
+                    q, k_cache.value, v_cache.value, bias=bias)
+            else:  # init trace
+                y = jax.nn.dot_product_attention(q, k, v)
+        else:
+            # GLOBAL positions: under a seq mesh axis this device's block
+            # starts at its ring offset, so rotary phases line up across
+            # devices and K blocks can stream already-rotated
+            pos0 = global_position_offset(S)
+            pos = pos0 + jnp.arange(S)
+            q = rope(q, pos, c.rope_theta)
+            k = rope(k, pos, c.rope_theta)
+            if seq_axis is not None:
+                y = ring_attention(q, repeat_kv(k), repeat_kv(v), seq_axis,
+                                   causal=True, impl=c.attention_impl)
+            elif use_flash(c.attention_impl):
+                y = flash_attention(q, k, v, causal=True)  # native GQA
+            else:
+                ar = jnp.arange(S)
+                bias = jnp.where(ar[:, None] >= ar[None, :], 0.0,
+                                 -1e9)[None, None].astype(c.dtype)
+                y = jax.nn.dot_product_attention(q, k, v, bias=bias)
+        y = y.reshape(B, S, c.hidden_size)
+        return nn.Dense(c.hidden_size, use_bias=False, dtype=c.dtype,
+                        name="out")(y)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        y = nn.RMSNorm(epsilon=c.norm_eps, dtype=c.dtype,
+                       name="attn_norm")(x)
+        x = x + LlamaAttention(c, decode=self.decode, name="attn")(y)
+        y = nn.RMSNorm(epsilon=c.norm_eps, dtype=c.dtype, name="mlp_norm")(x)
+        gate = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.dtype,
+                        name="gate")(y)
+        up = nn.Dense(c.intermediate_size, use_bias=False, dtype=c.dtype,
+                      name="up")(y)
+        y = nn.Dense(c.hidden_size, use_bias=False, dtype=c.dtype,
+                     name="down")(nn.silu(gate) * up)   # SwiGLU
+        return x + y
+
+
+class Llama(nn.Module):
+    """Next-token logits (B, S, V); ``decode=True`` = single-token
+    autoregressive mode with per-layer GQA KV caches."""
+
+    config: LlamaConfig
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, tokens):
+        c = self.config
+        emb = self.param("embed", nn.initializers.normal(0.02),
+                         (c.vocab_size, c.hidden_size), jnp.float32)
+        # sparse-sync path (Parallax routes it like the other LM tables);
+        # the output head is untied, so the lookup gradient stays sparse
+        x = embedding_lookup(emb, tokens, sync=True).astype(c.dtype)
+        block_cls = Llama._block_cls(c, self.decode)
+        for i in range(c.num_layers):
+            x = block_cls(c, decode=self.decode, name=f"l_{i}")(x)
+        x = nn.RMSNorm(epsilon=c.norm_eps, dtype=c.dtype, name="norm")(x)
+        head = self.param("lm_head", nn.initializers.normal(0.02),
+                          (c.hidden_size, c.vocab_size), jnp.float32)
+        return x.astype(jnp.float32) @ head
+
+    @staticmethod
+    def _block_cls(c, decode):
+        if c.remat and not decode:
+            return nn.remat(LlamaBlock)
+        return LlamaBlock
+
+
+@functools.lru_cache(maxsize=16)
+def _fresh_cache_shapes(config, B):
+    model = Llama(config, decode=True)
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                            jnp.zeros((B, 1), jnp.int32))["cache"]
+    return jax.tree.map(lambda s: (tuple(s.shape), s.dtype), shapes,
+                        is_leaf=lambda s: hasattr(s, "shape"))
+
+
+@functools.lru_cache(maxsize=16)
+def _make_rollout(config, B, total, temperature):
+    """Jitted whole-rollout scan, cached per (config, batch, TOTAL length)
+    — same executable-reuse contract as ``models/gpt.py:_make_rollout``
+    (the prompt length is a traced scalar, so variable-length prompts
+    share one compilation)."""
+    model = Llama(config, decode=True)
+
+    @jax.jit
+    def rollout(params, cache, buf0, prompt_len, rng):
+        def step(carry, t):
+            buf, cache, rng = carry
+            tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
+            logits, mut = model.apply({"params": params, "cache": cache},
+                                      tok, mutable=["cache"])
+            rng, sub = jax.random.split(rng)
+            if temperature > 0:
+                nxt = jax.random.categorical(sub, logits[:, 0] / temperature)
+            else:
+                nxt = jnp.argmax(logits[:, 0], axis=-1)
+            write_at = jnp.minimum(t + 1, total - 1)
+            write = jnp.where(            # prompt tokens stay authoritative
+                t + 1 < prompt_len,
+                jax.lax.dynamic_slice_in_dim(buf, write_at, 1, axis=1)[:, 0],
+                nxt.astype(jnp.int32))
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, write[:, None], write_at, axis=1)
+            return (buf, mut["cache"], rng), None
+
+        (buf, cache, rng), _ = jax.lax.scan(
+            step, (buf0, cache, rng), jnp.arange(total - 1))
+        return buf
+
+    return rollout
+
+
+def generate(config, params, prompt, max_new_tokens, temperature=0.0,
+             rng=None):
+    """Greedy/temperature sampling with per-layer GQA KV caches; one
+    forward per token through a jitted ``lax.scan`` rollout (compiled once
+    per (config, batch, total-length), mirroring ``models/gpt.py``)."""
+    import numpy as np
+
+    prompt = np.asarray(prompt, np.int32)
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    if total > config.max_position:
+        raise ValueError(f"{total} tokens exceed max_position")
+    cache = jax.tree.map(lambda sd: jnp.zeros(*sd),
+                         _fresh_cache_shapes(config, B),
+                         is_leaf=lambda x: isinstance(x, tuple))
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    buf0 = np.zeros((B, total), np.int32)
+    buf0[:, :P] = prompt
+    rollout = _make_rollout(config, B, total, float(temperature))
+    return rollout(params, cache, jnp.asarray(buf0), jnp.int32(P), rng)
+
+
+def llama_loss(logits, targets, mask=None):
+    """Same contract as ``models/gpt.py:gpt_loss``."""
+    from autodist_tpu.models.gpt import gpt_loss
+
+    return gpt_loss(logits, targets, mask)

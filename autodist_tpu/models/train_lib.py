@@ -98,6 +98,27 @@ def gpt_capture(config, seq_len, rng=None):
     return loss_fn, params, []
 
 
+def llama_capture(config, seq_len, rng=None):
+    """Init a Llama-family causal LM; returns (loss_fn, params, sparse_vars).
+
+    The input embedding is UNTIED (separate lm_head), so its gradient is
+    pure rows — it takes the sparse path (Parallax routes it like the
+    reference's IndexedSlices; PartitionedPS can shard the table).
+    """
+    from autodist_tpu.models.llama import Llama, llama_loss
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = Llama(config)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = model.init(rng, dummy)["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["tokens"])
+        return llama_loss(logits, batch["targets"], batch.get(BATCH_MASK_KEY))
+
+    return loss_fn, params, ["embed"]
+
+
 def lm_capture(config, seq_len, rng=None):
     """The embedding table is a TOP-LEVEL param (not flax-managed) so a
     PartitionedPS strategy can shard it end-to-end: the engine then hands

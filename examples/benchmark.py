@@ -68,18 +68,26 @@ def build(model_name, seq_len, image_size):
         return dict(loss_fn=loss_fn, params=params, mutable_state=None,
                     sparse_vars=sparse, has_rng=False, cfg=cfg,
                     optimizer=optax.adam(1e-3), batch_fn=batch_fn)
-    if model_name in ("gpt_small", "gpt_tiny"):
-        from autodist_tpu.models import GPT_SMALL, GPT_TINY
+    if model_name in ("gpt_small", "gpt_tiny", "llama_small", "llama_tiny"):
+        if model_name.startswith("gpt"):
+            from autodist_tpu.models import GPT_SMALL, GPT_TINY
 
-        cfg = GPT_SMALL if model_name == "gpt_small" else GPT_TINY
-        loss_fn, params, sparse = train_lib.gpt_capture(cfg, seq_len)
+            cfg = GPT_SMALL if model_name == "gpt_small" else GPT_TINY
+            loss_fn, params, sparse = train_lib.gpt_capture(cfg, seq_len)
+            has_rng = True   # dropout
+        else:
+            from autodist_tpu.models import LLAMA_TINY, LlamaConfig
+
+            cfg = LlamaConfig() if model_name == "llama_small" else LLAMA_TINY
+            loss_fn, params, sparse = train_lib.llama_capture(cfg, seq_len)
+            has_rng = False
 
         def batch_fn(B):
             toks = r.randint(0, cfg.vocab_size, (B, seq_len + 1)).astype(np.int32)
             return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
 
         return dict(loss_fn=loss_fn, params=params, mutable_state=None,
-                    sparse_vars=sparse, has_rng=True, cfg=cfg,
+                    sparse_vars=sparse, has_rng=has_rng, cfg=cfg,
                     optimizer=optax.adamw(1e-4), batch_fn=batch_fn)
     if model_name == "lm1b":
         from autodist_tpu.models import train_lib as tl
@@ -130,8 +138,11 @@ def _fwd_flops_per_example(model_name, params, seq_len, cfg=None):
         n = _matmul_param_count(params, ("position_embeddings",
                                         "type_embeddings"))
         return 2.0 * n * seq_len + 4.0 * cfg.num_layers * seq_len ** 2 * cfg.hidden_size
-    if model_name in ("gpt_small", "gpt_tiny"):
-        n = _matmul_param_count(params, ("wpe",))
+    if model_name in ("gpt_small", "gpt_tiny", "llama_small", "llama_tiny"):
+        # lookup-only tables do no matmul work: gpt's learned positions /
+        # llama's untied input table (gpt's wte counts — tied output head)
+        lookup_only = ("wpe",) if model_name.startswith("gpt") else ("embed",)
+        n = _matmul_param_count(params, lookup_only)
         # causal: the S^2 attention matmuls do half the work
         return 2.0 * n * seq_len + 2.0 * cfg.num_layers * seq_len ** 2 * cfg.hidden_size
     if model_name == "lm1b":
