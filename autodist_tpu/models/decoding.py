@@ -1,0 +1,84 @@
+"""Shared autoregressive decoding machinery for the decoder LMs.
+
+One implementation of the KV-cache rollout used by ``models/gpt.py`` and
+``models/llama.py`` (both expose the same decode contract: a flax module
+whose ``decode=True`` variant consumes one token per call and threads a
+"cache" collection).  The whole rollout is a single jitted ``lax.scan``
+— compiled once per (module, total-length, temperature); the prompt
+length is a traced scalar so variable-length prompts share the
+executable, with prompt tokens staying authoritative during replay.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=64)
+def _cache_shapes(model, B):
+    """Zero KV-cache template per (module, batch) WITHOUT materializing a
+    full parameter init: eval_shape gives the structure abstractly."""
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                            jnp.zeros((B, 1), jnp.int32))["cache"]
+    return jax.tree.map(lambda s: (tuple(s.shape), s.dtype), shapes,
+                        is_leaf=lambda s: hasattr(s, "shape"))
+
+
+def fresh_cache(model, B):
+    return jax.tree.map(lambda sd: jnp.zeros(*sd), _cache_shapes(model, B),
+                        is_leaf=lambda x: isinstance(x, tuple))
+
+
+@functools.lru_cache(maxsize=64)
+def _make_rollout(model, total, temperature):
+    """Jitted decode loop for a ``decode=True`` module (flax modules are
+    hashable frozen dataclasses, so they key the executable cache)."""
+
+    @jax.jit
+    def rollout(params, cache, buf0, prompt_len, rng):
+        def step(carry, t):
+            buf, cache, rng = carry
+            tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
+            logits, mut = model.apply({"params": params, "cache": cache},
+                                      tok, mutable=["cache"])
+            logits = logits[:, 0]
+            rng, sub = jax.random.split(rng)
+            if temperature > 0:
+                nxt = jax.random.categorical(sub, logits / temperature)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            # only write past the prompt (prompt tokens stay authoritative)
+            write_at = jnp.minimum(t + 1, total - 1)
+            write = jnp.where(
+                t + 1 < prompt_len,
+                jax.lax.dynamic_slice_in_dim(buf, write_at, 1, axis=1)[:, 0],
+                nxt.astype(jnp.int32))
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, write[:, None], write_at, axis=1)
+            return (buf, mut["cache"], rng), None
+
+        (buf, cache, rng), _ = jax.lax.scan(
+            step, (buf0, cache, rng), jnp.arange(total - 1))
+        return buf
+
+    return rollout
+
+
+def generate(model, max_position, params, prompt, max_new_tokens,
+             temperature=0.0, rng=None):
+    """Autoregressive generation through ``model`` (a ``decode=True``
+    module): one forward per token, O(T) total.  ``prompt``: (B, P) int32;
+    returns (B, P + max_new_tokens).  ``temperature=0`` is greedy."""
+    import numpy as np
+
+    prompt = np.asarray(prompt, np.int32)
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    if total > max_position:
+        raise ValueError(f"{total} tokens exceed max_position={max_position}")
+    buf0 = np.zeros((B, total), np.int32)
+    buf0[:, :P] = prompt
+    cache = fresh_cache(model, B)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rollout = _make_rollout(model, total, float(temperature))
+    return rollout(params, cache, jnp.asarray(buf0), jnp.int32(P), rng)

@@ -196,82 +196,16 @@ class GPT(nn.Module):
         return x.astype(jnp.float32) @ wte.T
 
 
-import functools
-
-
-@functools.lru_cache(maxsize=32)
-def _fresh_cache_shapes(config, B):
-    """Zero KV-cache template per (config, batch) WITHOUT materializing (and
-    discarding) a full random parameter init: eval_shape gives the cache
-    structure abstractly."""
-    model = GPT(config, decode=True)
-    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
-                            jnp.zeros((B, 1), jnp.int32))["cache"]
-    return jax.tree.map(lambda s: (tuple(s.shape), s.dtype), shapes,
-                        is_leaf=lambda s: hasattr(s, "shape"))
-
-
-def _fresh_cache(config, B):
-    return jax.tree.map(lambda sd: jnp.zeros(*sd),
-                        _fresh_cache_shapes(config, B),
-                        is_leaf=lambda x: isinstance(x, tuple))
-
-
-@functools.lru_cache(maxsize=32)
-def _make_rollout(config, B, total, temperature):
-    """Jitted decode loop, cached per (batch, TOTAL length, config); the
-    prompt length is a traced scalar, so variable-length prompts share one
-    executable instead of recompiling the whole scan."""
-    model = GPT(config, decode=True)
-
-    @jax.jit
-    def rollout(params, cache, buf0, prompt_len, rng):
-        def step(carry, t):
-            buf, cache, rng = carry
-            tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
-            logits, mut = model.apply({"params": params, "cache": cache},
-                                      tok, mutable=["cache"])
-            logits = logits[:, 0]
-            rng, sub = jax.random.split(rng)
-            if temperature > 0:
-                nxt = jax.random.categorical(sub, logits / temperature)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            # only write past the prompt (prompt tokens stay authoritative)
-            write_at = jnp.minimum(t + 1, total - 1)
-            write = jnp.where(
-                t + 1 < prompt_len,
-                jax.lax.dynamic_slice_in_dim(buf, write_at, 1, axis=1)[:, 0],
-                nxt.astype(jnp.int32))
-            buf = jax.lax.dynamic_update_slice_in_dim(
-                buf, write[:, None], write_at, axis=1)
-            return (buf, mut["cache"], rng), None
-
-        (buf, cache, rng), _ = jax.lax.scan(
-            step, (buf0, cache, rng), jnp.arange(total - 1))
-        return buf
-
-    return rollout
-
-
 def generate(config, params, prompt, max_new_tokens, temperature=0.0,
              rng=None):
     """Autoregressive generation with per-layer KV caches (one forward per
-    token, O(T) total instead of O(T^2)).  ``prompt``: (B, P) int32;
-    returns (B, P + max_new_tokens).  ``temperature=0`` is greedy."""
-    import numpy as np
+    token, O(T) total instead of O(T^2)) — the shared jitted-scan rollout
+    (``models/decoding.py``).  ``prompt``: (B, P) int32; returns
+    (B, P + max_new_tokens).  ``temperature=0`` is greedy."""
+    from autodist_tpu.models.decoding import generate as _generate
 
-    prompt = np.asarray(prompt, np.int32)
-    B, P = prompt.shape
-    total = P + max_new_tokens
-    if total > config.max_position:
-        raise ValueError(f"{total} tokens exceed max_position={config.max_position}")
-    buf0 = np.zeros((B, total), np.int32)
-    buf0[:, :P] = prompt
-    cache = _fresh_cache(config, B)
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
-    rollout = _make_rollout(config, B, total, float(temperature))
-    return rollout(params, cache, jnp.asarray(buf0), jnp.int32(P), rng)
+    return _generate(GPT(config, decode=True), config.max_position,
+                     params, prompt, max_new_tokens, temperature, rng)
 
 
 def gpt_loss(logits, targets, mask=None):

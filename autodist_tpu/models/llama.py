@@ -12,7 +12,6 @@ TPU-native choices mirror ``models/gpt.py``: bf16 activations / f32
 params, fused QKV projection, pre-norm blocks.
 """
 import dataclasses
-import functools
 from typing import Any
 
 import flax.linen as nn
@@ -94,6 +93,8 @@ class LlamaAttention(nn.Module):
         if self.decode:
             if seq_axis is not None:
                 raise NotImplementedError("decode under sequence parallelism")
+            if S != 1:
+                raise ValueError(f"decode expects one token per call, got {S}")
             cache_initialized = self.has_variable("cache", "k")
             k_cache = self.variable(
                 "cache", "k", jnp.zeros,
@@ -195,71 +196,14 @@ class Llama(nn.Module):
         return LlamaBlock
 
 
-@functools.lru_cache(maxsize=16)
-def _fresh_cache_shapes(config, B):
-    model = Llama(config, decode=True)
-    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
-                            jnp.zeros((B, 1), jnp.int32))["cache"]
-    return jax.tree.map(lambda s: (tuple(s.shape), s.dtype), shapes,
-                        is_leaf=lambda s: hasattr(s, "shape"))
-
-
-@functools.lru_cache(maxsize=16)
-def _make_rollout(config, B, total, temperature):
-    """Jitted whole-rollout scan, cached per (config, batch, TOTAL length)
-    — same executable-reuse contract as ``models/gpt.py:_make_rollout``
-    (the prompt length is a traced scalar, so variable-length prompts
-    share one compilation)."""
-    model = Llama(config, decode=True)
-
-    @jax.jit
-    def rollout(params, cache, buf0, prompt_len, rng):
-        def step(carry, t):
-            buf, cache, rng = carry
-            tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
-            logits, mut = model.apply({"params": params, "cache": cache},
-                                      tok, mutable=["cache"])
-            rng, sub = jax.random.split(rng)
-            if temperature > 0:
-                nxt = jax.random.categorical(sub, logits[:, 0] / temperature)
-            else:
-                nxt = jnp.argmax(logits[:, 0], axis=-1)
-            write_at = jnp.minimum(t + 1, total - 1)
-            write = jnp.where(            # prompt tokens stay authoritative
-                t + 1 < prompt_len,
-                jax.lax.dynamic_slice_in_dim(buf, write_at, 1, axis=1)[:, 0],
-                nxt.astype(jnp.int32))
-            buf = jax.lax.dynamic_update_slice_in_dim(
-                buf, write[:, None], write_at, axis=1)
-            return (buf, mut["cache"], rng), None
-
-        (buf, cache, rng), _ = jax.lax.scan(
-            step, (buf0, cache, rng), jnp.arange(total - 1))
-        return buf
-
-    return rollout
-
-
 def generate(config, params, prompt, max_new_tokens, temperature=0.0,
              rng=None):
-    """Greedy/temperature sampling with per-layer GQA KV caches; one
-    forward per token through a jitted ``lax.scan`` rollout (compiled once
-    per (config, batch, total-length), mirroring ``models/gpt.py``)."""
-    import numpy as np
+    """Greedy/temperature sampling with per-layer GQA KV caches — the
+    shared jitted-scan rollout (``models/decoding.py``)."""
+    from autodist_tpu.models.decoding import generate as _generate
 
-    prompt = np.asarray(prompt, np.int32)
-    B, P = prompt.shape
-    total = P + max_new_tokens
-    if total > config.max_position:
-        raise ValueError(f"{total} tokens exceed max_position")
-    cache = jax.tree.map(lambda sd: jnp.zeros(*sd),
-                         _fresh_cache_shapes(config, B),
-                         is_leaf=lambda x: isinstance(x, tuple))
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
-    buf0 = np.zeros((B, total), np.int32)
-    buf0[:, :P] = prompt
-    rollout = _make_rollout(config, B, total, float(temperature))
-    return rollout(params, cache, jnp.asarray(buf0), jnp.int32(P), rng)
+    return _generate(Llama(config, decode=True), config.max_position,
+                     params, prompt, max_new_tokens, temperature, rng)
 
 
 def llama_loss(logits, targets, mask=None):

@@ -67,10 +67,21 @@ importlib.reload(admod)
 
 uneven = strategy_name.endswith(":uneven")
 subset = strategy_name.endswith(":subset")
+seqring = strategy_name.endswith(":seqring")
 strategy_name = strategy_name.split(":")[0]
 
 dist_kwargs = {}
-if subset:
+if seqring:
+    # sequence axis MAJOR -> the seq ring's ppermute hops cross the real
+    # process boundary every step (ring attention over actual host links,
+    # rotary phases offset to global block starts); replica stays inside
+    # each process.  Each host feeds its sequence BLOCK of the full batch
+    # (dim-1 host-local slices -> host_local_array_to_global_array).
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": list(range(R))}],
+        "mesh": {"seq": nproc, "replica": R // nproc}})
+    builder = getattr(S, strategy_name)()
+elif subset:
     # dcn x ici mesh whose MAJOR axis is the process boundary: the PS
     # scatter/gather must stay inside each process's ici pair, with only
     # shard-sized psums crossing the inter-process (dcn) axis
@@ -84,7 +95,18 @@ else:
     builder = getattr(S, strategy_name)()
 ad = admod.AutoDist(resource_spec=spec, strategy_builder=builder)
 
-if uneven:
+if seqring:
+    from autodist_tpu.models import train_lib
+    from autodist_tpu.models.llama import LlamaConfig
+
+    # keep in sync with tests/integration/test_multiprocess.py oracle
+    LLAMA_MP = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                           num_heads=2, num_kv_heads=1, intermediate_size=32,
+                           max_position=32, dtype=jnp.float32)
+    MP_SEQ = 8
+    loss_fn, params, sparse = train_lib.llama_capture(LLAMA_MP, MP_SEQ)
+    dist_kwargs["sparse_vars"] = sparse
+elif uneven:
     # mask-aware loss: uneven per-host feeds are padded + masked; the
     # engine weights each device by its real-example count
     from autodist_tpu.const import BATCH_MASK_KEY
@@ -101,7 +123,8 @@ else:
         return jnp.mean((batch @ p["w"]) ** 2)
 
 
-params = {"w": jnp.asarray(np.linspace(1, 2, 6, dtype=np.float32))}
+if not seqring:
+    params = {"w": jnp.asarray(np.linspace(1, 2, 6, dtype=np.float32))}
 
 if pid == 0:
     # publish the id as the coordinator would (serialize happens in build)
@@ -121,6 +144,26 @@ sess = ad.distribute(loss_fn, params, optax.sgd(0.1), batch_mask=uneven,
                      **dist_kwargs)
 
 # global batch is seeded and identical across processes; each feeds its slice
+if seqring:
+    toks = np.random.RandomState(0).randint(
+        0, 64, (4, MP_SEQ + 1)).astype(np.int32)
+    blk = MP_SEQ // nproc
+    local = {"tokens": toks[:, :-1][:, pid * blk:(pid + 1) * blk],
+             "targets": toks[:, 1:][:, pid * blk:(pid + 1) * blk]}
+    losses = []
+    for _ in range(3):
+        metrics = sess.run(local)
+        losses.append(float(metrics["loss"]))
+    result = {
+        "pid": pid, "loss": losses[-1], "losses": losses,
+        "w": float(sum(float(jnp.sum(jnp.abs(l)))
+                       for l in jax.tree.leaves(sess.params()))),
+        "strategy": "Llama:seqring",
+    }
+    with open(os.path.join(out_dir, f"result_{pid}.json"), "w") as f:
+        json.dump(result, f)
+    print("OK", pid, losses)
+    sys.exit(0)
 if uneven:
     # 8 real rows split 5/3 across the two hosts (reference np.array_split
     # weighted-feed semantics) — hosts pad+mask to a common per-device count

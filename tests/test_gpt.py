@@ -127,7 +127,8 @@ def test_generate_sampled_shapes_and_budget():
 def test_generate_shares_executable_across_prompt_lengths():
     """Prompt length is a traced scalar: same (B, total) means one compiled
     rollout regardless of P."""
-    from autodist_tpu.models.gpt import _make_rollout, generate
+    from autodist_tpu.models.decoding import _make_rollout
+    from autodist_tpu.models.gpt import generate
 
     model = GPT(CFG)
     params = model.init(jax.random.PRNGKey(2),
