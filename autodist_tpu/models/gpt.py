@@ -162,7 +162,7 @@ class GPT(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens, deterministic=True):
+    def __call__(self, tokens, deterministic=True, return_hidden=False):
         from autodist_tpu.parallel.context import global_position_offset
 
         c = self.config
@@ -193,6 +193,10 @@ class GPT(nn.Module):
             x = block_cls(c, decode=self.decode, name=f"h_{i}")(
                 x, deterministic)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
+        if return_hidden:
+            # pre-projection activations for the streaming vocab loss
+            # (ops/losses.py): the (B, S, V) logits tensor never exists
+            return x.astype(jnp.float32)
         return x.astype(jnp.float32) @ wte.T
 
 

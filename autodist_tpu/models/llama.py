@@ -174,7 +174,7 @@ class Llama(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden=False):
         c = self.config
         emb = self.param("embed", nn.initializers.normal(0.02),
                          (c.vocab_size, c.hidden_size), jnp.float32)
@@ -187,6 +187,11 @@ class Llama(nn.Module):
         x = nn.RMSNorm(epsilon=c.norm_eps, dtype=c.dtype, name="norm")(x)
         head = self.param("lm_head", nn.initializers.normal(0.02),
                           (c.hidden_size, c.vocab_size), jnp.float32)
+        if return_hidden:
+            # pre-projection activations for the streaming vocab loss
+            # (ops/losses.py); lm_head still exists as a param (initialized
+            # above) so the streaming capture can pass it transposed
+            return x.astype(jnp.float32)
         return x.astype(jnp.float32) @ head
 
     @staticmethod

@@ -76,45 +76,92 @@ def bert_capture(config, seq_len, rng=None):
     return loss_fn, params, []
 
 
-def gpt_capture(config, seq_len, rng=None):
+def _positional_mask(targets, example_mask):
+    """Per-example (B,) session mask -> per-position mask matching
+    ``targets``; None stays None (ops/losses.py handles the -100 ignores)."""
+    if example_mask is None:
+        return None
+    m = example_mask.reshape(
+        example_mask.shape + (1,) * (targets.ndim - example_mask.ndim))
+    return jnp.broadcast_to(m, targets.shape)
+
+
+def gpt_capture(config, seq_len, rng=None, streaming_loss=False,
+                loss_chunk=8192):
     """Init a GPT causal LM; returns (loss_fn, params, sparse_vars).
 
     ``loss_fn(params, batch, rng)`` with ``batch = {"tokens", "targets"}``
     (targets pre-shifted by the caller).  The tied embedding's gradient is
     dense, so no variable takes the sparse path (same as BERT).
+
+    ``streaming_loss=True`` computes the cross entropy against the tied
+    ``wte`` table WITHOUT materializing the (B, S, V) logits
+    (``ops/losses.py``) — at GPT-2 vocab the logits are the largest single
+    training allocation, so this is the memory lever that buys batch size.
     """
     from autodist_tpu.models.gpt import GPT, gpt_loss
+    from autodist_tpu.ops.losses import streaming_softmax_xent
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     model = GPT(config)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(rng, dummy, deterministic=True)["params"]
 
-    def loss_fn(p, batch, step_rng):
-        logits = model.apply({"params": p}, batch["tokens"],
-                             deterministic=False, rngs={"dropout": step_rng})
-        return gpt_loss(logits, batch["targets"], batch.get(BATCH_MASK_KEY))
+    if streaming_loss:
+        def loss_fn(p, batch, step_rng):
+            hidden = model.apply(
+                {"params": p}, batch["tokens"], deterministic=False,
+                return_hidden=True, rngs={"dropout": step_rng})
+            t = batch["targets"]
+            return streaming_softmax_xent(
+                hidden, p["wte"], t,
+                valid=_positional_mask(t, batch.get(BATCH_MASK_KEY)),
+                chunk=loss_chunk)
+    else:
+        def loss_fn(p, batch, step_rng):
+            logits = model.apply(
+                {"params": p}, batch["tokens"],
+                deterministic=False, rngs={"dropout": step_rng})
+            return gpt_loss(logits, batch["targets"],
+                            batch.get(BATCH_MASK_KEY))
 
     return loss_fn, params, []
 
 
-def llama_capture(config, seq_len, rng=None):
+def llama_capture(config, seq_len, rng=None, streaming_loss=False,
+                  loss_chunk=8192):
     """Init a Llama-family causal LM; returns (loss_fn, params, sparse_vars).
 
     The input embedding is UNTIED (separate lm_head), so its gradient is
     pure rows — it takes the sparse path (Parallax routes it like the
     reference's IndexedSlices; PartitionedPS can shard the table).
+
+    ``streaming_loss=True`` streams the untied (D, V) head through
+    ``ops/losses.py`` (passed transposed; the head gradient flows back
+    through the transpose) — no (B, S, V) logits allocation.
     """
     from autodist_tpu.models.llama import Llama, llama_loss
+    from autodist_tpu.ops.losses import streaming_softmax_xent
 
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     model = Llama(config)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(rng, dummy)["params"]
 
-    def loss_fn(p, batch):
-        logits = model.apply({"params": p}, batch["tokens"])
-        return llama_loss(logits, batch["targets"], batch.get(BATCH_MASK_KEY))
+    if streaming_loss:
+        def loss_fn(p, batch):
+            hidden = model.apply({"params": p}, batch["tokens"],
+                                 return_hidden=True)
+            t = batch["targets"]
+            return streaming_softmax_xent(
+                hidden, p["lm_head"].T, t,
+                valid=_positional_mask(t, batch.get(BATCH_MASK_KEY)),
+                chunk=loss_chunk)
+    else:
+        def loss_fn(p, batch):
+            logits = model.apply({"params": p}, batch["tokens"])
+            return llama_loss(logits, batch["targets"],
+                              batch.get(BATCH_MASK_KEY))
 
     return loss_fn, params, ["embed"]
 

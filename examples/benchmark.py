@@ -17,7 +17,8 @@ import numpy as np
 import optax
 
 
-def build(model_name, seq_len, image_size):
+def build(model_name, seq_len, image_size, streaming_loss=False,
+          remat=False):
     from autodist_tpu.models import (
         BERT_BASE, BERT_LARGE, DenseNet121, InceptionV3, LMConfig, NCFConfig,
         ResNet50, ResNet101, VGG16,
@@ -69,17 +70,29 @@ def build(model_name, seq_len, image_size):
                     sparse_vars=sparse, has_rng=False, cfg=cfg,
                     optimizer=optax.adam(1e-3), batch_fn=batch_fn)
     if model_name in ("gpt_small", "gpt_tiny", "llama_small", "llama_tiny"):
+        import dataclasses
+
         if model_name.startswith("gpt"):
             from autodist_tpu.models import GPT_SMALL, GPT_TINY
 
             cfg = GPT_SMALL if model_name == "gpt_small" else GPT_TINY
-            loss_fn, params, sparse = train_lib.gpt_capture(cfg, seq_len)
+            if seq_len > cfg.max_position or remat:
+                cfg = dataclasses.replace(
+                    cfg, max_position=max(seq_len, cfg.max_position),
+                    remat=remat or cfg.remat)
+            loss_fn, params, sparse = train_lib.gpt_capture(
+                cfg, seq_len, streaming_loss=streaming_loss)
             has_rng = True   # dropout
         else:
             from autodist_tpu.models import LLAMA_TINY, LlamaConfig
 
             cfg = LlamaConfig() if model_name == "llama_small" else LLAMA_TINY
-            loss_fn, params, sparse = train_lib.llama_capture(cfg, seq_len)
+            if seq_len > cfg.max_position or remat:
+                cfg = dataclasses.replace(
+                    cfg, max_position=max(seq_len, cfg.max_position),
+                    remat=remat or cfg.remat)
+            loss_fn, params, sparse = train_lib.llama_capture(
+                cfg, seq_len, streaming_loss=streaming_loss)
             has_rng = False
 
         def batch_fn(B):
@@ -278,7 +291,8 @@ def sweep(args):
     if records_dir:
         os.makedirs(records_dir, exist_ok=True)
     for name in strategies:
-        cap = build(args.model, args.seq_len, args.image_size)
+        cap = build(args.model, args.seq_len, args.image_size,
+                    streaming_loss=args.streaming_loss, remat=args.remat)
         eps, record, sess = run_one(args, name, cap, n_chips)
         measured[name] = record.step_time_s
         est = estimate(sess._t.strategy, sess._t.model_item, _spec(n_chips),
@@ -340,6 +354,11 @@ def main():
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--seq_len", type=int, default=128)
     ap.add_argument("--image_size", type=int, default=224)
+    ap.add_argument("--streaming_loss", action="store_true",
+                    help="GPT/Llama: streaming vocab cross-entropy "
+                         "(ops/losses.py) — no (B,S,V) logits allocation")
+    ap.add_argument("--remat", action="store_true",
+                    help="GPT/Llama: per-block rematerialization")
     args = ap.parse_args()
 
     if args.strategies:
@@ -347,7 +366,8 @@ def main():
         return
 
     n_chips = jax.device_count()
-    cap = build(args.model, args.seq_len, args.image_size)
+    cap = build(args.model, args.seq_len, args.image_size,
+                streaming_loss=args.streaming_loss, remat=args.remat)
     _, record, sess = run_one(args, args.autodist_strategy, cap, n_chips)
     if args.records_dir:
         os.makedirs(args.records_dir, exist_ok=True)
