@@ -101,9 +101,7 @@ class AsyncPSEngineSession:
 
         if model_item.optimizer is None:
             raise ValueError("ModelItem has no optimizer")
-        for feature, flag in (("has_rng", model_item.has_rng),
-                              ("has_aux", model_item.has_aux),
-                              ("eval_fn", model_item.eval_fn is not None),
+        for feature, flag in (("eval_fn", model_item.eval_fn is not None),
                               ("mutable_state",
                                model_item.mutable_state is not None)):
             if flag:
@@ -119,11 +117,26 @@ class AsyncPSEngineSession:
             raise ValueError(
                 "strategy has no async (sync=False) PS node; the "
                 "synchronous engine handles it")
+        ar_nodes = sorted(n for n, p in self.plans.items()
+                          if p.sync == SyncKind.ALL_REDUCE)
+        if ar_nodes:
+            # loud, at session build (VERDICT r3 item 7): the user asked
+            # for AR on these variables but selected an async strategy — a
+            # worker running ahead cannot rendezvous for collectives, so
+            # they are host-served asynchronously like the PS nodes
+            logging.warning(
+                "Async PS runtime: %d AllReduce-labeled variable(s) %s "
+                "degrade to asynchronous host serving — per-step collective "
+                "semantics cannot hold when workers run ahead (reference: "
+                "async mode serializes everything through the PS too). Use "
+                "sync=True for true per-step AllReduce.",
+                len(ar_nodes), ar_nodes)
         self.staleness = min(stale)
         self._inner = AsyncPSSession(
             model_item.loss_fn, model_item.params, model_item.optimizer,
             staleness=self.staleness, devices=devices,
-            num_workers=num_workers)
+            num_workers=num_workers, has_rng=model_item.has_rng,
+            has_aux=model_item.has_aux)
 
     # thin delegation (the session surface tests/users drive).  params is
     # a METHOD, matching DistributedSession.params() — code written against
@@ -148,6 +161,10 @@ class AsyncPSEngineSession:
         return self._inner.history
 
     @property
+    def aux_history(self):
+        return self._inner.aux_history
+
+    @property
     def num_workers(self):
         return len(self._inner._devices)
 
@@ -159,7 +176,9 @@ class AsyncPSEngineSession:
 class AsyncPSSession:
     """Asynchronous bounded-staleness training session.
 
-    ``loss_fn(params, batch) -> loss`` is single-device code.  Each worker
+    ``loss_fn(params, batch) -> loss`` is single-device code (with
+    ``has_rng``, ``loss_fn(params, batch, rng)``; with ``has_aux``,
+    returning ``(loss, aux)`` — aux lands in ``aux_history``).  Each worker
     computes gradients on its own device against its last-pulled parameter
     snapshot and pushes them to the host parameter server, which applies
     them immediately (async SGD).  ``staleness`` bounds how far any worker
@@ -167,7 +186,8 @@ class AsyncPSSession:
     """
 
     def __init__(self, loss_fn, params, optimizer, *, staleness=0,
-                 devices=None, num_workers=None):
+                 devices=None, num_workers=None, has_rng=False,
+                 has_aux=False, rng=None):
         self._devices = list(devices if devices is not None
                              else jax.local_devices())
         if num_workers is not None:
@@ -187,12 +207,19 @@ class AsyncPSSession:
             self._to_host(self._params)))
         self._version = 0
         self._lock = threading.Lock()
-        self._grad = jax.jit(jax.value_and_grad(loss_fn))
+        self._has_rng = bool(has_rng)
+        self._has_aux = bool(has_aux)
+        self._base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._grad = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
         self._apply = jax.jit(lambda g, st, p: optimizer.update(g, st, p))
         self.staleness = int(staleness)
         self.barrier = TokenBarrier(len(self._devices), staleness)
         self.history = []                               # (worker, version, loss)
+        self.aux_history = []                           # (worker, version, aux)
         self._stale_pushes = 0
+        # rng streams must not replay across run() calls on one session:
+        # each run folds in steps offset by everything run before it
+        self._rng_step_base = 0
 
     def _to_host(self, tree):
         if self._host_dev is None:
@@ -254,9 +281,22 @@ class AsyncPSSession:
                 p, ver = self.pull()
                 p_dev = jax.device_put(p, dev)
                 b_dev = jax.device_put(batches[i % len(batches)], dev)
-                loss, g = self._grad(p_dev, b_dev)
+                if self._has_rng:
+                    # independent per-(worker, lifetime-step) stream — the
+                    # dropout/sampling rng the sync engine threads per
+                    # device; _rng_step_base keeps later run() calls from
+                    # replaying the first run's masks
+                    step_rng = jax.random.fold_in(
+                        jax.random.fold_in(self._base_rng, w),
+                        self._rng_step_base + i)
+                    out, g = self._grad(p_dev, b_dev, step_rng)
+                else:
+                    out, g = self._grad(p_dev, b_dev)
+                loss, aux = out if self._has_aux else (out, None)
                 new_ver = self.push(g, ver)
                 self.history.append((w, new_ver, float(loss)))
+                if self._has_aux:
+                    self.aux_history.append((w, new_ver, jax.device_get(aux)))
                 self.barrier.advance(w)
         except Exception as e:  # surface to the caller, don't die silently
             errors.append((w, e))
@@ -297,6 +337,7 @@ class AsyncPSSession:
         grace_end = time.time() + 5.0
         for t in threads:
             t.join(max(0.0, grace_end - time.time()))
+        self._rng_step_base += steps
         if errors:
             raise errors[0][1]
         alive = [t for t in threads if t.is_alive()]

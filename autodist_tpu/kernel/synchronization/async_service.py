@@ -1,0 +1,164 @@
+"""Cross-process asynchronous parameter serving.
+
+The thread-based :mod:`async_ps` runtime bounds staleness across the LOCAL
+devices of one process.  This module is the cross-PROCESS half (VERDICT r3
+item 7): a host parameter server + token barrier served over a
+``multiprocessing.managers.BaseManager`` TCP endpoint — the TPU-world
+analog of the reference's gRPC PS transport
+(``/root/reference/autodist/utils/server_starter.py:50-76``) with the
+size-``s`` token queues of
+(``/root/reference/autodist/kernel/synchronization/ps_synchronizer.py:388-458``)
+enforced across real OS processes.
+
+Design: the CHIEF process owns the authoritative parameters + optimizer
+state and runs the manager server in a daemon thread (state stays in the
+chief, not a forked child).  Every worker process — the chief usually runs
+one too — connects, then loops pull → local grad on its own device →
+push.  The barrier is polled (``may_start``) rather than blocked server-
+side so a wedged worker can't pin a server thread.  Everything crossing
+the wire is a numpy pytree (pickled by the manager).
+"""
+import threading
+import time
+from multiprocessing.managers import BaseManager
+
+import jax
+import numpy as np
+
+from autodist_tpu.kernel.synchronization.async_ps import TokenBarrier
+from autodist_tpu.utils import logging
+
+_EXPOSED = ("pull", "push", "may_start", "advance", "stats")
+
+
+class AsyncPSService:
+    """The server half of an async PS, shared across processes.
+
+    Same push/pull + bounded-lead contract as :class:`async_ps
+    .AsyncPSSession`, minus the worker threads (workers live in their own
+    processes and drive their own devices).
+    """
+
+    def __init__(self, params, optimizer, *, staleness=0, num_workers=1):
+        self._opt = optimizer
+        self._params = jax.tree.map(np.asarray, jax.device_get(params))
+        self._opt_state = jax.device_get(optimizer.init(params))
+        self._apply = jax.jit(lambda g, st, p: optimizer.update(g, st, p))
+        self._version = 0
+        self._stale_pushes = 0
+        self._lock = threading.Lock()
+        self.barrier = TokenBarrier(num_workers, staleness)
+        self.staleness = int(staleness)
+
+    # -- RPC surface (everything numpy / picklable) -------------------------
+
+    def pull(self):
+        with self._lock:
+            return self._params, self._version
+
+    def push(self, grads, seen_version):
+        import optax
+
+        with self._lock:
+            updates, self._opt_state = jax.device_get(
+                self._apply(grads, self._opt_state, self._params))
+            self._params = jax.tree.map(
+                np.asarray, optax.apply_updates(self._params, updates))
+            self._version += 1
+            if seen_version < self._version - 1:
+                self._stale_pushes += 1
+            return self._version
+
+    def may_start(self, worker):
+        """Non-blocking barrier probe: True when ``worker`` is within the
+        staleness bound (clients poll; no server thread is held)."""
+        with self.barrier._cv:
+            lead = self.barrier._steps[worker] - min(self.barrier._steps)
+            if lead <= self.barrier._s:
+                self.barrier.max_lead_seen = max(
+                    self.barrier.max_lead_seen, lead)
+                return True
+            return False
+
+    def advance(self, worker):
+        self.barrier.advance(worker)
+
+    def stats(self):
+        with self._lock:
+            return {"version": self._version,
+                    "stale_pushes": self._stale_pushes,
+                    "max_lead_seen": self.barrier.max_lead_seen,
+                    "steps": self.barrier.steps}
+
+
+def serve_async_ps(service, address, authkey=b"autodist-async-ps"):
+    """Serve ``service`` at ``address`` from a daemon thread of THIS
+    process (chief keeps the authoritative state).  Returns
+    ``(thread, bound_address)`` — the address matters when port 0
+    (ephemeral) was requested."""
+    # a fresh manager class per call: the registry is CLASS-level state, so
+    # a shared class would let a later client register() clobber the
+    # callable the live server resolves "svc" through
+    class _ServerManager(BaseManager):
+        pass
+
+    _ServerManager.register("svc", callable=lambda: service,
+                            exposed=_EXPOSED)
+    mgr = _ServerManager(address=address, authkey=authkey)
+    server = mgr.get_server()
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    logging.info("Async PS service at %s (staleness=%d)", server.address,
+                 service.staleness)
+    return t, server.address
+
+
+def connect_async_ps(address, authkey=b"autodist-async-ps", retries=40,
+                     retry_s=0.25):
+    """Connect to a chief's service; returns the RPC proxy."""
+    class _ClientManager(BaseManager):
+        pass
+
+    _ClientManager.register("svc")
+    mgr = _ClientManager(address=address, authkey=authkey)
+    for attempt in range(retries):
+        try:
+            mgr.connect()
+            break
+        except (ConnectionError, OSError):
+            if attempt == retries - 1:
+                raise
+            time.sleep(retry_s)
+    return mgr.svc()
+
+
+def run_async_worker(svc, loss_fn, worker_id, batches, steps, *, delay=0.0,
+                     device=None, poll_s=0.01, timeout=120.0):
+    """Drive one worker process against a (possibly remote) service.
+
+    pull → grad on the local device → push, with the polled token barrier
+    bounding the lead.  Returns the list of (version, loss) this worker
+    contributed."""
+    dev = device or jax.local_devices()[0]
+    grad = jax.jit(jax.value_and_grad(loss_fn))
+    out = []
+    deadline = time.time() + timeout
+    for i in range(steps):
+        while not svc.may_start(worker_id):
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"worker {worker_id} barred past timeout at step {i}")
+            time.sleep(poll_s)
+        params, ver = svc.pull()
+        if delay:
+            # induced straggler: a slow worker is slow COMPUTING the
+            # gradient (between pull and push), which is what makes its
+            # eventual push stale
+            time.sleep(delay)
+        p_dev = jax.device_put(params, dev)
+        b_dev = jax.device_put(batches[i % len(batches)], dev)
+        loss, g = grad(p_dev, b_dev)
+        new_ver = svc.push(jax.tree.map(np.asarray, jax.device_get(g)), ver)
+        out.append((new_ver, float(loss)))
+        svc.advance(worker_id)
+    return out

@@ -190,7 +190,8 @@ class Llama(nn.Module):
         if return_hidden:
             # pre-projection activations for the streaming vocab loss
             # (ops/losses.py); lm_head still exists as a param (initialized
-            # above) so the streaming capture can pass it transposed
+            # above) and is streamed as stored via layout="dv" — no
+            # transpose copy
             return x.astype(jnp.float32)
         return x.astype(jnp.float32) @ head
 

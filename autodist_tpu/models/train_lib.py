@@ -105,7 +105,11 @@ def gpt_capture(config, seq_len, rng=None, streaming_loss=False,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     model = GPT(config)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
-    params = model.init(rng, dummy, deterministic=True)["params"]
+    # return_hidden at init: the param tree is identical (all params are
+    # created before the early return) and init never materializes the
+    # (1, S, V) logits the streaming path exists to avoid
+    params = model.init(rng, dummy, deterministic=True,
+                        return_hidden=streaming_loss)["params"]
 
     if streaming_loss:
         def loss_fn(p, batch, step_rng):
@@ -137,8 +141,8 @@ def llama_capture(config, seq_len, rng=None, streaming_loss=False,
     reference's IndexedSlices; PartitionedPS can shard the table).
 
     ``streaming_loss=True`` streams the untied (D, V) head through
-    ``ops/losses.py`` (passed transposed; the head gradient flows back
-    through the transpose) — no (B, S, V) logits allocation.
+    ``ops/losses.py`` (native "dv" layout — no transpose copy) — no
+    (B, S, V) logits allocation.
     """
     from autodist_tpu.models.llama import Llama, llama_loss
     from autodist_tpu.ops.losses import streaming_softmax_xent
@@ -146,7 +150,8 @@ def llama_capture(config, seq_len, rng=None, streaming_loss=False,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     model = Llama(config)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
-    params = model.init(rng, dummy)["params"]
+    # see gpt_capture: identical param tree, no init-time logits tensor
+    params = model.init(rng, dummy, return_hidden=streaming_loss)["params"]
 
     if streaming_loss:
         def loss_fn(p, batch):
@@ -154,9 +159,9 @@ def llama_capture(config, seq_len, rng=None, streaming_loss=False,
                                  return_hidden=True)
             t = batch["targets"]
             return streaming_softmax_xent(
-                hidden, p["lm_head"].T, t,
+                hidden, p["lm_head"], t,
                 valid=_positional_mask(t, batch.get(BATCH_MASK_KEY)),
-                chunk=loss_chunk)
+                chunk=loss_chunk, layout="dv")
     else:
         def loss_fn(p, batch):
             logits = model.apply({"params": p}, batch["tokens"])

@@ -13,6 +13,12 @@ per backward step.
 Everything is jit/scan (static chunk count, MXU-sized matmuls with f32
 accumulation), so XLA pipelines the chunk loop; sharded vocab dims
 compose (the scan is over the LOCAL table under tensor parallelism).
+Vocabs that don't divide the chunk are handled WITHOUT copying or
+padding the table: the final chunk's slice start is clamped so it stays
+in bounds, and columns already covered by earlier chunks are masked to
+-inf inside the scan — the chunk size requested is the chunk size run
+(no silent shrink-to-a-divisor cliff), and both (V, D) and (D, V) head
+layouts stream without a table transpose.
 """
 import functools
 
@@ -20,129 +26,183 @@ import jax
 import jax.numpy as jnp
 
 
-def _chunked(table, chunk):
-    v = table.shape[0]
-    if v % chunk:
-        raise ValueError(f"vocab {v} not divisible by chunk {chunk}")
-    return table.reshape(v // chunk, chunk, table.shape[1])
+def _vocab_axis(layout):
+    return 0 if layout == "vd" else 1
 
 
-def _chunk_logits(h, w_c):
-    """(N, D) x (C, D) -> (N, C) f32 on the MXU."""
+def _slice_chunk(table, start, chunk, layout):
+    """``chunk`` vocab rows of the table at ``start`` without reshaping or
+    copying it: (chunk, D) for the "vd" layout, (D, chunk) for "dv"."""
+    return jax.lax.dynamic_slice_in_dim(table, start, chunk,
+                                        axis=_vocab_axis(layout))
+
+
+def _chunk_logits(h, w_c, layout):
+    """(N, D) x chunk -> (N, C) f32 on the MXU."""
+    contract = (1,) if layout == "vd" else (0,)
     return jax.lax.dot_general(
-        h, w_c, (((1,), (1,)), ((), ())),
+        h, w_c, (((1,), contract), ((), ())),
         preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _streaming_lse_and_target(h, table, targets, chunk):
-    return _fwd_scan(h, table, targets, chunk)[0]
+def _chunk_start(c_idx, chunk, v):
+    """Clamped slice start: the final chunk of a non-dividing vocab slides
+    back to end exactly at ``v`` (its first columns then repeat columns of
+    the previous chunk — callers mask those to -inf as "not fresh")."""
+    return jnp.minimum(c_idx * chunk, v - chunk)
 
 
-def _fwd_scan(h, table, targets, chunk):
-    """Returns ((lse, target_logit), residual-free); online logsumexp over
-    vocab chunks, gathering each row's target logit in its chunk."""
+def _masked_chunk_logits(h, table, c_idx, chunk, v, layout):
+    """Chunk logits with already-covered (non-fresh) columns at -inf.
+    Returns (logits, start, w_c) — the slice is returned so the backward
+    pass reuses it instead of slicing twice.  Fresh ⟺ global column >=
+    c_idx * chunk; chunk 0 is always fully fresh, so the online max never
+    sees an all--inf row."""
+    start = _chunk_start(c_idx, chunk, v)
+    w_c = _slice_chunk(table, start, chunk, layout)
+    logits = _chunk_logits(h, w_c, layout)
+    col = start + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    return jnp.where(col >= c_idx * chunk, logits, -jnp.inf), start, w_c
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _streaming_lse_and_target(h, table, targets, chunk, layout):
+    return _fwd_scan(h, table, targets, chunk, layout)[0]
+
+
+def _n_chunks(table, chunk, layout):
+    v = table.shape[_vocab_axis(layout)]
+    return -(-v // chunk)
+
+
+def _fwd_scan(h, table, targets, chunk, layout):
+    """Returns ((lse, target_logit), None); online logsumexp over vocab
+    chunks, gathering each row's target logit in its chunk."""
     n = h.shape[0]
-    wc = _chunked(table, chunk)
+    v = table.shape[_vocab_axis(layout)]
 
-    def body(carry, inp):
+    def body(carry, c_idx):
         m, s, tl = carry
-        c_idx, w_c = inp
-        logits = _chunk_logits(h, w_c)                    # (N, C)
+        logits, start, _ = _masked_chunk_logits(h, table, c_idx, chunk, v,
+                                                layout)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(logits - m_new[:, None]), axis=-1)
-        local = targets - c_idx * chunk                   # (N,)
-        in_chunk = (local >= 0) & (local < chunk)
+        local = targets - start                           # (N,)
+        fresh = (targets >= c_idx * chunk) & (targets < start + chunk)
         safe = jnp.clip(local, 0, chunk - 1)
         got = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
-        tl = jnp.where(in_chunk, got, tl)
+        tl = jnp.where(fresh, got, tl)
         return (m_new, s, tl), None
 
     m0 = jnp.full((n,), -jnp.inf, jnp.float32)
     s0 = jnp.zeros((n,), jnp.float32)
     tl0 = jnp.zeros((n,), jnp.float32)
     (m, s, tl), _ = jax.lax.scan(
-        body, (m0, s0, tl0),
-        (jnp.arange(wc.shape[0]), wc))
+        body, (m0, s0, tl0), jnp.arange(_n_chunks(table, chunk, layout)))
     lse = m + jnp.log(s)
     return (lse, tl), None
 
 
-def _fwd(h, table, targets, chunk):
-    out, _ = _fwd_scan(h, table, targets, chunk)
+def _fwd(h, table, targets, chunk, layout):
+    out, _ = _fwd_scan(h, table, targets, chunk, layout)
     lse, _tl = out
     return out, (h, table, targets, lse)
 
 
-def _bwd(chunk, res, g):
+def _bwd(chunk, layout, res, g):
     """g = (d_lse, d_target_logit), each (N,).  Recompute each chunk's
-    softmax block; dh and dW accumulate chunk by chunk."""
+    softmax block; dh accumulates chunk by chunk and dW is a full-shape
+    f32 carry updated in place per chunk (non-fresh columns have p == 0
+    and no target hit, so the overlapped final-chunk add is exact)."""
     h, table, targets, lse = res
     g_lse, g_tl = g
-    wc = _chunked(table, chunk)
     hf = h.astype(jnp.float32)
+    v = table.shape[_vocab_axis(layout)]
+    axis = _vocab_axis(layout)
 
-    def body(dh, inp):
-        c_idx, w_c = inp
-        logits = _chunk_logits(h, w_c)                    # (N, C)
+    def body(carry, c_idx):
+        dh, dw = carry
+        logits, start, w_c = _masked_chunk_logits(h, table, c_idx, chunk, v,
+                                                  layout)
         p = jnp.exp(logits - lse[:, None])                # softmax block
-        local = targets - c_idx * chunk
-        in_chunk = (local >= 0) & (local < chunk)
-        onehot = (jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-                  == local[:, None]) & in_chunk[:, None]
+        local = targets - start
+        fresh = (targets >= c_idx * chunk) & (targets < start + chunk)
+        col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        onehot = (col == local[:, None]) & fresh[:, None]
         dlogits = p * g_lse[:, None] + jnp.where(onehot, g_tl[:, None], 0.0)
+        w_contract = (0,) if layout == "vd" else (1,)
         dh = dh + jax.lax.dot_general(                    # (N, D)
-            dlogits, w_c.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            dlogits, w_c.astype(jnp.float32),
+            (((1,), w_contract), ((), ())),
             preferred_element_type=jnp.float32)
-        dw_c = jax.lax.dot_general(                       # (C, D)
-            dlogits, hf, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dh, dw_c
+        if layout == "vd":
+            dw_c = jax.lax.dot_general(                   # (C, D)
+                dlogits, hf, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            dw_c = jax.lax.dot_general(                   # (D, C)
+                hf, dlogits, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dw_slice = jax.lax.dynamic_slice_in_dim(dw, start, chunk, axis=axis)
+        dw = jax.lax.dynamic_update_slice_in_dim(dw, dw_slice + dw_c, start,
+                                                 axis=axis)
+        return (dh, dw), None
 
     dh0 = jnp.zeros(h.shape, jnp.float32)
-    dh, dwc = jax.lax.scan(body, dh0, (jnp.arange(wc.shape[0]), wc))
-    dw = dwc.reshape(table.shape).astype(table.dtype)
-    return dh.astype(h.dtype), dw, None
+    dw0 = jnp.zeros(table.shape, jnp.float32)
+    (dh, dw), _ = jax.lax.scan(
+        body, (dh0, dw0), jnp.arange(_n_chunks(table, chunk, layout)))
+    return dh.astype(h.dtype), dw.astype(table.dtype), None
 
 
 _streaming_lse_and_target.defvjp(_fwd, _bwd)
 
 
 def streaming_softmax_xent(hidden, table, targets, valid=None, chunk=8192,
-                           bias=None):
-    """Mean next-token cross entropy of ``hidden @ table.T`` WITHOUT
+                           bias=None, layout="vd"):
+    """Mean next-token cross entropy of the output projection WITHOUT
     materializing the logits.
 
     Args:
       hidden: (..., D) pre-projection activations (any leading shape).
-      table:  (V, D) output embedding (tied or untied; a (D, V) head
-        should be passed transposed).
+      table:  (V, D) output embedding (``layout="vd"``, e.g. a tied input
+        table) or (D, V) head kernel (``layout="dv"``, e.g. a Dense/
+        lm_head) — pass the param as stored; no transpose copy is made.
       targets: (...,) int32; negative ids (e.g. -100) are ignored.
-      valid: optional (...,) extra validity mask (multiplies the target
-        mask — the session's uneven-batch example mask).
-      chunk: vocab rows per scan step (must divide V); 8192 keeps the
-        (N, chunk) block MXU-sized while bounding peak memory.
+      valid: optional (...,) per-position weights (the session's
+        uneven-batch example mask broadcast per position): multiplies the
+        target mask, weighting both the NLL numerator and the mean's
+        denominator — same semantics as the dense ``gpt_loss``.
+      chunk: vocab rows per scan step; 8192 keeps the (N, chunk) block
+        MXU-sized while bounding peak memory.  Vocabs that don't divide it
+        run the same chunk size with a clamped, -inf-masked final chunk —
+        no table copy, no shrink-to-a-divisor cliff.
       bias: optional (V,) logit bias, folded in exactly.
+      layout: "vd" (table is (V, D)) or "dv" (table is (D, V)).
 
-    Returns the masked mean NLL (same value as the dense computation).
+    Returns the weighted mean NLL (same value as the dense computation).
     """
+    if layout not in ("vd", "dv"):
+        raise ValueError(f"layout must be 'vd' or 'dv', got {layout!r}")
     d = hidden.shape[-1]
     h = hidden.reshape(-1, d)
     t = targets.reshape(-1)
-    mask = (t >= 0)
+    weights = (t >= 0).astype(jnp.float32)
     if valid is not None:
-        mask = mask & (valid.reshape(-1) > 0)
-    safe_t = jnp.where(mask, t, 0).astype(jnp.int32)
+        weights = weights * valid.reshape(-1).astype(jnp.float32)
+    safe_t = jnp.where(t >= 0, t, 0).astype(jnp.int32)
     if bias is not None:
         # fold the bias by augmenting D with a ones column: keeps the
         # streaming path single-implementation and exactly equivalent
         h = jnp.concatenate([h, jnp.ones((h.shape[0], 1), h.dtype)], axis=1)
-        table = jnp.concatenate(
-            [table, bias[:, None].astype(table.dtype)], axis=1)
-    chunk = min(chunk, table.shape[0])
-    while table.shape[0] % chunk:
-        chunk -= 1
-    lse, tl = _streaming_lse_and_target(h, table, safe_t, chunk)
-    nll = (lse - tl) * mask.astype(jnp.float32)
-    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        if layout == "vd":
+            table = jnp.concatenate(
+                [table, bias[:, None].astype(table.dtype)], axis=1)
+        else:
+            table = jnp.concatenate(
+                [table, bias[None, :].astype(table.dtype)], axis=0)
+    chunk = min(chunk, table.shape[_vocab_axis(layout)])
+    lse, tl = _streaming_lse_and_target(h, table, safe_t, chunk, layout)
+    nll = (lse - tl) * weights
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(weights), 1.0)

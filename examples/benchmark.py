@@ -26,6 +26,12 @@ def build(model_name, seq_len, image_size, streaming_loss=False,
     from autodist_tpu.models import train_lib
 
     r = np.random.RandomState(0)
+    if (streaming_loss or remat) and model_name not in (
+            "gpt_small", "gpt_tiny", "llama_small", "llama_tiny"):
+        raise SystemExit(
+            f"--streaming_loss/--remat only apply to GPT/Llama, not "
+            f"{model_name} — refusing to measure a configuration that "
+            f"would not take effect")
     if model_name in ("resnet50", "resnet101", "vgg16", "densenet121", "inception_v3"):
         model = {"resnet50": ResNet50, "resnet101": ResNet101, "vgg16": VGG16,
                  "densenet121": DenseNet121, "inception_v3": InceptionV3}[model_name]()
@@ -76,24 +82,18 @@ def build(model_name, seq_len, image_size, streaming_loss=False,
             from autodist_tpu.models import GPT_SMALL, GPT_TINY
 
             cfg = GPT_SMALL if model_name == "gpt_small" else GPT_TINY
-            if seq_len > cfg.max_position or remat:
-                cfg = dataclasses.replace(
-                    cfg, max_position=max(seq_len, cfg.max_position),
-                    remat=remat or cfg.remat)
-            loss_fn, params, sparse = train_lib.gpt_capture(
-                cfg, seq_len, streaming_loss=streaming_loss)
-            has_rng = True   # dropout
+            capture, has_rng = train_lib.gpt_capture, True  # dropout rng
         else:
             from autodist_tpu.models import LLAMA_TINY, LlamaConfig
 
             cfg = LlamaConfig() if model_name == "llama_small" else LLAMA_TINY
-            if seq_len > cfg.max_position or remat:
-                cfg = dataclasses.replace(
-                    cfg, max_position=max(seq_len, cfg.max_position),
-                    remat=remat or cfg.remat)
-            loss_fn, params, sparse = train_lib.llama_capture(
-                cfg, seq_len, streaming_loss=streaming_loss)
-            has_rng = False
+            capture, has_rng = train_lib.llama_capture, False
+        if seq_len > cfg.max_position or remat:
+            cfg = dataclasses.replace(
+                cfg, max_position=max(seq_len, cfg.max_position),
+                remat=remat or cfg.remat)
+        loss_fn, params, sparse = capture(cfg, seq_len,
+                                          streaming_loss=streaming_loss)
 
         def batch_fn(B):
             toks = r.randint(0, cfg.vocab_size, (B, seq_len + 1)).astype(np.int32)

@@ -1,0 +1,66 @@
+"""One process of the cross-process async PS rig (reference case c9 across
+real OS processes: fast chief / slow worker, bounded lead).
+
+Usage: async_ps_worker.py <rank> <port> <steps> <staleness> <out_dir>
+Rank 0 = chief: owns the service, serves it over TCP, runs worker 0 (fast).
+Rank 1 = worker: connects, runs worker 1 with an induced delay.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from autodist_tpu.kernel.synchronization.async_service import (  # noqa: E402
+    AsyncPSService, connect_async_ps, run_async_worker, serve_async_ps)
+
+
+def _loss(p, b):
+    return jnp.mean((b @ p["w"]) ** 2)
+
+
+def main():
+    rank, port, steps, staleness = map(int, sys.argv[1:5])
+    out_dir = sys.argv[5]
+    address = ("127.0.0.1", port)
+    r = np.random.RandomState(10 + rank)
+    batches = [r.randn(8, 6).astype(np.float32) for _ in range(4)]
+
+    if rank == 0:
+        p0 = {"w": jnp.asarray(np.random.RandomState(0).randn(6),
+                               jnp.float32)}
+        service = AsyncPSService(p0, optax.sgd(0.02), staleness=staleness,
+                                 num_workers=2)
+        serve_async_ps(service, address)[0]
+        hist = run_async_worker(service, _loss, 0, batches, steps)
+        # chief keeps serving until the other worker finishes too
+        deadline = time.time() + 120
+        while min(service.stats()["steps"]) < steps:
+            if time.time() > deadline:
+                raise TimeoutError(f"worker 1 never finished: "
+                                   f"{service.stats()}")
+            time.sleep(0.05)
+        result = dict(service.stats(), rank=0,
+                      losses=[l for _, l in hist],
+                      final_w=[float(x) for x in service.pull()[0]["w"]])
+    else:
+        svc = connect_async_ps(address)
+        hist = run_async_worker(svc, _loss, 1, batches, steps, delay=0.05)
+        result = dict(svc.stats(), rank=1, losses=[l for _, l in hist])
+
+    with open(os.path.join(out_dir, f"async_result_{rank}.json"), "w") as f:
+        json.dump(result, f)
+    print(f"rank {rank} done: {result['version']} versions")
+
+
+if __name__ == "__main__":
+    main()

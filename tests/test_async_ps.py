@@ -186,6 +186,82 @@ def test_async_runtime_rejects_unsupported_features():
     loss, params = _mixed_model()
     ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(2),
                   strategy_builder=PS(sync=False))
-    with _pytest.raises(NotImplementedError, match="has_rng"):
-        ad.distribute(lambda p, b, r: 0.0, params, optax.sgd(0.02),
-                      has_rng=True)
+    with _pytest.raises(NotImplementedError, match="mutable_state"):
+        ad.distribute(loss, params, optax.sgd(0.02),
+                      mutable_state={"bn": jnp.zeros(3)})
+
+
+def test_async_has_rng_and_aux_through_distribute():
+    """has_rng/has_aux now flow through the async runtime (VERDICT r3
+    item 7): per-(worker, step) rng streams, aux in aux_history."""
+    import jax
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.kernel.synchronization.async_ps import (
+        AsyncPSEngineSession)
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import PS
+
+    r = np.random.RandomState(5)
+    params = {"w": jnp.asarray(r.randn(6), jnp.float32)}
+
+    def loss(p, b, rng):
+        noise = 0.01 * jax.random.normal(rng, b.shape)
+        pred = (b + noise) @ p["w"]
+        return jnp.mean(pred ** 2), jnp.max(jnp.abs(pred))
+
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(2),
+                  strategy_builder=PS(sync=False, staleness=1))
+    sess = ad.distribute(loss, params, optax.sgd(0.02), has_rng=True,
+                         has_aux=True)
+    assert isinstance(sess, AsyncPSEngineSession)
+    steps = 4
+    sess.run(_streams(sess.num_workers), steps)
+    assert sess.version == steps * sess.num_workers
+    assert all(np.isfinite(l) for _, _, l in sess.history)
+    aux = sess.aux_history
+    assert len(aux) == steps * sess.num_workers
+    assert all(np.isfinite(float(a)) for _, _, a in aux)
+    # a second run() must not replay the first run's rng streams: same
+    # batches, (near-)converged identical params would otherwise repeat
+    # identical noise — assert the folded step base advanced
+    assert sess._inner._rng_step_base == steps
+    sess.run(_streams(sess.num_workers), 2)
+    assert sess._inner._rng_step_base == steps + 2
+
+
+def test_async_service_tcp_roundtrip():
+    """The cross-process service over a real localhost TCP socket (the
+    2-real-process case lives in tests/integration/test_async_service.py):
+    two polled workers, bounded lead, finite convergent state."""
+    import threading
+
+    from autodist_tpu.kernel.synchronization.async_service import (
+        AsyncPSService, connect_async_ps, run_async_worker, serve_async_ps)
+
+    r = np.random.RandomState(0)
+    p0 = {"w": jnp.asarray(r.randn(6), jnp.float32)}
+    service = AsyncPSService(p0, optax.sgd(0.02), staleness=1,
+                             num_workers=2)
+    _, address = serve_async_ps(service, ("127.0.0.1", 0))  # ephemeral port
+    proxy = connect_async_ps(address)
+    streams = _streams(2)
+    results = {}
+
+    def drive(wid, delay):
+        # worker 0 drives the service directly (the chief's local path),
+        # worker 1 through the TCP proxy
+        results[wid] = run_async_worker(proxy if wid else service, _loss,
+                                        wid, streams[wid], 6, delay=delay)
+
+    ts = [threading.Thread(target=drive, args=(w, 0.02 * w), daemon=True)
+          for w in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    stats = service.stats()
+    assert stats["version"] == 12
+    assert stats["steps"] == [6, 6]
+    assert stats["max_lead_seen"] <= 1
+    assert all(np.isfinite(l) for _, l in results[0] + results[1])

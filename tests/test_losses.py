@@ -13,20 +13,21 @@ N, D, V = 24, 16, 96
 
 
 def dense_xent(hidden, table, targets, valid=None, bias=None):
-    """Reference: materialized (N, V) logits, standard masked-mean NLL."""
+    """Reference: materialized (N, V) logits, weighted-mean NLL with the
+    dense ``gpt_loss`` mask semantics (weights multiply numerator AND
+    denominator)."""
     h = hidden.reshape(-1, hidden.shape[-1]).astype(jnp.float32)
     logits = h @ table.astype(jnp.float32).T
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)[None, :]
     t = targets.reshape(-1)
-    mask = t >= 0
+    w = (t >= 0).astype(jnp.float32)
     if valid is not None:
-        mask = mask & (valid.reshape(-1) > 0)
-    safe = jnp.where(mask, t, 0)
+        w = w * valid.reshape(-1).astype(jnp.float32)
+    safe = jnp.where(t >= 0, t, 0)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tl = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
-    m = mask.astype(jnp.float32)
-    return jnp.sum((lse - tl) * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.sum((lse - tl) * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 @pytest.fixture
@@ -39,18 +40,35 @@ def data():
     return h, table, jnp.asarray(t, jnp.int32)
 
 
-@pytest.mark.parametrize("chunk", [V, 32, 7])  # 7 does not divide 96 ->
-def test_loss_matches_dense(data, chunk):      # falls back to a divisor
+@pytest.mark.parametrize("chunk", [V, 32, 7, 50])  # 7/50 don't divide 96:
+def test_loss_matches_dense(data, chunk):          # vocab pads + col mask
     h, table, t = data
     got = streaming_softmax_xent(h, table, t, chunk=chunk)
     want = dense_xent(h, table, t)
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
-def test_grads_match_dense(data):
+@pytest.mark.parametrize("chunk", [32, 50])
+def test_dv_layout_matches(data, chunk):
+    """(D, V) head kernels stream without a transpose copy; grads come
+    back in (D, V) layout."""
+    h, table, t = data
+    table_dv = jnp.asarray(np.asarray(table).T)
+    got = streaming_softmax_xent(h, table_dv, t, chunk=chunk, layout="dv")
+    want = dense_xent(h, table, t)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    g_s = jax.grad(lambda w: streaming_softmax_xent(
+        h, w, t, chunk=chunk, layout="dv"))(table_dv)
+    g_d = jax.grad(lambda w: dense_xent(h, w, t))(table)
+    np.testing.assert_allclose(g_s, np.asarray(g_d).T, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("chunk", [32, 50])  # 50: padded final chunk
+def test_grads_match_dense(data, chunk):
     h, table, t = data
 
-    g_s = jax.grad(lambda hh, w: streaming_softmax_xent(hh, w, t, chunk=32),
+    g_s = jax.grad(lambda hh, w: streaming_softmax_xent(hh, w, t,
+                                                        chunk=chunk),
                    argnums=(0, 1))(h, table)
     g_d = jax.grad(lambda hh, w: dense_xent(hh, w, t),
                    argnums=(0, 1))(h, table)
@@ -76,6 +94,17 @@ def test_valid_mask(data):
                         jnp.float32)
     got = streaming_softmax_xent(h, table, t, valid=valid, chunk=32)
     want = dense_xent(h, table, t, valid=valid)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_nonbinary_weights_match_dense(data):
+    """Fractional mask values weight the mean (numerator AND denominator)
+    — the dense gpt_loss semantics (the capture-level test below pins the
+    full-path agreement through _positional_mask)."""
+    h, table, t = data
+    w = jnp.asarray(np.random.RandomState(3).rand(N), jnp.float32)
+    got = streaming_softmax_xent(h, table, t, valid=w, chunk=32)
+    want = dense_xent(h, table, t, valid=w)
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
@@ -159,7 +188,9 @@ def test_gpt_capture_streaming_with_session_mask():
 
     r = np.random.RandomState(2)
     batch = _batch(r, 4, 16, GPT_TINY.vocab_size)
-    batch[BATCH_MASK_KEY] = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    # non-binary weights: the streaming path must weight the mean exactly
+    # like the dense gpt_loss (numerator and denominator)
+    batch[BATCH_MASK_KEY] = jnp.asarray([1.0, 0.5, 0.25, 0.0])
     rng = jax.random.PRNGKey(0)
     loss_d, params, _ = train_lib.gpt_capture(GPT_TINY, 16)
     loss_s, _, _ = train_lib.gpt_capture(GPT_TINY, 16, streaming_loss=True,
