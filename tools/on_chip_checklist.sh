@@ -1,27 +1,61 @@
 #!/bin/sh
 # On-chip validation checklist — run when TPU hardware is reachable
-# (STATUS.md "Next round" items 1-3).  Artifacts land in ./onchip_results/.
+# (VERDICT r3 items 2-4).  Artifacts land in ./onchip_results/; successful
+# bench.py runs also update BENCH_MEASURED.json (commit it!).
 set -x
 mkdir -p onchip_results
 
 # 1. North-star bench (driver metric) + profiler trace
 BENCH_TRACE=onchip_results/trace python bench.py | tee onchip_results/bench.json
+python tools/trace_summary.py onchip_results/trace \
+    | tee onchip_results/trace_summary.txt || true
 
-# 2. BERT-base per-strategy sweep + cost-model ranking validation
+# 1b. MFU push (VERDICT r3 item 2): exact space_to_depth stem + batch sweep
+BENCH_STEM=space_to_depth python bench.py \
+    | tee onchip_results/bench_s2d.json
+BENCH_STEM=space_to_depth BENCH_BATCH=512 python bench.py \
+    | tee onchip_results/bench_s2d_b512.json
+BENCH_BATCH=512 python bench.py | tee onchip_results/bench_b512.json
+
+# 2. GPT long-context flagship as a recorded driver metric (item 6):
+#    S=1024, flash attention, streaming vocab loss, remat
+BENCH_MODEL=gpt_small python bench.py | tee onchip_results/bench_gpt.json
+BENCH_MODEL=gpt_small BENCH_BATCH=16 python bench.py \
+    | tee onchip_results/bench_gpt_b16.json
+
+# 3. Pallas surface on the real Mosaic compile path (item 3)
+# (AUTODIST_TEST_TPU=1 stops conftest from force-pinning the cpu platform)
+AUTODIST_TEST_TPU=1 python -m pytest tests/test_pallas_quantize.py \
+    tests/test_flash_attention.py tests/test_ring_attention.py -v \
+    | tee onchip_results/pallas.log
+
+# 3b. optimized-HLO receipt: the AR bucket's collective operand dtype
+# (bf16/int8 on the wire) on the TPU compile path
+AUTODIST_DUMP_HLO=onchip_results/hlo python - <<'EOF' 2>&1 | tee onchip_results/wire_dtype.log
+import numpy as np, optax, jax.numpy as jnp
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce
+p = {"w": jnp.zeros((128, 128), jnp.float32)}
+loss = lambda p_, b: jnp.mean((b @ p_["w"]) ** 2)
+ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(1),
+              strategy_builder=AllReduce(compressor="BF16Compressor"))
+sess = ad.distribute(loss, p, optax.sgd(0.1))
+sess.run(np.random.RandomState(0).randn(8, 128).astype(np.float32))
+print("HLO dumped to onchip_results/hlo")
+EOF
+
+# 4. BERT-base per-strategy sweep + cost-model ranking validation (item 4)
 python examples/benchmark.py --model bert_base \
     --strategies "AllReduce,PS,PartitionedPS,Parallax" \
     --records_dir onchip_results/records --batch_per_chip 32 --steps 20 \
     | tee onchip_results/bert_sweep.log
 
-# 3. Pallas int8 kernels vs the jnp path on real hardware
-# (AUTODIST_TEST_TPU=1 stops conftest from force-pinning the cpu platform)
-AUTODIST_TEST_TPU=1 python -m pytest tests/test_pallas_quantize.py -v \
-    | tee onchip_results/pallas.log
+# 5. GPT throughput via the harness (longer S, engine sweep levers)
+python examples/benchmark.py --model gpt_small --batch_per_chip 8 \
+    --seq_len 2048 --streaming_loss --remat --steps 10 \
+    | tee onchip_results/gpt_s2048.log
 
-# 4. GPT throughput (long-context flagship)
-python examples/benchmark.py --model gpt_small --batch_per_chip 16 \
-    --seq_len 512 --steps 10 | tee onchip_results/gpt.log
-
-# 5. Input pipeline at speed: native loader + device double-buffer
+# 6. Input pipeline at speed: native loader + device double-buffer
 python examples/benchmark.py --model resnet50 --data real \
     --batch_per_chip 64 --steps 12 | tee onchip_results/real_data.log
