@@ -184,6 +184,25 @@ class AutoDist:
                     f"async PS runtime (sync=False) does not support "
                     f"{sorted(unsupported)}; use the synchronous engine "
                     f"or drop these options")
+            n_nodes = len(self._resource_spec.node_addresses)
+            if n_nodes > 1 or ENV.AUTODIST_NUM_PROCESSES.val > 1:
+                # multi-process deployment: the chief serves the TCP PS,
+                # every rank (chief included) drives one worker — the
+                # reference's PS-reachable-from-AutoDist() shape
+                # (server_starter.py:50-76) through the front door.  The
+                # barrier size comes from the SPEC when it is multi-node
+                # (the chief's own env never carries
+                # AUTODIST_NUM_PROCESSES — worker_env only hands it to
+                # workers), falling back to the env contract for
+                # spec-less worker processes.
+                from autodist_tpu.kernel.synchronization.async_service import (
+                    AsyncPSClusterSession)
+
+                return AsyncPSClusterSession(
+                    strategy, item, run_id=raw.id,
+                    num_workers=(n_nodes if n_nodes > 1
+                                 else ENV.AUTODIST_NUM_PROCESSES.val),
+                    chief_host=self._resource_spec.chief)
             from autodist_tpu.kernel.synchronization.async_ps import (
                 AsyncPSEngineSession)
 
@@ -230,14 +249,29 @@ class AutoDist:
             "coordinator_port": coordinator_port}
         coordinator = Coordinator(self._resource_spec, **kw)
         self._coordinator = coordinator  # keep monitors/terminate reachable
-        coordinator.setup(raw)  # chief launches workers; everyone joins
-
-        return self._assemble_session(
-            item, raw,
+        session_kwargs = dict(
             rng=kwargs.pop("rng", None),
             donate=kwargs.pop("donate", True),
             batch_mask=kwargs.pop("batch_mask", False),
             **kwargs)
+        if _strategy_requests_async(raw.proto):
+            # async runtime: each process drives only its LOCAL devices
+            # through the host PS, so there is no SPMD group to join —
+            # skip jax.distributed.  The chief BINDS the service first
+            # (assemble), then publishes the BOUND address into the env
+            # the workers are launched with, so an ephemeral-port
+            # (":0") request reaches them resolved.
+            import os
+
+            sess = self._assemble_session(item, raw, **session_kwargs)
+            cl = coordinator.cluster
+            if cl.num_processes > 1 and cl.is_chief:
+                if getattr(sess, "address", None):
+                    os.environ["AUTODIST_ASYNC_PS_ADDR"] = sess.address
+                cl.launch_workers(raw.id)
+            return sess
+        coordinator.setup(raw)  # chief launches workers; everyone joins
+        return self._assemble_session(item, raw, **session_kwargs)
 
     @contextlib.contextmanager
     def scope(self):

@@ -92,6 +92,8 @@ class Cluster:
         """Env contract the chief hands to each worker (reference
         coordinator.py:69-79)."""
         rank = self._rank_order().index(worker_address)
+        from autodist_tpu.const import DEFAULT_ASYNC_PS_PORT
+
         env = {
             "AUTODIST_WORKER": worker_address,
             "AUTODIST_STRATEGY_ID": strategy_id or "",
@@ -99,6 +101,11 @@ class Cluster:
             "AUTODIST_NUM_PROCESSES": str(self.num_processes),
             "AUTODIST_COORDINATOR": self.coordinator_address,
             "AUTODIST_MIN_LOG_LEVEL": ENV.AUTODIST_MIN_LOG_LEVEL.val,
+            # where the chief's async PS serves, should the strategy go
+            # async (harmless otherwise); the chief's own override wins so
+            # an ephemeral bound port can be handed down
+            "AUTODIST_ASYNC_PS_ADDR": ENV.AUTODIST_ASYNC_PS_ADDR.val
+            or f"{self._spec.chief}:{DEFAULT_ASYNC_PS_PORT}",
         }
         ssh = self._spec.ssh_config(worker_address)
         if ssh is not None:

@@ -23,6 +23,11 @@ REPLICA_PREFIX = "autodist-replica"
 DEFAULT_PORT_RANGE = (15000, 16000)
 DEFAULT_COORDINATOR_PORT = 15501
 
+# Default port the chief's cross-process async parameter server binds
+# (kernel/synchronization/async_service.py); override per run with
+# ENV.AUTODIST_ASYNC_PS_ADDR ("host:port", port 0 = ephemeral).
+DEFAULT_ASYNC_PS_PORT = 15990
+
 # Default mesh axis names.  "replica" is the data-parallel axis (the only
 # axis the reference's strategies use); the others are forward-looking axes
 # for tensor/pipeline/sequence/expert parallelism (SURVEY.md section 2.8).
@@ -60,6 +65,7 @@ class ENV(Enum):
     AUTODIST_PROCESS_ID = (lambda v: int(v) if v else 0,)
     AUTODIST_NUM_PROCESSES = (lambda v: int(v) if v else 1,)
     AUTODIST_COORDINATOR = (lambda v: v or "",)
+    AUTODIST_ASYNC_PS_ADDR = (lambda v: v or "",)
     SYS_DATA_PATH = (lambda v: v or "",)
     SYS_RESOURCE_PATH = (lambda v: v or "",)
 

@@ -58,6 +58,18 @@ class TokenBarrier:
                 self.max_lead_seen,
                 self._steps[worker] - min(self._steps))
 
+    def probe(self, worker):
+        """Non-blocking :meth:`wait_turn`: True when ``worker`` is within
+        the staleness bound right now (records the observed lead).  The
+        polled form used by cross-process clients, kept here so the lead
+        computation has exactly one owner (ADVICE r4)."""
+        with self._cv:
+            lead = self._steps[worker] - min(self._steps)
+            if lead <= self._s:
+                self.max_lead_seen = max(self.max_lead_seen, lead)
+                return True
+            return False
+
     def advance(self, worker):
         with self._cv:
             self._steps[worker] += 1
@@ -67,6 +79,52 @@ class TokenBarrier:
     def steps(self):
         with self._cv:
             return list(self._steps)
+
+
+def resolve_async_plans(strategy, model_item):
+    """Shared strategy→async-runtime resolution: validate the ModelItem is
+    async-runnable, build the variable plans, and collapse the per-variable
+    staleness fields into the single global bound (MIN over async PS nodes
+    — only the tightest bound satisfies every variable's contract).
+
+    Returns ``(plans, staleness)``.  Used by both the thread-local
+    :class:`AsyncPSEngineSession` and the cross-process
+    :class:`~autodist_tpu.kernel.synchronization.async_service
+    .AsyncPSClusterSession` so the two front-door routes cannot drift.
+    """
+    from autodist_tpu.kernel.partitioner import SyncKind, build_var_plans
+
+    if model_item.optimizer is None:
+        raise ValueError("ModelItem has no optimizer")
+    for feature, flag in (("eval_fn", model_item.eval_fn is not None),
+                          ("mutable_state",
+                           model_item.mutable_state is not None)):
+        if flag:
+            raise NotImplementedError(
+                f"async PS runtime does not support {feature} yet; "
+                f"use the synchronous engine (sync=True)")
+    plans = build_var_plans(strategy, model_item, num_replicas=1)
+    stale = [p.staleness for p in plans.values()
+             if p.sync == SyncKind.PS and not p.ps_sync]
+    if not stale:
+        raise ValueError(
+            "strategy has no async (sync=False) PS node; the "
+            "synchronous engine handles it")
+    ar_nodes = sorted(n for n, p in plans.items()
+                      if p.sync == SyncKind.ALL_REDUCE)
+    if ar_nodes:
+        # loud, at session build (VERDICT r3 item 7): the user asked
+        # for AR on these variables but selected an async strategy — a
+        # worker running ahead cannot rendezvous for collectives, so
+        # they are host-served asynchronously like the PS nodes
+        logging.warning(
+            "Async PS runtime: %d AllReduce-labeled variable(s) %s "
+            "degrade to asynchronous host serving — per-step collective "
+            "semantics cannot hold when workers run ahead (reference: "
+            "async mode serializes everything through the PS too). Use "
+            "sync=True for true per-step AllReduce.",
+            len(ar_nodes), ar_nodes)
+    return plans, min(stale)
 
 
 class AsyncPSEngineSession:
@@ -96,42 +154,9 @@ class AsyncPSEngineSession:
 
     def __init__(self, strategy, model_item, *, devices=None,
                  num_workers=None):
-        from autodist_tpu.kernel.partitioner import (SyncKind,
-                                                     build_var_plans)
-
-        if model_item.optimizer is None:
-            raise ValueError("ModelItem has no optimizer")
-        for feature, flag in (("eval_fn", model_item.eval_fn is not None),
-                              ("mutable_state",
-                               model_item.mutable_state is not None)):
-            if flag:
-                raise NotImplementedError(
-                    f"async PS runtime does not support {feature} yet; "
-                    f"use the synchronous engine (sync=True)")
         self.strategy = strategy
         self.model_item = model_item
-        self.plans = build_var_plans(strategy, model_item, num_replicas=1)
-        stale = [p.staleness for p in self.plans.values()
-                 if p.sync == SyncKind.PS and not p.ps_sync]
-        if not stale:
-            raise ValueError(
-                "strategy has no async (sync=False) PS node; the "
-                "synchronous engine handles it")
-        ar_nodes = sorted(n for n, p in self.plans.items()
-                          if p.sync == SyncKind.ALL_REDUCE)
-        if ar_nodes:
-            # loud, at session build (VERDICT r3 item 7): the user asked
-            # for AR on these variables but selected an async strategy — a
-            # worker running ahead cannot rendezvous for collectives, so
-            # they are host-served asynchronously like the PS nodes
-            logging.warning(
-                "Async PS runtime: %d AllReduce-labeled variable(s) %s "
-                "degrade to asynchronous host serving — per-step collective "
-                "semantics cannot hold when workers run ahead (reference: "
-                "async mode serializes everything through the PS too). Use "
-                "sync=True for true per-step AllReduce.",
-                len(ar_nodes), ar_nodes)
-        self.staleness = min(stale)
+        self.plans, self.staleness = resolve_async_plans(strategy, model_item)
         self._inner = AsyncPSSession(
             model_item.loss_fn, model_item.params, model_item.optimizer,
             staleness=self.staleness, devices=devices,

@@ -93,12 +93,19 @@ class ResNet(nn.Module):
     # the identical function with far better MXU lane utilization (3
     # input channels waste 125/128 lanes).
     stem: str = "conv"
+    # True: batch-norm reduces mean/var in float32 (flax default; exact).
+    # False: stats reduce in the compute dtype (bf16 here) — halves the
+    # BN-stat HBM traffic that profiling showed at ~30% of the forward
+    # pass (docs/performance.md), at a small stats-precision cost.  A perf
+    # lever for bench sweeps (BENCH_BN_STATS=bf16), not the default.
+    bn_f32_stats: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
-                       epsilon=1e-5, dtype=self.dtype)
+                       epsilon=1e-5, dtype=self.dtype,
+                       force_float32_reductions=self.bn_f32_stats)
         x = x.astype(self.dtype)
         if self.stem == "space_to_depth":
             x = space_to_depth(x, 2)

@@ -9,11 +9,22 @@ number too (VERDICT r3 item 6).
 Prints ONE JSON line — always — and exits 0, structured so it cannot fail
 silently (VERDICT r2 item 1):
 
-  1. a ~60 s subprocess PROBE of ``jax.devices()`` first: if backend init
-     hangs or errors, the error JSON is printed immediately;
-  2. the measurement runs in a child with a <=240 s timeout, one retry
-     (half batch only on a narrowly-matched OOM);
-  3. total wall-clock is capped (default 600 s) by the parent, with a
+  1. a subprocess PROBE of ``jax.devices()``, RETRIED across the whole
+     budget (VERDICT r4 item 1): the relay is known to come up
+     intermittently, so a wedged probe at second 0 is a delay, not a
+     round-fatal failure.  Probing stops only when too little wall-clock
+     remains to measure anything; the error record then carries every
+     attempt's timing.
+  2. on the FIRST probe success the measurement runs immediately in a
+     child with a <=240 s timeout, one retry (half batch only on a
+     narrowly-matched OOM);
+  3. with budget left after the headline measurement, extra children
+     measure the ``space_to_depth`` stem variant (picking the best-MFU
+     record as headline, honestly labeled) and the ``gpt_small`` model,
+     whose record lands in the same single JSON line under
+     ``secondary`` (VERDICT r4 items 2+3 — env-only model selection
+     meant the driver could never see gpt_small);
+  4. total wall-clock is capped (default 600 s) by the parent, with a
      watchdog that prints a diagnostic JSON line BEFORE any external
      deadline it cannot control.
 
@@ -147,7 +158,19 @@ def _error_rec(cause, detail=""):
 
 # ---------------------------------------------------------------- probe --
 
+def _force_requested_platform():
+    """The image's sitecustomize may pin ``jax_platforms=axon,cpu`` at
+    interpreter start, overriding the JAX_PLATFORMS env var; honor an
+    explicit cpu request at the config level so CPU smoke runs of this
+    file can't hang on a wedged relay.  No-op for real driver runs."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def _probe():
+    _force_requested_platform()
     import jax
 
     ds = jax.devices()
@@ -177,9 +200,12 @@ def _build_resnet(n_chips, batch_per_chip):
 
     B = batch_per_chip * n_chips
     # bf16 compute (default dtype); BENCH_STEM=space_to_depth selects the
-    # exact MXU-friendly stem reparametrization (tests/test_models.py)
+    # exact MXU-friendly stem reparametrization (tests/test_models.py);
+    # BENCH_BN_STATS=bf16 reduces BN stats in bf16 (approximate — manual
+    # experiments only, never the recorded default)
     stem = os.environ.get("BENCH_STEM", "conv")
-    model = ResNet50(num_classes=1000, stem=stem)
+    bn_f32 = os.environ.get("BENCH_BN_STATS", "f32") != "bf16"
+    model = ResNet50(num_classes=1000, stem=stem, bn_f32_stats=bn_f32)
     loss_fn, params, state = train_lib.classifier_capture(model, (224, 224, 3))
     ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
                   strategy_builder=AllReduce())
@@ -194,7 +220,7 @@ def _build_resnet(n_chips, batch_per_chip):
     gbatch = sess._shard_batch(batch)
     gbatch["image"] = jnp.asarray(gbatch["image"], jnp.bfloat16)
     return sess, gbatch, MODELS["resnet50"]["train_flops_per_example"], {
-        "stem": stem}
+        "stem": stem, "bn_stats": "f32" if bn_f32 else "bf16"}
 
 
 def _build_gpt(n_chips, batch_per_chip):
@@ -245,6 +271,7 @@ def _build_gpt(n_chips, batch_per_chip):
 
 def _bench():
     _stage("import")
+    _force_requested_platform()
     import jax
 
     from autodist_tpu.utils.timing import (fetch_scalar, measure_per_step,
@@ -350,7 +377,10 @@ def _run_child(env_extra, timeout_s):
     them out of the tail."""
     env = dict(os.environ, **env_extra)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache")
-    metric = MODELS[_model_name()]["metric"]
+    # the child's model comes from the MERGED env — _measure_model may
+    # override BENCH_MODEL per-child (gpt_small secondary)
+    child_model = env.get("BENCH_MODEL", "resnet50")
+    metric = MODELS.get(child_model, MODELS["resnet50"])["metric"]
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,
@@ -407,25 +437,112 @@ def main():
     watchdog.daemon = True
     watchdog.start()
 
-    # 1) backend probe: fail fast + loud when the TPU is unreachable
+    # 1) backend probe, retried across the WHOLE budget (VERDICT r4 item
+    # 1): four rounds of official records died on a single 75 s probe
+    # while the relay is known to come up intermittently.  Keep probing
+    # until <90 s of wall-clock remain; a probe that hung for its full
+    # timeout already consumed real time, so only the fast failures get
+    # the long inter-attempt sleep.
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
-    rec, info, _ = _run_child({"_BENCH_PROBE": "1"}, probe_timeout)
-    if rec is None:
-        _emit(_error_rec("backend_probe_failed", info))
+    retry_sleep = int(os.environ.get("BENCH_PROBE_RETRY_SLEEP", "45"))
+    probe = None
+    attempts = []
+    while probe is None:
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 90:  # not enough left to measure even if it answered
+            break
+        t0 = time.monotonic()
+        # leave >=60 s after the probe for a measurement attempt
+        rec, info, _ = _run_child({"_BENCH_PROBE": "1"},
+                                  int(min(probe_timeout, remaining - 60)))
+        took = time.monotonic() - t0
+        if rec is not None:
+            probe = rec
+            break
+        attempts.append({"t_start_s": round(t0 - t_start, 1),
+                         "took_s": round(took, 1), "error": info[:200]})
+        # hung probes already burned wall-clock; only fast failures get
+        # the long sleep — and the break guard must use the sleep that
+        # would ACTUALLY happen, or a wedged-relay round gives up with a
+        # probe+measurement still affordable
+        next_sleep = retry_sleep if took < 30 else 10
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 90 + next_sleep:
+            break
+        time.sleep(next_sleep)
+    if probe is None:
+        _emit(_error_rec("backend_probe_failed",
+                         f"{len(attempts)} probe attempts spanning "
+                         f"{round(time.monotonic() - t_start)}s of {budget}s "
+                         f"budget: {json.dumps(attempts)}"))
         return
-    probe = rec
+    probe["n_probe_attempts"] = len(attempts) + 1
 
-    # 2) measurement: <=240s per attempt, one retry; half batch only on OOM
-    default_batch = MODELS[_model_name()]["default_batch"]
+    # 2) headline measurement: <=240 s per attempt, one retry; half batch
+    # only on a narrowly-matched OOM
+    rec, last_err = _measure_model(_model_name(), {}, probe, budget, t_start)
+    if rec is None:
+        _emit(_error_rec("all_attempts_failed",
+                         f"probe={probe} | {last_err}"))
+        return
+
+    # 3) budget-permitting extras (VERDICT r4 items 2+3).  Only for the
+    # default driver invocation — an explicit BENCH_MODEL/BENCH_STEM run
+    # is a manual experiment and gets exactly what it asked for.
+    if (_model_name() == "resnet50" and "BENCH_STEM" not in os.environ
+            and "BENCH_MODEL" not in os.environ):
+        # 3a) space_to_depth stem: exact MXU-friendly reparametrization of
+        # the 7x7/s2 stem — measure it and let the best MFU be headline
+        if budget - (time.monotonic() - t_start) > 150:
+            alt, _ = _measure_model(
+                "resnet50", {"BENCH_STEM": "space_to_depth"}, probe,
+                budget, t_start, max_tries=1)
+            if alt is not None:
+                # a timing_suspect record (physically impossible MFU) can
+                # never displace an honest one as headline
+                def _rank(r):
+                    return (not r.get("timing_suspect"), r["mfu"])
+
+                best, other = ((alt, rec) if _rank(alt) > _rank(rec)
+                               else (rec, alt))
+                best["stem_variants"] = {
+                    other["stem"]: {k: other[k] for k in
+                                    ("value", "mfu", "step_ms")}}
+                rec = best
+                # both variants share the metric key in BENCH_MEASURED —
+                # make sure the BEST one is what persists
+                if (not rec.get("timing_suspect")
+                        and rec.get("backend") != "cpu"):
+                    try:
+                        _save_measured(rec)
+                    except OSError:
+                        pass
+        # 3b) gpt_small: the long-context flagship, embedded as a labeled
+        # secondary record so the fixed driver command still surfaces it
+        if budget - (time.monotonic() - t_start) > 120:
+            gpt, _ = _measure_model("gpt_small", {}, probe, budget,
+                                    t_start, max_tries=1)
+            if gpt is not None:
+                rec["secondary"] = gpt
+    _emit(rec)
+
+
+def _measure_model(name, env_extra, probe, budget, t_start, max_tries=2):
+    """Run measurement children for ``name``; returns (rec|None, err).
+
+    Each successful on-chip record is persisted to BENCH_MEASURED.json
+    immediately — durable evidence survives even if a later child hangs
+    past the watchdog."""
+    default_batch = MODELS[name]["default_batch"]
     oom_seen = False
     last_err = ""
-    for attempt in range(2):
+    for attempt in range(max_tries):
         remaining = budget - (time.monotonic() - t_start) - 30
         child_timeout = int(min(240, remaining))
         if child_timeout < 60:
             last_err += " | no wall-clock left for another attempt"
             break
-        env = {"_BENCH_CHILD": "1"}
+        env = {"_BENCH_CHILD": "1", "BENCH_MODEL": name, **env_extra}
         if attempt == 1 and oom_seen and "BENCH_BATCH" not in os.environ:
             env["BENCH_BATCH"] = str(default_batch // 2)
         rec, info, combined = _run_child(env, child_timeout)
@@ -441,13 +558,11 @@ def main():
                     _save_measured(rec)
                 except OSError:
                     pass
-            _emit(rec)
-            return
+            return rec, ""
         oom_seen = oom_seen or any(m in combined for m in _OOM_MARKERS)
         last_err = f"attempt {attempt + 1}: {info}"
         time.sleep(5)
-
-    _emit(_error_rec("all_attempts_failed", f"probe={probe} | {last_err}"))
+    return None, last_err
 
 
 if __name__ == "__main__":

@@ -31,7 +31,7 @@ def _loss(p, b):
 def main():
     rank, port, steps, staleness = map(int, sys.argv[1:5])
     out_dir = sys.argv[5]
-    address = ("127.0.0.1", port)
+    addr_file = os.path.join(out_dir, "ps_address.json")
     r = np.random.RandomState(10 + rank)
     batches = [r.randn(8, 6).astype(np.float32) for _ in range(4)]
 
@@ -40,7 +40,13 @@ def main():
                                jnp.float32)}
         service = AsyncPSService(p0, optax.sgd(0.02), staleness=staleness,
                                  num_workers=2)
-        serve_async_ps(service, address)[0]
+        # bind the requested port (0 = ephemeral, the flake-free rig —
+        # ADVICE r4) and PUBLISH the bound address for the other rank
+        _, bound = serve_async_ps(service, ("127.0.0.1", port))
+        tmp = addr_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"host": bound[0], "port": bound[1]}, f)
+        os.replace(tmp, addr_file)
         hist = run_async_worker(service, _loss, 0, batches, steps)
         # chief keeps serving until the other worker finishes too
         deadline = time.time() + 120
@@ -53,7 +59,14 @@ def main():
                       losses=[l for _, l in hist],
                       final_w=[float(x) for x in service.pull()[0]["w"]])
     else:
-        svc = connect_async_ps(address)
+        deadline = time.time() + 60
+        while not os.path.exists(addr_file):
+            if time.time() > deadline:
+                raise TimeoutError("rank 0 never published its address")
+            time.sleep(0.05)
+        with open(addr_file) as f:
+            a = json.load(f)
+        svc = connect_async_ps((a["host"], a["port"]))
         hist = run_async_worker(svc, _loss, 1, batches, steps, delay=0.05)
         result = dict(svc.stats(), rank=1, losses=[l for _, l in hist])
 

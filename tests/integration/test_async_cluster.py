@@ -1,7 +1,9 @@
-"""Cross-process async PS: the token barrier + bounded staleness across
-REAL OS processes (reference integration case c9 —
-``/root/reference/tests/integration/cases/c9.py:14-22`` — fast chief /
-slow worker, validated over the TCP-served parameter server)."""
+"""FRONT-DOOR cross-process async PS (VERDICT r4 item 6): two real OS
+processes drive the chief-served TCP parameter server purely through
+``AutoDist(resource_spec, PS(sync=False, staleness=s)).distribute()`` —
+the reference's PS-reachable-from-``AutoDist()`` deployment shape
+(``/root/reference/autodist/utils/server_starter.py:50-76``), with the c9
+bounded-staleness contract asserted on the result."""
 import json
 import os
 import subprocess
@@ -12,18 +14,18 @@ import pytest
 
 pytestmark = pytest.mark.integration
 
-WORKER = os.path.join(os.path.dirname(__file__), "async_ps_worker.py")
+WORKER = os.path.join(os.path.dirname(__file__), "async_cluster_worker.py")
 
 
-def test_two_process_async_bounded_staleness(tmp_path):
-    # port 0: rank 0 binds an ephemeral port and publishes it via tmp_path
-    # (a fixed port made concurrent runs flake — ADVICE r4)
-    steps, staleness, port = 8, 2, 0
+def test_frontdoor_two_process_async(tmp_path):
+    steps, staleness = 8, 2
     env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "AUTODIST_WORKER",
+                        "AUTODIST_PROCESS_ID", "AUTODIST_NUM_PROCESSES",
+                        "AUTODIST_ASYNC_PS_ADDR", "AUTODIST_STRATEGY_ID")}
     procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(rank), str(port), str(steps),
-         str(staleness), str(tmp_path)],
+        [sys.executable, WORKER, str(rank), str(steps), str(staleness),
+         str(tmp_path)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for rank in range(2)]
     outs = []
@@ -40,19 +42,18 @@ def test_two_process_async_bounded_staleness(tmp_path):
 
     results = {}
     for rank in range(2):
-        with open(tmp_path / f"async_result_{rank}.json") as f:
+        with open(tmp_path / f"cluster_result_{rank}.json") as f:
             results[rank] = json.load(f)
 
     chief = results[0]
-    # both workers completed every step; every push was applied
+    # every step of both workers was pushed and applied
     assert chief["steps"] == [steps, steps]
     assert chief["version"] == 2 * steps
-    # the c9 contract across processes: the fast chief ran ahead of the
-    # delayed worker, but never beyond the staleness bound
+    # the c9 contract through the public API: the fast chief ran ahead of
+    # the delayed worker, never beyond the staleness bound
     assert 1 <= chief["max_lead_seen"] <= staleness
     # true asynchrony: stale gradients were applied
     assert chief["stale_pushes"] > 0
-    # progress on the convex problem + finite state all the way through
     assert all(np.isfinite(l) for l in chief["losses"])
     assert all(np.isfinite(l) for l in results[1]["losses"])
     assert all(np.isfinite(x) for x in chief["final_w"])

@@ -163,6 +163,37 @@ def test_async_selected_through_distribute():
     assert all(np.isfinite(l) for _, _, l in sess.history)
 
 
+def test_cluster_session_sizes_barrier_from_spec(monkeypatch):
+    """A multi-node spec routes to AsyncPSClusterSession with the barrier
+    sized from the SPEC, not the env — the chief's own environment never
+    carries AUTODIST_NUM_PROCESSES (code-review r5 finding)."""
+    import socket
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.kernel.synchronization.async_service import (
+        AsyncPSClusterSession)
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import PS
+
+    monkeypatch.delenv("AUTODIST_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("AUTODIST_PROCESS_ID", raising=False)
+    # ephemeral port: the chief binds and exposes the resolved address
+    monkeypatch.setenv("AUTODIST_ASYNC_PS_ADDR", "127.0.0.1:0")
+    spec = ResourceSpec(resource_info={"nodes": [
+        {"address": socket.gethostname(), "cpus": [0], "chief": True},
+        {"address": "worker-node", "cpus": [0]},
+        {"address": "worker-node-2", "cpus": [0]}]})
+    loss, params = _mixed_model()
+    ad = AutoDist(resource_spec=spec,
+                  strategy_builder=PS(sync=False, staleness=1))
+    sess = ad.distribute(loss, params, optax.sgd(0.02), sparse_vars=["emb"])
+    assert isinstance(sess, AsyncPSClusterSession)
+    assert sess.num_workers == 3
+    assert len(sess._service.barrier.steps) == 3
+    assert sess.is_chief and sess.worker_id == 0
+    assert not sess.address.endswith(":0")  # bound, resolved
+
+
 def test_sync_strategy_still_uses_spmd_engine():
     from autodist_tpu.autodist import AutoDist
     from autodist_tpu.resource_spec import ResourceSpec

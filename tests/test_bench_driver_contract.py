@@ -1,0 +1,185 @@
+"""Driver contract for bench.py's parent orchestration (VERDICT r4 items
+1-3): the probe RETRIES across the whole budget instead of dying on one
+attempt, the ``space_to_depth`` stem variant competes for headline on
+MFU, and ``gpt_small`` lands in the same single JSON line as a labeled
+``secondary`` record.  ``_run_child`` is mocked so no backend is touched
+— this pins the orchestration, not the measurement.
+"""
+import json
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+import bench  # noqa: E402
+
+
+@pytest.fixture
+def harness(monkeypatch, tmp_path, capsys):
+    """Reset the print-once latch, neutralize sleeps/saves, and return a
+    helper that runs main() with a scripted _run_child and parses the
+    single emitted JSON line."""
+    monkeypatch.setattr(bench, "_PRINTED", False)
+    monkeypatch.setattr(bench, "MEASURED_PATH", str(tmp_path / "m.json"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_git_sha", lambda: "testsha")
+    # the watchdog thread must not leak a timer that os._exit()s the
+    # test process minutes later
+    class _T:
+        def __init__(self, *a, **k):
+            self.daemon = True
+
+        def start(self):
+            pass
+
+    monkeypatch.setattr(bench.threading, "Timer", _T)
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    monkeypatch.delenv("BENCH_STEM", raising=False)
+    monkeypatch.delenv("BENCH_BUDGET", raising=False)
+
+    def run(script, budget=600):
+        """script: callable(env_extra, timeout_s) -> (rec, info, out)."""
+        monkeypatch.setenv("BENCH_BUDGET", str(budget))
+        monkeypatch.setattr(bench, "_run_child",
+                            lambda env, t: script(env, t))
+        bench.main()
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1, f"ONE JSON line contract broken: {out}"
+        return json.loads(out[0])
+
+    return run
+
+
+def _fake_rec(metric, mfu, stem=None, backend="axon"):
+    rec = {"metric": metric, "value": 100.0, "unit": "u", "mfu": mfu,
+           "step_ms": 10.0, "backend": backend, "vs_baseline": mfu / 0.35}
+    if stem is not None:
+        rec["stem"] = stem
+    return rec
+
+
+RESNET = bench.MODELS["resnet50"]["metric"]
+GPT = bench.MODELS["gpt_small"]["metric"]
+
+
+def test_probe_retries_span_budget(harness):
+    """Probe failures retry until <90s of budget remain; the error record
+    carries every attempt (the four-round single-probe failure mode)."""
+    calls = []
+    fake_clock = [0.0]
+
+    def script(env, timeout_s):
+        assert env.get("_BENCH_PROBE") == "1"
+        calls.append(timeout_s)
+        fake_clock[0] += 80.0  # each probe hangs ~80s of wall-clock
+        return None, "timeout after 75s (last stage: none)", ""
+
+    t0 = time.monotonic()
+    # monotonic must move with the scripted probes; patch via a counter
+    import types
+
+    real_mono = time.monotonic
+    bench.time = types.SimpleNamespace(
+        monotonic=lambda: t0 + fake_clock[0], sleep=lambda s: None,
+        time=real_mono)
+    try:
+        rec = harness(script, budget=600)
+    finally:
+        bench.time = time
+    assert rec["error"] == "backend_probe_failed"
+    assert len(calls) >= 5, f"only {len(calls)} probe attempts"
+    assert f"{len(calls)} probe attempts" in rec["detail"]
+
+
+def test_first_probe_success_measures_immediately(harness):
+    seen = []
+
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True, "backend": "axon"}, "", ""
+        seen.append(dict(env))
+        model = env.get("BENCH_MODEL", "resnet50")
+        if model == "gpt_small":
+            return _fake_rec(GPT, 0.30), "", ""
+        stem = env.get("BENCH_STEM", "conv")
+        return _fake_rec(RESNET, 0.20 if stem == "conv" else 0.40,
+                         stem=stem), "", ""
+
+    rec = harness(script)
+    # headline = the better-MFU stem variant, honestly labeled
+    assert rec["metric"] == RESNET
+    assert rec["stem"] == "space_to_depth" and rec["mfu"] == 0.40
+    assert rec["stem_variants"]["conv"]["mfu"] == 0.20
+    # gpt_small rides along as the labeled secondary record
+    assert rec["secondary"]["metric"] == GPT
+    assert rec["secondary"]["mfu"] == 0.30
+    assert rec["probe"]["n_probe_attempts"] == 1
+    # one resnet default + one stem variant + one gpt child
+    models = [(e.get("BENCH_MODEL"), e.get("BENCH_STEM")) for e in seen]
+    assert models == [("resnet50", None), ("resnet50", "space_to_depth"),
+                      ("gpt_small", None)]
+
+
+def test_conv_headline_kept_when_better(harness):
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True}, "", ""
+        model = env.get("BENCH_MODEL", "resnet50")
+        if model == "gpt_small":
+            return None, "gpt child died", ""
+        stem = env.get("BENCH_STEM", "conv")
+        return _fake_rec(RESNET, 0.40 if stem == "conv" else 0.20,
+                         stem=stem), "", ""
+
+    rec = harness(script)
+    assert rec["stem"] == "conv" and rec["mfu"] == 0.40
+    assert rec["stem_variants"]["space_to_depth"]["mfu"] == 0.20
+    # a failed secondary never blocks the headline emit
+    assert "secondary" not in rec
+
+
+def test_explicit_model_skips_extras(harness, monkeypatch):
+    monkeypatch.setenv("BENCH_MODEL", "gpt_small")
+    calls = []
+
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True}, "", ""
+        calls.append(env.get("BENCH_MODEL"))
+        return _fake_rec(GPT, 0.3), "", ""
+
+    rec = harness(script)
+    assert rec["metric"] == GPT
+    assert calls == ["gpt_small"]
+    assert "secondary" not in rec and "stem_variants" not in rec
+
+
+def test_onchip_records_persist_best_variant(harness, tmp_path):
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True}, "", ""
+        model = env.get("BENCH_MODEL", "resnet50")
+        if model == "gpt_small":
+            return _fake_rec(GPT, 0.30), "", ""
+        stem = env.get("BENCH_STEM", "conv")
+        return _fake_rec(RESNET, 0.20 if stem == "conv" else 0.40,
+                         stem=stem), "", ""
+
+    harness(script)
+    doc = json.loads((tmp_path / "m.json").read_text())
+    assert doc["records"][RESNET]["stem"] == "space_to_depth"
+    assert doc["records"][GPT]["mfu"] == 0.30
+
+
+def test_cpu_records_never_persist(harness, tmp_path):
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True, "backend": "cpu"}, "", ""
+        model = env.get("BENCH_MODEL", "resnet50")
+        metric = GPT if model == "gpt_small" else RESNET
+        return _fake_rec(metric, 0.4, stem=env.get("BENCH_STEM", "conv"),
+                         backend="cpu"), "", ""
+
+    harness(script)
+    assert not (tmp_path / "m.json").exists()

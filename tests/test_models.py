@@ -38,6 +38,30 @@ def test_resnet18_trains_with_batch_stats():
     assert np.any(bn["bn_init"]["mean"] != 0)  # stats updated + synced
 
 
+def test_bf16_bn_stats_close_to_f32():
+    """The BENCH_BN_STATS=bf16 perf lever (reduce BN stats in the compute
+    dtype) stays numerically close to the exact f32-stats model at init
+    and still trains."""
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.randn(8, 32, 32, 3), jnp.float32)
+    outs = {}
+    for f32 in (True, False):
+        model = ResNet18(num_classes=10, num_filters=8, dtype=jnp.bfloat16,
+                         bn_f32_stats=f32)
+        v = model.init(jax.random.PRNGKey(0), x, train=True)
+        y, _ = model.apply(v, x, train=True, mutable=["batch_stats"])
+        outs[f32] = np.asarray(y, np.float32)
+    # same function up to bf16 stats rounding
+    np.testing.assert_allclose(outs[True], outs[False], atol=0.15)
+    model = ResNet18(num_classes=10, num_filters=8, dtype=jnp.float32,
+                     bn_f32_stats=False)
+    loss_fn, params, state = train_lib.classifier_capture(model, (32, 32, 3))
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=AllReduce())
+    sess = ad.distribute(loss_fn, params, optax.sgd(0.1), mutable_state=state)
+    losses = [float(sess.run(_img_batch())["loss"]) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
 @pytest.mark.parametrize("model_fn,kwargs", [
     (ResNet50, dict(num_classes=10, num_filters=4, dtype=jnp.float32)),
     (DenseNet121, dict(num_classes=10, growth_rate=4, dtype=jnp.float32)),
