@@ -248,23 +248,31 @@ def measure_and_record(session, batch, resource_yaml="", steps=10, warmup=2):
         return m["loss"]
 
     dt, _ = measure_per_step(run_steps, k=max(1, steps // 3), repeats=1)
+    import jax
+
     t = session._t
     return RuntimeRecord(
         model_def=t.model_item.serialize(),
         strategy_pb=t.strategy.proto.SerializeToString(),
         resource_yaml=resource_yaml,
         step_time_s=dt,
+        backend=jax.default_backend(),
     )
 
 
 @dataclasses.dataclass
 class RuntimeRecord:
-    """AutoSync-style measured tuple: (model, resource, strategy, runtime)."""
+    """AutoSync-style measured tuple: (model, resource, strategy, runtime).
+
+    ``backend`` labels where the runtime was measured ("cpu" records are
+    pipeline-validation artifacts and must never be merged into hardware
+    claims — VERDICT r4 item 7)."""
 
     model_def: bytes          # ModelItemDef proto
     strategy_pb: bytes        # Strategy proto
     resource_yaml: str
     step_time_s: float
+    backend: str = ""
 
     def dump(self, path):
         import base64
@@ -275,5 +283,18 @@ class RuntimeRecord:
                 "strategy": base64.b64encode(self.strategy_pb).decode(),
                 "resource": self.resource_yaml,
                 "step_time_s": self.step_time_s,
+                "backend": self.backend,
             }, f)
         return path
+
+    @classmethod
+    def load(cls, path):
+        import base64
+
+        with open(path) as f:
+            d = json.load(f)
+        return cls(model_def=base64.b64decode(d["model_def"]),
+                   strategy_pb=base64.b64decode(d["strategy"]),
+                   resource_yaml=d["resource"],
+                   step_time_s=d["step_time_s"],
+                   backend=d.get("backend", ""))

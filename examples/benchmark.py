@@ -13,6 +13,13 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # honor an explicit cpu request at the config level too — the image's
+    # sitecustomize may have pinned jax_platforms=axon,cpu at interpreter
+    # start, and a wedged relay would otherwise hang backend init
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import optax
 
@@ -311,6 +318,7 @@ def sweep(args):
     estimated_rank = sorted(estimated, key=estimated.get)
     summary = {
         "model": args.model, "chips": n_chips,
+        "backend": jax.default_backend(),   # "cpu" = pipeline validation
         "batch_per_chip": args.batch_per_chip,
         "measured_step_s": measured, "estimated_step_s": estimated,
         "measured_rank": measured_rank, "estimated_rank": estimated_rank,

@@ -90,6 +90,86 @@ def test_calibration_degenerate():
     assert cal == {"compute_scale": 1.0, "comm_scale": 1.0, "overhead_s": 0.0}
 
 
+def test_record_measure_calibrate_rank_pipeline(tmp_path):
+    """The full AutoSync loop on the CPU mesh (relay-down insurance,
+    VERDICT r4 item 7): measure real sessions under three strategies,
+    dump/load RuntimeRecords (backend-labeled), fit a calibration from
+    the (estimate, measured) pairs, and rank with it — every stage of
+    the record→calibrate→rank pipeline exercised end-to-end.  The
+    committed ``records/cpu_mesh/`` artifacts are the script-level run
+    of this same pipeline (examples/benchmark.py --strategies)."""
+    import optax
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.simulator.cost_model import (RuntimeRecord, calibrate,
+                                                   measure_and_record)
+
+    r = np.random.RandomState(0)
+    params = {"emb": jnp.asarray(r.randn(512, 16), jnp.float32),
+              "w": jnp.asarray(r.randn(16, 8), jnp.float32)}
+
+    def loss(p, b):
+        h = p["emb"][b["ids"]] @ p["w"]
+        return jnp.mean(h ** 2)
+
+    batch = {"ids": r.randint(0, 512, (16,))}
+    pairs, measured = [], {}
+    for builder_cls in (AllReduce, PS, Parallax):
+        item = ModelItem(loss, params, optimizer=optax.sgd(0.01),
+                         sparse_vars=["emb"])
+        ad = AutoDist(resource_spec=SPEC8, strategy_builder=builder_cls())
+        sess = ad.distribute(loss, params, optax.sgd(0.01),
+                             sparse_vars=["emb"])
+        rec = measure_and_record(sess, sess._shard_batch(batch), steps=3,
+                                 warmup=1)
+        assert rec.backend == "cpu"           # labeled, never a hw claim
+        path = rec.dump(str(tmp_path / f"{builder_cls.__name__}.json"))
+        loaded = RuntimeRecord.load(path)
+        assert loaded.backend == "cpu"
+        assert loaded.step_time_s == rec.step_time_s
+        assert loaded.strategy_pb == rec.strategy_pb
+        est = estimate(sess._t.strategy, item, SPEC8)
+        pairs.append((est, rec.step_time_s))
+        measured[builder_cls.__name__] = rec.step_time_s
+    cal = calibrate(pairs)
+    assert set(cal) == {"compute_scale", "comm_scale", "overhead_s"}
+    assert all(v >= 0.0 for v in cal.values())
+    # the calibrated model must reproduce the measured times better than
+    # (or as well as) the raw analytic estimate on its own training set
+    raw_err = sum(abs(e.total_s - m) for e, m in pairs)
+    cal_err = sum(abs(e.calibrated_total(cal) - m) for e, m in pairs)
+    assert cal_err <= raw_err + 1e-9
+    # and ranking with the calibration runs end-to-end
+    order = rank_strategies([AllReduce(), PS(), Parallax()],
+                            _item(sparse=True), SPEC8, calibration=cal)
+    assert len(order) == 3
+
+
+def test_committed_cpu_records_load_and_are_labeled():
+    """The committed records/cpu_mesh artifacts stay loadable and
+    cpu-labeled (the dataset-consumption path of the AutoSync analog)."""
+    import glob
+    import json
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..", "records",
+                        "cpu_mesh")
+    from autodist_tpu.simulator.cost_model import RuntimeRecord
+
+    recs = [p for p in glob.glob(os.path.join(root, "*.json"))
+            if not p.endswith("summary.json")]
+    assert len(recs) >= 3
+    for p in recs:
+        rec = RuntimeRecord.load(p)
+        assert rec.backend == "cpu"
+        assert rec.step_time_s > 0
+        assert len(rec.strategy_pb) > 0 and len(rec.model_def) > 0
+    with open(os.path.join(root, "gpt_tiny_summary.json")) as f:
+        s = json.load(f)
+    assert s["backend"] == "cpu"
+    assert set(s["measured_rank"]) == set(s["estimated_rank"])
+
+
 def test_auto_strategy_with_calibration_file(tmp_path):
     """AutoStrategy loads a sweep summary JSON and ranks with the
     measured-grounded coefficients."""
