@@ -15,6 +15,14 @@ Model (per step, seconds):
                but param all-gather adds param_bytes * (R-1)/R each step
   sharded    ~ adds param all-gather on use (forward) as well
   sparse     ~ all-gather of touched rows only: batch * row_bytes * R factor
+  update     ~ opt_bytes_factor * update_bytes / hbm_bw — the optimizer
+               phase is HBM-traffic-bound (param + grad + moment reads,
+               param + moment writes).  Replicated placements touch the
+               FULL parameter set on every chip; weight-update-sharded
+               placements touch 1/R.  On a TPU mesh the wire volumes of
+               ring-AR and reduce-scatter+all-gather are IDENTICAL (that
+               equivalence is how the engine realizes PS), so this term
+               is what genuinely separates the dense strategies.
 """
 import dataclasses
 import json
@@ -26,6 +34,11 @@ DEFAULT_PEAK_FLOPS = 394e12        # bf16 FLOPs/s per chip (v5e ~394 TFLOPs)
 DEFAULT_MXU_EFF = 0.45
 DEFAULT_ICI_GBPS = 1600.0          # per-chip ICI bi-dir, Gbit/s
 DEFAULT_DCN_GBPS = 100.0
+DEFAULT_HBM_GBPS = 819.0           # v5e HBM bandwidth, GByte/s
+# optimizer-phase bytes touched per parameter byte: param + grad + two
+# moments read, param + two moments written (adam-class; sgd touches less
+# but the RANKING only needs the placement-relative factor)
+DEFAULT_OPT_BYTES_FACTOR = 7.0
 
 
 @dataclasses.dataclass
@@ -94,7 +107,8 @@ def _gather_time(bytes_, n, bw_bytes_per_s):
 def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
              batch_per_chip=32, peak_flops=DEFAULT_PEAK_FLOPS,
              mxu_eff=DEFAULT_MXU_EFF, ici_gbps=DEFAULT_ICI_GBPS,
-             dcn_gbps=None, avg_sparse_rows=None):
+             dcn_gbps=None, avg_sparse_rows=None, hbm_gbps=DEFAULT_HBM_GBPS,
+             opt_bytes_factor=DEFAULT_OPT_BYTES_FACTOR):
     """Estimate per-step cost of `strategy` for `model_item` on the spec.
 
     Multi-node DCN bandwidth comes from the spec's per-node
@@ -126,11 +140,21 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
     subset_R = subset_other = 1
 
     ar_bytes = ps_bytes = gather_bytes = sparse_bytes = 0
+    update_bytes = 0.0
     for v in model_item.var_infos:
         plan = plans.get(v.name)
         if plan is None:
             continue
         nbytes = v.byte_size
+        # optimizer phase: weight-update-sharded realizations touch 1/R of
+        # the parameter (+ moments) per chip — SHARDED storage AND sync-PS
+        # (the engine's PS is reduce-scatter → shard-local update →
+        # all-gather even for replicated storage, graph_transformer.py);
+        # replicated-AR / DIVERGENT update the full var on every chip
+        sharded_update = (plan.placement == Placement.SHARDED
+                          or (plan.sync == SyncKind.PS
+                              and plan.placement != Placement.DIVERGENT))
+        update_bytes += nbytes / R if sharded_update else nbytes
         if plan.sparse:
             rows = avg_sparse_rows or batch_per_chip
             row_bytes = nbytes / max(1, v.shape[0] if v.shape else 1)
@@ -199,10 +223,12 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         subset_s = (2.0 * _gather_time(subset_ps_bytes, subset_R, ici_bw)
                     + _ring_time(subset_ps_bytes / subset_R, subset_other, bw))
         comm_s += subset_s
-    return CostEstimate(compute_s, comm_s, {
+    update_s = opt_bytes_factor * update_bytes / (hbm_gbps * 1e9)
+    return CostEstimate(compute_s + update_s, comm_s, {
         "ar_bytes": ar_bytes, "ps_bytes": ps_bytes,
         "gather_bytes": gather_bytes, "sparse_bytes": sparse_bytes,
         "subset_ps_bytes": subset_ps_bytes, "subset_ps_s": subset_s,
+        "update_bytes": update_bytes, "update_s": update_s,
         "num_replicas": R})
 
 

@@ -52,8 +52,11 @@ def build(model_name, seq_len, image_size, streaming_loss=False,
         return dict(loss_fn=loss_fn, params=params, mutable_state=state,
                     sparse_vars=None, has_rng=False, cfg=None,
                     optimizer=train_lib.sgd_momentum(0.1), batch_fn=batch_fn)
-    if model_name in ("bert_base", "bert_large"):
-        cfg = BERT_BASE if model_name == "bert_base" else BERT_LARGE
+    if model_name in ("bert_tiny", "bert_base", "bert_large"):
+        from autodist_tpu.models import BERT_TINY
+
+        cfg = {"bert_tiny": BERT_TINY, "bert_base": BERT_BASE,
+               "bert_large": BERT_LARGE}[model_name]
         loss_fn, params, sparse = train_lib.bert_capture(cfg, seq_len)
 
         def batch_fn(B):
@@ -154,7 +157,7 @@ def _fwd_flops_per_example(model_name, params, seq_len, cfg=None):
     QK^T / PV attention matmuls.  MFU numerator = 3x this (bwd ~ 2x fwd)."""
     if model_name in FLOPS_PER_EXAMPLE:
         return FLOPS_PER_EXAMPLE[model_name]
-    if model_name in ("bert_base", "bert_large"):
+    if model_name in ("bert_tiny", "bert_base", "bert_large"):
         n = _matmul_param_count(params, ("position_embeddings",
                                         "type_embeddings"))
         return 2.0 * n * seq_len + 4.0 * cfg.num_layers * seq_len ** 2 * cfg.hidden_size
