@@ -482,6 +482,20 @@ def main():
     # only on a narrowly-matched OOM
     rec, last_err = _measure_model(_model_name(), {}, probe, budget, t_start)
     if rec is None:
+        # last resort for a default invocation: a gpt_small record beats
+        # no record — the driver captures whatever single JSON line we
+        # print, under its own honest metric name
+        if ("BENCH_MODEL" not in os.environ
+                and budget - (time.monotonic() - t_start) > 150):
+            rec, gpt_err = _measure_model("gpt_small", {}, probe, budget,
+                                          t_start, max_tries=1)
+            if rec is not None:
+                rec["fallback_from"] = {
+                    "metric": MODELS[_model_name()]["metric"],
+                    "error": last_err[:500]}
+                _emit(rec)
+                return
+            last_err += f" | gpt_small fallback: {gpt_err}"
         _emit(_error_rec("all_attempts_failed",
                          f"probe={probe} | {last_err}"))
         return

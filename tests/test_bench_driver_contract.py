@@ -155,6 +155,23 @@ def test_explicit_model_skips_extras(harness, monkeypatch):
     assert "secondary" not in rec and "stem_variants" not in rec
 
 
+def test_gpt_fallback_when_headline_model_fails(harness):
+    """If every resnet child dies but budget remains, a gpt_small record
+    is emitted under its own metric (a labeled fallback beats an error
+    record)."""
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True}, "", ""
+        if env.get("BENCH_MODEL", "resnet50") == "resnet50":
+            return None, "resnet child crashed", ""
+        return _fake_rec(GPT, 0.3), "", ""
+
+    rec = harness(script)
+    assert rec["metric"] == GPT and rec["mfu"] == 0.3
+    assert rec["fallback_from"]["metric"] == RESNET
+    assert "resnet child crashed" in rec["fallback_from"]["error"]
+
+
 def test_onchip_records_persist_best_variant(harness, tmp_path):
     def script(env, timeout_s):
         if env.get("_BENCH_PROBE"):
