@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -21,6 +21,11 @@ proto:
 
 bench:
 	$(PY) bench.py
+
+# Pallas surface through the REAL Mosaic/XLA:TPU compiler, no chip needed
+# (libtpu deviceless topology compile); writes MOSAIC_AOT.json
+mosaic-aot:
+	$(PY) tools/mosaic_aot_check.py
 
 lint:
 	$(PY) tools/lint.py

@@ -104,7 +104,10 @@ def _scores(q_ref, k_ref, bias_ref, i, j, *, sm_scale, causal,
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale
     if bias_ref is not None:
-        s = s + bias_ref[0][None, :]
+        # bias rides as (B, 1, Sk) with (1, 1, block_k) blocks — Mosaic
+        # requires the last TWO block dims divisible by (8, 128) or equal
+        # to the array dims, which a 2-D (1, block_k) block violates
+        s = s + bias_ref[0, 0][None, :]
     if causal:
         rows = q_off + i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -161,7 +164,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         denom = jnp.where(l == 0.0, 1.0, l)            # fully-masked rows -> 0
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
         lse = m_scr[:, :1] + jnp.log(denom)
-        lse_ref[0] = lse[:, 0]
+        lse_ref[0, 0] = lse[:, 0]
 
 
 def _fwd_scratch(block_q, d):
@@ -201,21 +204,21 @@ def _flash_fwd(q, k, v, bias, h, sm_scale, causal, block_q, block_k,
                          lambda b, i, j: (_kv_index(b, h, group), j, 0)),
             pl.BlockSpec((1, block_k, d),
                          lambda b, i, j: (_kv_index(b, h, group), j, 0)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // h, j)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // h, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         scratch_shapes=_fwd_scratch(block_q, d),
         compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, bias)
-    return out, lse
+    )(q, k, v, bias[:, None, :])
+    return out, lse[:, 0, :]
 
 
 # --------------------------------------------------------------- backward --
@@ -239,7 +242,7 @@ def _dkdv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
         s = _scores(q_ref, k_ref, bias_ref, i, j, sm_scale=sm_scale,
                     causal=causal, block_q=block_q, block_k=block_k,
                     q_off=q_off, k_off=k_off)
-        p = jnp.exp(s - lse_ref[0][:, None])           # (bq, bk)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])        # (bq, bk)
         do = do_ref[0].astype(jnp.float32)             # (bq, D)
         dv_scr[:] += jax.lax.dot_general(              # p^T @ dO -> (bk, D)
             p, do, (((0,), (0,)), ((), ())),
@@ -247,7 +250,7 @@ def _dkdv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
         dp = jax.lax.dot_general(                      # dO @ v^T -> (bq, bk)
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        ds = p * (dp - delta_ref[0, 0][:, None]) * sm_scale
         dk_scr[:] += jax.lax.dot_general(              # ds^T @ q -> (bk, D)
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -276,12 +279,12 @@ def _dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
         s = _scores(q_ref, k_ref, bias_ref, i, j, sm_scale=sm_scale,
                     causal=causal, block_q=block_q, block_k=block_k,
                     q_off=q_off, k_off=k_off)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        ds = p * (dp - delta_ref[0, 0][:, None]) * sm_scale
         dq_scr[:] += jax.lax.dot_general(              # ds @ k -> (bq, D)
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -305,7 +308,7 @@ def _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
     qspec = pl.BlockSpec((1, block_q, d), lambda b, x, y, *_: (b, x, 0))
-    row = pl.BlockSpec((1, block_q), lambda b, x, y, *_: (b, x))
+    row = pl.BlockSpec((1, 1, block_q), lambda b, x, y, *_: (b, 0, x))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(bh, nq, nk),
@@ -315,7 +318,7 @@ def _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
                          lambda b, i, j, *_: (_kv_index(b, h, group), j, 0)),
             pl.BlockSpec((1, block_k, d),
                          lambda b, i, j, *_: (_kv_index(b, h, group), j, 0)),
-            pl.BlockSpec((1, block_k), lambda b, i, j, *_: (b // h, j)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j, *_: (b // h, 0, j)),
             qspec, row, row,
         ],
         out_specs=qspec,
@@ -329,7 +332,8 @@ def _dq_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qo, ko, q, k, v, bias, do, lse, delta)
+    )(qo, ko, q, k, v, bias[:, None, :], do, lse[:, None, :],
+      delta[:, None, :])
 
 
 def _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
@@ -344,7 +348,7 @@ def _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
     nq, nk = sq // block_q, sk // block_k
     # k-block outer, q-block inner: grid indices are (b, j, i)
     qspec_i = pl.BlockSpec((1, block_q, d), lambda b, j, i, *_: (b, i, 0))
-    row_i = pl.BlockSpec((1, block_q), lambda b, j, i, *_: (b, i))
+    row_i = pl.BlockSpec((1, 1, block_q), lambda b, j, i, *_: (b, 0, i))
     kspec_in = pl.BlockSpec((1, block_k, d),
                             lambda b, j, i, *_: (_kv_index(b, h, group), j, 0))
     kspec_out = pl.BlockSpec((1, block_k, d), lambda b, j, i, *_: (b, j, 0))
@@ -352,7 +356,8 @@ def _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
         num_scalar_prefetch=2,
         grid=(bh, nk, nq),
         in_specs=[qspec_i, kspec_in, kspec_in,
-                  pl.BlockSpec((1, block_k), lambda b, j, i, *_: (b // h, j)),
+                  pl.BlockSpec((1, 1, block_k),
+                               lambda b, j, i, *_: (b // h, 0, j)),
                   qspec_i, row_i, row_i],
         out_specs=[kspec_out, kspec_out],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
@@ -371,7 +376,8 @@ def _dkdv_call(q, k, v, bias, do, lse, delta, h, sm_scale, causal,
                        (bh, sk, d), jnp.float32 if group > 1 else v.dtype)],
         compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qo, ko, q, k, v, bias, do, lse, delta)
+    )(qo, ko, q, k, v, bias[:, None, :], do, lse[:, None, :],
+      delta[:, None, :])
 
 
 def _flash_bwd(q, k, v, bias, out, lse, do, h, sm_scale, causal,
@@ -412,8 +418,8 @@ def _block_update_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
         # clamp at the floor: the XLA ring path seeds m with -inf, under
         # which exp(m_prev - m_new) would NaN at the first real block
         m_scr[:] = jnp.broadcast_to(
-            jnp.maximum(m_in_ref[0][:, None], _M_FLOOR), m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_in_ref[0][:, None], l_scr.shape)
+            jnp.maximum(m_in_ref[0, 0][:, None], _M_FLOOR), m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_in_ref[0, 0][:, None], l_scr.shape)
         acc_scr[:] = o_in_ref[0].astype(jnp.float32)
 
     last_q = q_off + (i + 1) * block_q - 1
@@ -428,8 +434,8 @@ def _block_update_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
 
     @pl.when(j == num_k - 1)
     def _():
-        m_out_ref[0] = m_scr[:, 0]
-        l_out_ref[0] = l_scr[:, 0]
+        m_out_ref[0, 0] = m_scr[:, 0]
+        l_out_ref[0, 0] = l_scr[:, 0]
         o_out_ref[0] = acc_scr[:]
 
 
@@ -469,13 +475,13 @@ def flash_block_update(q, k, v, m, l, o, q_off, k_off, causal=False,
             pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j, *_: (b, j, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j, *_: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j, *_: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j, *_: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j, *_: (b, 0, i)),
             pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq), lambda b, i, j, *_: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j, *_: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j, *_: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j, *_: (b, 0, i)),
             pl.BlockSpec((1, bq, d), lambda b, i, j, *_: (b, i, 0)),
         ],
         scratch_shapes=_fwd_scratch(bq, d),
@@ -485,17 +491,18 @@ def flash_block_update(q, k, v, m, l, o, q_off, k_off, causal=False,
         block_q=bq, block_k=bk, num_k=nk)
     qo = jnp.asarray(q_off, jnp.int32).reshape(1)
     ko = jnp.asarray(k_off, jnp.int32).reshape(1)
-    return pl.pallas_call(
+    m2, l2, o2 = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
             jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
         ],
         compiler_params=_tpu_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qo, ko, q, k, v, m, l, o.astype(jnp.float32))
+    )(qo, ko, q, k, v, m[:, None, :], l[:, None, :], o.astype(jnp.float32))
+    return m2[:, 0, :], l2[:, 0, :], o2
 
 
 # ------------------------------------------------------------- public API --

@@ -67,6 +67,7 @@ def _make_ring_flash(axis_name, causal, b, h, sq, d, bq, bk, scale,
         m0 = jnp.full((bh, sq), F._M_FLOOR, jnp.float32)
         l0 = jnp.zeros((bh, sq), jnp.float32)
         o0 = jnp.zeros((bh, sq, d), jnp.float32)
+        m0, l0, o0 = _pcast_varying((m0, l0, o0), axis_name)
 
         def body(carry, step):
             k_blk, v_blk, m, l, o = carry
@@ -118,12 +119,28 @@ def _make_ring_flash(axis_name, causal, b, h, sq, d, bq, bk, scale,
             return (k_blk, v_blk, dk, dv, dq), None
 
         z = jnp.zeros((bh, sq, d), jnp.float32)
+        z = _pcast_varying(z, axis_name)
         (_, _, dk, dv, dq), _ = _ring(body, (kf, vf, z, z, z), r)
         return (dq.astype(qf.dtype), dk.astype(kf.dtype),
                 dv.astype(vf.dtype))
 
     attend.defvjp(fwd, bwd)
     return attend
+
+
+def _pcast_varying(tree, axis_name):
+    """Mark constants as device-varying over ``axis_name`` so scan carries
+    that mix them with ppermute'd blocks type-check under shard_map's
+    default varying-manual-axes (VMA) validation.  No-op where the API or
+    the context (no manual axes) doesn't apply."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return tree
+    try:
+        return jax.tree.map(
+            lambda t: pcast(t, (axis_name,), to="varying"), tree)
+    except Exception:
+        return tree
 
 
 def _ring_flash(q, k, v, axis_name, causal):
@@ -176,6 +193,11 @@ def ring_attention(q, k, v, axis_name, causal=False, impl="auto"):
     m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, Sq), jnp.float32)
     o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+    # the accumulators START as unvarying constants but the scan body folds
+    # device-varying blocks into them; under shard_map's default VMA check
+    # the carry types must agree, so mark them varying up front (engine
+    # paths run check_vma=False and never see this, bare shard_map users do)
+    m0, l0, o0 = _pcast_varying((m0, l0, o0), axis_name)
     perm = [(i, (i + 1) % R) for i in range(R)]
 
     def body(carry, step):

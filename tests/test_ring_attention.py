@@ -41,6 +41,25 @@ def test_ring_attention_matches_full(causal, impl):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+def test_xla_ring_passes_default_vma_check():
+    """The XLA ring path must be VMA-clean under shard_map's DEFAULT
+    varying-manual-axes validation: the scan's (m, l, o) accumulators are
+    pcast to varying before they mix with ppermute'd blocks (found by the
+    Mosaic AOT harness — tools/mosaic_aot_check.py).  Pallas-kernel paths
+    legitimately need check_vma=False (pallas out_shapes carry no vma)."""
+    mesh = build_mesh()
+    q, k, v = _qkv()
+    want = _reference(q, k, v, True)
+    got = jax.jit(jax.shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, "replica",
+                                          causal=True, impl="xla"),
+        mesh=mesh,
+        in_specs=(jax.P(None, "replica"),) * 3,
+        out_specs=jax.P(None, "replica"),
+    ))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_gradients_match_xla_ring(causal):
     """The flash ring bwd (second ring pass: dk/dv travel with their block,
