@@ -1,0 +1,32 @@
+"""The Pallas surface must compile through the REAL Mosaic/XLA:TPU
+compiler (deviceless libtpu topology — tools/mosaic_aot_check.py).  Run
+as a subprocess: the checker needs a jax whose backends are untouched by
+this process's axon/cpu pinning (it scrubs its own env and re-execs)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.integration
+
+TOOL = os.path.join(os.path.dirname(__file__), "..", "..", "tools",
+                    "mosaic_aot_check.py")
+
+
+def test_mosaic_aot_surface_compiles(tmp_path):
+    out = tmp_path / "mosaic_aot.json"
+    # write to tmp: a test run must never overwrite the committed
+    # evidence artifact with a -dirty stamp
+    env = dict(os.environ, MOSAIC_AOT_OUT=str(out))
+    proc = subprocess.run([sys.executable, TOOL], env=env,
+                          capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["ok"] is True
+    assert set(doc["checks"]) == {
+        "flash_attention_fwd", "flash_attention_bwd", "int8_quantize",
+        "ring_attention_4dev", "entry_flagship_gpt"}
+    assert all(c["ok"] for c in doc["checks"].values())

@@ -230,7 +230,8 @@ def main():
     results["total_seconds"] = round(time.time() - t0, 1)
     results["git_sha"] = _git_sha()
     results["recorded_unix"] = int(time.time())
-    out = os.path.join(REPO, "MOSAIC_AOT.json")
+    out = os.environ.get("MOSAIC_AOT_OUT") or os.path.join(
+        REPO, "MOSAIC_AOT.json")
     with open(out, "w") as f:
         json.dump(results, f, indent=2)
         f.write("\n")
