@@ -267,39 +267,104 @@ class GraphTransformer:
 
     # -- state init --------------------------------------------------------
 
+    def _to_storage(self, leaf, plan):
+        if plan.placement in (Placement.REPLICATED, Placement.CUSTOM):
+            return leaf
+        if plan.placement == Placement.SHARDED:
+            pad = plan.padded_dim - leaf.shape[plan.partition_axis]
+            if pad:
+                widths = [(0, 0)] * leaf.ndim
+                widths[plan.partition_axis] = (0, pad)
+                leaf = jnp.pad(leaf, widths)
+            return leaf
+        if plan.placement == Placement.DIVERGENT:
+            return jnp.broadcast_to(leaf[None],
+                                    (self.num_replicas,) + leaf.shape)
+        raise ValueError(plan.placement)
+
+    def _to_update_space(self, leaf, plan):
+        if plan.placement in (Placement.SHARDED, Placement.DIVERGENT):
+            return self._to_storage(leaf, plan)
+        if plan.sync == SyncKind.PS:
+            r = self._R_for(plan)
+            n = leaf.size
+            npad = -(-n // r) * r
+            return jnp.zeros((npad,), leaf.dtype).at[:n].set(leaf.ravel())
+        return leaf
+
+    def _plans_tree(self):
+        return self.treedef.unflatten([self.plans[n] for n in self.names])
+
+    def abstract_state(self, rng=None):
+        """Abstract (ShapeDtypeStruct + NamedSharding) pytree matching
+        :meth:`init_state`'s output, built WITHOUT touching any device —
+        the AOT entry: trace ``make_train_step()`` with this over a
+        deviceless PJRT topology and the full engine program compiles
+        through the real TPU toolchain before a single chip is attached
+        (tools/mosaic_aot_check.py; the deploy-before-the-pod-is-up
+        workflow)."""
+        params = self.model_item.params
+        opt = self.model_item.optimizer
+        if opt is None:
+            raise ValueError("ModelItem has no optimizer")
+        plans_tree = self._plans_tree()
+        storage_shapes = jax.eval_shape(
+            lambda p: jax.tree.map(self._to_storage, p, plans_tree), params)
+        update0_shapes = jax.eval_shape(
+            lambda p: jax.tree.map(self._to_update_space, p, plans_tree),
+            params)
+        opt_shapes = jax.eval_shape(opt.init, update0_shapes)
+        # comp states: shapes from the host-side compressor init (cannot
+        # eval_shape init_comp_states — it device_puts eagerly), stacked
+        # along the replica axis like init_comp_states does
+        csh = NamedSharding(self.mesh, P(self.axis))
+        comp_avals = {
+            key: jax.tree.map(
+                lambda b: jax.ShapeDtypeStruct(
+                    (self.num_replicas,) + b.shape, b.dtype, sharding=csh),
+                base)
+            for key, base in ar_sync.init_compressor_states(
+                self.buckets).items()}
+        rng_shapes = jax.eval_shape(
+            lambda: rng if rng is not None else jax.random.PRNGKey(0))
+        mut_shapes = (jax.eval_shape(lambda: self.model_item.mutable_state)
+                      if self.model_item.mutable_state is not None else None)
+
+        rep = NamedSharding(self.mesh, P())
+
+        def shd(shapes, spec_tree):
+            sharding = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), spec_tree,
+                is_leaf=lambda x: isinstance(x, P))
+            return jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                  sharding=s),
+                shapes, sharding)
+
+        def replicated(shapes):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=rep), shapes)
+
+        return {
+            "params": shd(storage_shapes, self.params_spec_tree("storage")),
+            "opt_state": shd(opt_shapes, self._opt_spec_tree(opt_shapes)),
+            "comp": comp_avals,
+            "mutable": replicated(mut_shapes) if mut_shapes is not None
+            else None,
+            "step": jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+            "rng": replicated(rng_shapes),
+        }
+
     def init_state(self, params=None, rng=None):
         """Build the global, correctly-sharded DistributedState dict."""
         params = self.model_item.params if params is None else params
         opt = self.model_item.optimizer
         if opt is None:
             raise ValueError("ModelItem has no optimizer")
-        R = self.num_replicas
-
-        def to_storage(leaf, plan):
-            if plan.placement in (Placement.REPLICATED, Placement.CUSTOM):
-                return leaf
-            if plan.placement == Placement.SHARDED:
-                pad = plan.padded_dim - leaf.shape[plan.partition_axis]
-                if pad:
-                    widths = [(0, 0)] * leaf.ndim
-                    widths[plan.partition_axis] = (0, pad)
-                    leaf = jnp.pad(leaf, widths)
-                return leaf
-            if plan.placement == Placement.DIVERGENT:
-                return jnp.broadcast_to(leaf[None], (R,) + leaf.shape)
-            raise ValueError(plan.placement)
-
-        def to_update_space(leaf, plan):
-            if plan.placement in (Placement.SHARDED, Placement.DIVERGENT):
-                return to_storage(leaf, plan)
-            if plan.sync == SyncKind.PS:
-                r = self._R_for(plan)
-                n = leaf.size
-                npad = -(-n // r) * r
-                return jnp.zeros((npad,), leaf.dtype).at[:n].set(leaf.ravel())
-            return leaf
-
-        plans_tree = self.treedef.unflatten([self.plans[n] for n in self.names])
+        to_storage = self._to_storage
+        to_update_space = self._to_update_space
+        plans_tree = self._plans_tree()
         storage_sharding = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), self.params_spec_tree("storage"),
             is_leaf=lambda x: isinstance(x, P))

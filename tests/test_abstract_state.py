@@ -1,0 +1,55 @@
+"""GraphTransformer.abstract_state() must mirror init_state() exactly —
+same treedef, shapes, dtypes, and shardings — or the deviceless AOT
+compile (tools/mosaic_aot_check.py) validates a program the real session
+would never run."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import (AllReduce, Parallax, PartitionedPS, PS)
+
+SPEC = ResourceSpec.from_num_chips(8)
+
+
+def _capture():
+    r = np.random.RandomState(0)
+    params = {"emb": jnp.asarray(r.randn(64, 8), jnp.float32),
+              "w": jnp.asarray(r.randn(8, 4), jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32)}
+
+    def loss(p, b, rng):
+        h = p["emb"][b["ids"]] @ p["w"] + p["b"]
+        h = h + 0.01 * jax.random.normal(rng, h.shape)
+        return jnp.mean(h ** 2)
+
+    return loss, params
+
+
+@pytest.mark.parametrize("builder", [
+    AllReduce(), AllReduce(compressor="PowerSGDCompressor"),
+    PS(), PartitionedPS(max_shards=8), Parallax(),
+    PS(sync=True, staleness=2),
+])
+def test_abstract_state_matches_init_state(builder):
+    loss, params = _capture()
+    ad = AutoDist(resource_spec=SPEC, strategy_builder=builder)
+    sess = ad.distribute(loss, params, optax.adamw(1e-3),
+                         sparse_vars=["emb"], has_rng=True)
+    t = sess._t
+    concrete = t.init_state()
+    abstract = t.abstract_state()
+
+    c_leaves, c_def = jax.tree_util.tree_flatten(concrete)
+    a_leaves, a_def = jax.tree_util.tree_flatten(abstract)
+    assert c_def == a_def, f"treedef drift:\n{c_def}\n{a_def}"
+    for c, a in zip(c_leaves, a_leaves):
+        assert tuple(c.shape) == tuple(a.shape), (c.shape, a.shape)
+        assert jnp.result_type(c) == a.dtype or c.dtype == a.dtype
+        # sharding must agree so the AOT-compiled program is the same
+        # GSPMD partitioning the live session runs
+        assert c.sharding.is_equivalent_to(a.sharding, c.ndim), (
+            c.sharding, a.sharding)
