@@ -27,6 +27,11 @@ bench:
 mosaic-aot:
 	$(PY) tools/mosaic_aot_check.py
 
+# model x strategy sweep compiled for v5e targets (XLA cost/memory stats
+# + roofline ranking); writes records/v5e_aot/summary.json
+aot-sweep:
+	$(PY) tools/aot_sweep.py
+
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m compileall -q autodist_tpu tests examples

@@ -189,6 +189,29 @@ def test_committed_cpu_records_load_and_are_labeled():
     assert set(s["measured_rank"]) == set(s["estimated_rank"])
 
 
+def test_committed_v5e_aot_sweep_loads():
+    """The committed v5e AOT sweep (records/v5e_aot/summary.json — model x
+    strategy compiled by the real TPU toolchain, tools/aot_sweep.py) stays
+    well-formed: every strategy entry carries XLA stats + a roofline
+    prediction, and the per-model ranking covers all four strategies."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "records",
+                        "v5e_aot", "summary.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["n_devices"] >= 4
+    assert "not an on-chip measurement" in d["method"]
+    for model, v in d["models"].items():
+        assert set(v["predicted_rank"]) == {"AllReduce", "PS",
+                                            "PartitionedPS", "Parallax"}
+        for sname, st in v["strategies"].items():
+            assert st["xla_flops"] > 0, (model, sname)
+            assert st["step_pred_s"] > 0
+            assert st["analytic_comm_s"] >= 0
+
+
 def test_auto_strategy_with_calibration_file(tmp_path):
     """AutoStrategy loads a sweep summary JSON and ranks with the
     measured-grounded coefficients."""
