@@ -32,6 +32,11 @@ mosaic-aot:
 aot-sweep:
 	$(PY) tools/aot_sweep.py
 
+# HBM capacity proof for the headline bench configs (several minutes);
+# writes records/v5e_aot/capacity.json
+aot-capacity:
+	$(PY) tools/aot_capacity.py
+
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m compileall -q autodist_tpu tests examples

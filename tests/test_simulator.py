@@ -212,6 +212,25 @@ def test_committed_v5e_aot_sweep_loads():
             assert st["analytic_comm_s"] >= 0
 
 
+def test_committed_v5e_capacity_proof_loads():
+    """The committed HBM capacity proof (records/v5e_aot/capacity.json):
+    both headline bench configs compiled full-size for v5e and fitting
+    the 16 GiB budget."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "records",
+                        "v5e_aot", "capacity.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["ok"] is True
+    assert set(d["configs"]) == {"gpt_small_s1024_b8_flash_streaming_remat",
+                                 "resnet50_224_b256_bf16"}
+    for name, c in d["configs"].items():
+        assert c["ok"] and c["fits_hbm"], (name, c)
+        assert 0 < c["demand_bytes"] <= d["hbm_bytes"]
+
+
 def test_auto_strategy_with_calibration_file(tmp_path):
     """AutoStrategy loads a sweep summary JSON and ranks with the
     measured-grounded coefficients."""
