@@ -29,5 +29,6 @@ def test_mosaic_aot_surface_compiles(tmp_path):
     assert set(doc["checks"]) == {
         "flash_attention_fwd", "flash_attention_bwd", "int8_quantize",
         "ring_attention_4dev", "entry_flagship_gpt",
-        "engine_step_parallax_4dev", "gpt_train_step_flash_streaming_4dev"}
+        "engine_step_parallax_4dev", "gpt_train_step_flash_streaming_4dev",
+        "multihost_subset_ps_16dev_4host"}
     assert all(c["ok"] for c in doc["checks"].values())

@@ -342,6 +342,63 @@ def main():
         return {"n_devices": n, "seq": S, "streaming_loss": True,
                 **_xla_stats(exe)}
 
+    def multihost_subset_ps():
+        """MULTI-HOST: the subset-axis PS engine step compiled for a real
+        16-chip / 4-host v5e:4x4 topology — the scatter/gather confined to
+        the within-host ``ici`` axis (replica_groups of contiguous
+        same-host ids asserted in the HLO), only shard-sized psums
+        crossing the ``dcn`` (cross-host) axis.  The multi-slice traffic
+        shape the framework is designed around, validated by the real
+        toolchain with zero hosts attached."""
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from autodist_tpu.kernel.graph_transformer import GraphTransformer
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import PS
+        from autodist_tpu.strategy.base import StrategyCompiler
+
+        os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+        big = topologies.get_topology_desc("v5e:4x4", "tpu")
+        devs = sorted(big.devices, key=lambda d: (d.process_index, d.id))
+        hosts = sorted({d.process_index for d in devs})
+        n = len(devs)
+        per_host = n // len(hosts)
+        spec = ResourceSpec(resource_info={
+            "nodes": [{"address": "localhost", "chips": list(range(n))}],
+            "mesh": {"dcn": len(hosts), "ici": per_host}})
+        r = np.random.RandomState(0)
+        params = {"w": jnp.asarray(r.randn(512, 256) * 0.1, jnp.float32),
+                  "b": jnp.zeros((256,), jnp.float32)}
+
+        def loss(p, batch):
+            return jnp.mean((batch["x"] @ p["w"] + p["b"]
+                             - batch["y"]) ** 2)
+
+        item = ModelItem(loss, params, optax.sgd(0.05))
+        strat = StrategyCompiler(item, spec).compile(
+            PS(ps_axes=("ici",)).build(item, spec))
+        mesh = Mesh(np.array(devs).reshape(len(hosts), per_host),
+                    ("dcn", "ici"))
+        t = GraphTransformer(strat, item, mesh, data_axes=("dcn", "ici"))
+        B = 2 * n
+        bsh = NamedSharding(mesh, P(("dcn", "ici")))
+        batch_avals = {
+            "x": jax.ShapeDtypeStruct((B, 512), jnp.float32, sharding=bsh),
+            "y": jax.ShapeDtypeStruct((B, 256), jnp.float32, sharding=bsh)}
+        step = t.make_train_step(donate=False)
+        lowered = step.trace(t.abstract_state(), batch_avals).lower(
+            lowering_platforms=("tpu",))
+        exe = lowered.compile()
+        txt = exe.as_text()
+        within_host = "{0,1,2,3}" in txt.replace(" ", "")
+        assert within_host, (
+            "no within-host {0,1,2,3} replica group found — the PS "
+            "scatter/gather is not confined to the ici axis")
+        return {"n_devices": n, "n_hosts": len(hosts),
+                "within_host_groups": True, **_xla_stats(exe)}
+
     check("flash_attention_fwd", flash_fwd)
     check("flash_attention_bwd", flash_bwd)
     check("int8_quantize", quantize)
@@ -349,6 +406,7 @@ def main():
     check("entry_flagship_gpt", flagship_entry)
     check("engine_step_parallax_4dev", engine_step)
     check("gpt_train_step_flash_streaming_4dev", gpt_train_step)
+    check("multihost_subset_ps_16dev_4host", multihost_subset_ps)
 
     results["ok"] = ok
     results["total_seconds"] = round(time.time() - t0, 1)
