@@ -131,17 +131,61 @@ def main():
                                   train_lib.sgd_momentum(0.1), batch_avals,
                                   mutable_state=state, mesh=mesh)
 
-    record("gpt_small_s1024_b8_flash_streaming_remat", gpt_small)
-    record("resnet50_224_b256_bf16", resnet50)
+    def gpt_longcontext_ring():
+        """The long-context pillar at scale: S=8192 sharded over a
+        4-device ``seq`` axis (per-device block 2048), causal flash RING
+        attention streaming K/V blocks around the mesh, streaming vocab
+        loss, remat — per-device memory must be O(S_local), not O(S)."""
+        import dataclasses
+
+        from autodist_tpu.models import GPT_SMALL, train_lib
+
+        S, B = 8192, 2
+        n_seq = 4
+        cfg = dataclasses.replace(GPT_SMALL, max_position=S, remat=True)
+        loss_fn, params, sparse = train_lib.gpt_capture(
+            cfg, S, streaming_loss=True)
+        ring_mesh = Mesh(np.array(topo.devices).reshape(1, n_seq),
+                         ("replica", "seq"))
+        rsh = NamedSharding(ring_mesh, P("replica", "seq"))
+        batch_avals = {
+            "tokens": jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=rsh),
+            "targets": jax.ShapeDtypeStruct((B, S), jnp.int32,
+                                            sharding=rsh)}
+        return _engine_step_avals(loss_fn, params, optax.adamw(1e-4),
+                                  batch_avals, sparse=sparse, has_rng=True,
+                                  mesh=ring_mesh)
+
+    builders = {
+        "gpt_small_s1024_b8_flash_streaming_remat": gpt_small,
+        "resnet50_224_b256_bf16": resnet50,
+        "gpt_small_s8192_b2_ring_seq4": gpt_longcontext_ring,
+    }
+    # argv selects a subset (full-size compiles take minutes each); the
+    # results MERGE into the existing artifact so configs can be recorded
+    # one at a time under an external per-process time budget
+    selected = sys.argv[1:] or list(builders)
+    unknown = [s for s in selected if s not in builders]
+    if unknown:
+        raise SystemExit(f"unknown configs {unknown}; have {list(builders)}")
+
+    out_dir = os.environ.get("AOT_SWEEP_DIR") or os.path.join(
+        REPO, "records", "v5e_aot")
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, "capacity.json")
+    try:
+        with open(out) as f:
+            results["configs"] = json.load(f).get("configs", {})
+    except (OSError, ValueError):
+        pass
+
+    for name in selected:
+        record(name, builders[name])
 
     results["ok"] = all(c.get("ok") and c.get("fits_hbm")
                         for c in results["configs"].values())
     results["git_sha"] = _git_sha()
     results["recorded_unix"] = int(time.time())
-    out_dir = os.environ.get("AOT_SWEEP_DIR") or os.path.join(
-        REPO, "records", "v5e_aot")
-    os.makedirs(out_dir, exist_ok=True)
-    out = os.path.join(out_dir, "capacity.json")
     with open(out, "w") as f:
         json.dump(results, f, indent=2)
         f.write("\n")
