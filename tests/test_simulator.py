@@ -225,7 +225,8 @@ def test_committed_v5e_capacity_proof_loads():
         d = json.load(f)
     assert d["ok"] is True
     assert set(d["configs"]) == {"gpt_small_s1024_b8_flash_streaming_remat",
-                                 "resnet50_224_b256_bf16"}
+                                 "resnet50_224_b256_bf16",
+                                 "gpt_small_s8192_b2_ring_seq4"}
     for name, c in d["configs"].items():
         assert c["ok"] and c["fits_hbm"], (name, c)
         assert 0 < c["demand_bytes"] <= d["hbm_bytes"]

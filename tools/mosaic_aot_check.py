@@ -399,6 +399,48 @@ def main():
         return {"n_devices": n, "n_hosts": len(hosts),
                 "within_host_groups": True, **_xla_stats(exe)}
 
+    def wire_dtype_bf16():
+        """The compressed-AR wire receipt (VERDICT r3 item 4's HLO proof,
+        deviceless form): an AllReduce(BF16Compressor) engine step
+        compiled for v5e must carry a cross-replica all-reduce whose
+        operand is bf16 — the compressor halves the wire bytes on the
+        actual TPU compile path, not just in the jaxpr."""
+        import re
+
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from autodist_tpu.kernel.graph_transformer import GraphTransformer
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import AllReduce
+        from autodist_tpu.strategy.base import StrategyCompiler
+
+        os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+        n = len(topo.devices)
+        spec = ResourceSpec.from_num_chips(n)
+        r = np.random.RandomState(0)
+        params = {"w": jnp.asarray(r.randn(256, 256) * 0.1, jnp.float32)}
+
+        def loss(p, b):
+            return jnp.mean((b @ p["w"]) ** 2)
+
+        item = ModelItem(loss, params, optax.sgd(0.1))
+        strat = StrategyCompiler(item, spec).compile(
+            AllReduce(compressor="BF16Compressor").build(item, spec))
+        mesh = Mesh(np.array(topo.devices), ("replica",))
+        t = GraphTransformer(strat, item, mesh)
+        bsh = NamedSharding(mesh, P("replica"))
+        batch_avals = jax.ShapeDtypeStruct((8 * n, 256), jnp.float32,
+                                           sharding=bsh)
+        step = t.make_train_step(donate=False)
+        lowered = step.trace(t.abstract_state(), batch_avals).lower(
+            lowering_platforms=("tpu",))
+        txt = lowered.compile().as_text()
+        bf16_ar = re.findall(r"bf16\[[0-9,]*\][^\n]*all-reduce", txt)
+        assert bf16_ar, "no bf16-operand all-reduce in the optimized HLO"
+        return {"bf16_allreduce_ops": len(bf16_ar)}
+
     check("flash_attention_fwd", flash_fwd)
     check("flash_attention_bwd", flash_bwd)
     check("int8_quantize", quantize)
@@ -407,6 +449,7 @@ def main():
     check("engine_step_parallax_4dev", engine_step)
     check("gpt_train_step_flash_streaming_4dev", gpt_train_step)
     check("multihost_subset_ps_16dev_4host", multihost_subset_ps)
+    check("wire_dtype_bf16_allreduce", wire_dtype_bf16)
 
     results["ok"] = ok
     results["total_seconds"] = round(time.time() - t0, 1)
