@@ -30,5 +30,6 @@ def test_mosaic_aot_surface_compiles(tmp_path):
         "flash_attention_fwd", "flash_attention_bwd", "int8_quantize",
         "ring_attention_4dev", "entry_flagship_gpt",
         "engine_step_parallax_4dev", "gpt_train_step_flash_streaming_4dev",
-        "multihost_subset_ps_16dev_4host", "wire_dtype_bf16_allreduce"}
+        "multihost_subset_ps_16dev_4host", "wire_dtype_bf16_allreduce",
+        "llama_gqa_train_step_4dev", "pipeline_1f1b_4dev"}
     assert all(c["ok"] for c in doc["checks"].values())

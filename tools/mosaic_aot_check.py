@@ -441,6 +441,103 @@ def main():
         assert bf16_ar, "no bf16-operand all-reduce in the optimized HLO"
         return {"bf16_allreduce_ops": len(bf16_ar)}
 
+    def llama_gqa_train_step():
+        """The Llama family's GQA path through the kernel — group>1 means
+        the shared-K/V-block index maps and the group-summed f32 dkdv
+        outputs, a DISTINCT Mosaic program from the MHA checks above —
+        compiled as a full engine train step for 4 v5e targets."""
+        import dataclasses
+
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from autodist_tpu.kernel.graph_transformer import GraphTransformer
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.models import train_lib
+        from autodist_tpu.models.llama import LLAMA_TINY
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import Parallax
+        from autodist_tpu.strategy.base import StrategyCompiler
+
+        os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+        n = len(topo.devices)
+        S = 128
+        cfg = dataclasses.replace(LLAMA_TINY, dtype=jnp.bfloat16,
+                                  attention_impl="auto")
+        assert cfg.num_kv_heads < cfg.num_heads  # GQA, not MHA
+        loss_fn, params, sparse = train_lib.llama_capture(
+            cfg, S, streaming_loss=True, loss_chunk=100)
+        item = ModelItem(loss_fn, params, optax.adamw(1e-3),
+                         sparse_vars=sparse)
+        spec = ResourceSpec.from_num_chips(n)
+        strat = StrategyCompiler(item, spec).compile(
+            Parallax().build(item, spec))
+        mesh = Mesh(np.array(topo.devices), ("replica",))
+        t = GraphTransformer(strat, item, mesh)
+        bsh = NamedSharding(mesh, P("replica"))
+        B = 2 * n
+        batch_avals = {
+            "tokens": jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=bsh),
+            "targets": jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=bsh)}
+        step = t.make_train_step(donate=False)
+        with _pretend_on_tpu():
+            lowered = step.trace(t.abstract_state(), batch_avals).lower(
+                lowering_platforms=("tpu",))
+        exe = lowered.compile()
+        assert "tpu_custom_call" in exe.as_text()
+        return {"n_devices": n, "gqa_group":
+                cfg.num_heads // cfg.num_kv_heads, **_xla_stats(exe)}
+
+    def pipeline_1f1b():
+        """The 1F1B interleaved pipeline schedule — stacked stage params
+        sharded over the pipe axis, ppermute activation handoff — as an
+        engine step over a replica x pipe mesh of 4 v5e targets."""
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from autodist_tpu.const import AXIS_PIPELINE
+        from autodist_tpu.kernel.graph_transformer import GraphTransformer
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.parallel.pipeline import (pipeline_train_loss,
+                                                    stack_stages_interleaved)
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy import AllReduce
+        from autodist_tpu.strategy.base import StrategyCompiler
+
+        os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+        Spipe, L = 4, 2
+        rr = np.random.RandomState(7)
+        stages = [{"w": jnp.asarray(rr.randn(128, 128) * 0.1, jnp.float32)}
+                  for _ in range(Spipe * L)]
+        blocks = stack_stages_interleaved(stages, Spipe)
+
+        def pp_loss(p, b):
+            return pipeline_train_loss(
+                lambda sp, a: a + jnp.tanh(a @ sp["w"]),
+                lambda act, y: jnp.mean((act - y) ** 2),
+                p["blocks"], b["x"], b["y"], AXIS_PIPELINE,
+                num_microbatches=Spipe, schedule="1f1b")
+
+        spec = ResourceSpec(resource_info={
+            "nodes": [{"address": "localhost", "chips": list(range(4))}],
+            "mesh": {"replica": 1, "pipe": Spipe}})
+        item = ModelItem(pp_loss, {"blocks": blocks}, optax.sgd(0.01))
+        strat = StrategyCompiler(item, spec).compile(
+            AllReduce().build(item, spec))
+        mesh = Mesh(np.array(topo.devices).reshape(1, Spipe),
+                    ("replica", AXIS_PIPELINE))
+        t = GraphTransformer(strat, item, mesh, data_axes=("replica",),
+                             param_specs={"blocks/w": P(AXIS_PIPELINE)})
+        bsh = NamedSharding(mesh, P("replica"))
+        bav = jax.ShapeDtypeStruct((8, 128), jnp.float32, sharding=bsh)
+        step = t.make_train_step(donate=False)
+        lowered = step.trace(t.abstract_state(),
+                             {"x": bav, "y": bav}).lower(
+            lowering_platforms=("tpu",))
+        txt = lowered.compile().as_text()
+        assert "collective-permute" in txt, "no ppermute handoff in HLO"
+        return {"stages": Spipe, "layers_per_stage": L}
+
     check("flash_attention_fwd", flash_fwd)
     check("flash_attention_bwd", flash_bwd)
     check("int8_quantize", quantize)
@@ -450,6 +547,8 @@ def main():
     check("gpt_train_step_flash_streaming_4dev", gpt_train_step)
     check("multihost_subset_ps_16dev_4host", multihost_subset_ps)
     check("wire_dtype_bf16_allreduce", wire_dtype_bf16)
+    check("llama_gqa_train_step_4dev", llama_gqa_train_step)
+    check("pipeline_1f1b_4dev", pipeline_1f1b)
 
     results["ok"] = ok
     results["total_seconds"] = round(time.time() - t0, 1)
