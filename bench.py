@@ -153,6 +153,13 @@ def _error_rec(cause, detail=""):
         # verifiable evidence from the last committed on-chip run — NOT
         # this run's value (VERDICT r3 item 1b)
         rec["last_measured"] = measured["records"]
+    # relay-down evidence trail: the committed deviceless real-TPU-compiler
+    # artifacts (compile validation + capacity + strategy sweep) — see
+    # docs/performance.md "Where the numbers live"
+    rec["compile_time_evidence"] = [
+        p for p in ("MOSAIC_AOT.json", "records/v5e_aot/capacity.json",
+                    "records/v5e_aot/summary.json")
+        if os.path.exists(os.path.join(_REPO, p))]
     return rec
 
 

@@ -31,5 +31,6 @@ def test_mosaic_aot_surface_compiles(tmp_path):
         "ring_attention_4dev", "entry_flagship_gpt",
         "engine_step_parallax_4dev", "gpt_train_step_flash_streaming_4dev",
         "multihost_subset_ps_16dev_4host", "wire_dtype_bf16_allreduce",
-        "llama_gqa_train_step_4dev", "pipeline_1f1b_4dev"}
+        "llama_gqa_train_step_4dev", "pipeline_1f1b_4dev",
+        "gpt_decode_rollout_serving"}
     assert all(c["ok"] for c in doc["checks"].values())

@@ -538,6 +538,40 @@ def main():
         assert "collective-permute" in txt, "no ppermute handoff in HLO"
         return {"stages": Spipe, "layers_per_stage": L}
 
+    def gpt_decode_rollout():
+        """The serving path: GPT-2-small autoregressive decode — the
+        jitted lax.scan rollout with per-layer KV caches (one token per
+        step, prompt replay, greedy head) — compiled for a v5e target."""
+        from autodist_tpu.models.decoding import _cache_shapes, _make_rollout
+        from autodist_tpu.models.gpt import GPT, GPT_SMALL
+
+        B, total = 4, 128
+        model = GPT(GPT_SMALL, decode=True)
+        params_shapes = jax.eval_shape(
+            model.init, jax.random.PRNGKey(0),
+            jnp.zeros((B, 1), jnp.int32))["params"]
+        cache_avals = jax.tree.map(
+            lambda sd: jax.ShapeDtypeStruct(*sd), _cache_shapes(model, B),
+            is_leaf=lambda x: isinstance(x, tuple))
+        rollout = _make_rollout(model, total, 0.0)
+        avals = (
+            jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                         params_shapes),
+            cache_avals,
+            jax.ShapeDtypeStruct((B, total), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+        )
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(TOPO.devices[:1]), ("x",))
+        s = NamedSharding(mesh, P())
+        lowered = jax.jit(rollout.__wrapped__ if hasattr(
+            rollout, "__wrapped__") else rollout,
+            in_shardings=s).trace(*avals).lower(lowering_platforms=("tpu",))
+        exe = lowered.compile()
+        return {"batch": B, "total_len": total, **_xla_stats(exe)}
+
     check("flash_attention_fwd", flash_fwd)
     check("flash_attention_bwd", flash_bwd)
     check("int8_quantize", quantize)
@@ -549,6 +583,7 @@ def main():
     check("wire_dtype_bf16_allreduce", wire_dtype_bf16)
     check("llama_gqa_train_step_4dev", llama_gqa_train_step)
     check("pipeline_1f1b_4dev", pipeline_1f1b)
+    check("gpt_decode_rollout_serving", gpt_decode_rollout)
 
     results["ok"] = ok
     results["total_seconds"] = round(time.time() - t0, 1)
