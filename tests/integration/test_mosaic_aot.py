@@ -32,5 +32,6 @@ def test_mosaic_aot_surface_compiles(tmp_path):
         "engine_step_parallax_4dev", "gpt_train_step_flash_streaming_4dev",
         "multihost_subset_ps_16dev_4host", "wire_dtype_bf16_allreduce",
         "llama_gqa_train_step_4dev", "pipeline_1f1b_4dev",
-        "gpt_decode_rollout_serving"}
+        "gpt_decode_rollout_serving", "tensor_parallel_2x2",
+        "expert_parallel_moe_2x2"}
     assert all(c["ok"] for c in doc["checks"].values())
