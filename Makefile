@@ -37,6 +37,11 @@ aot-sweep:
 aot-capacity:
 	$(PY) tools/aot_capacity.py
 
+# ResNet-50 MFU-lever analysis via per-variant v5e compiles (minutes per
+# variant); writes records/v5e_aot/resnet_levers.json
+aot-levers:
+	$(PY) tools/aot_levers.py
+
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m compileall -q autodist_tpu tests examples
