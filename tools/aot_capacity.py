@@ -71,7 +71,7 @@ def main():
     def record(name, builder):
         t0 = time.time()
         try:
-            step, state_avals, batch_avals = builder()
+            step, state_avals, batch_avals, units = builder()
             with _pretend_on_tpu():
                 lowered = step.trace(state_avals, batch_avals).lower(
                     lowering_platforms=("tpu",))
@@ -91,6 +91,22 @@ def main():
                 "headroom_gib": round((HBM_BYTES - demand) / 1024 ** 3, 2),
                 "compile_seconds": round(time.time() - t0, 1),
             }
+            # roofline throughput prediction from XLA's own counts —
+            # compile-time evidence, labeled, never a measured claim
+            from tools.mosaic_aot_check import _xla_stats
+
+            stats = _xla_stats(exe)
+            flops = stats.get("xla_flops", 0.0)
+            bytes_ = stats.get("xla_bytes_accessed", 0.0)
+            if flops and bytes_ and units:
+                pred_s = max(flops / (394e12 * 0.45), bytes_ / 819e9)
+                unit_name, n_units = units
+                results["configs"][name].update({
+                    "xla_flops": flops, "xla_bytes_accessed": bytes_,
+                    "roofline_pred_step_ms": round(1000 * pred_s, 2),
+                    f"roofline_pred_{unit_name}_per_sec": round(
+                        n_units / pred_s, 1),
+                })
         except Exception as e:
             import traceback
 
@@ -112,9 +128,10 @@ def main():
         batch_avals = {
             "tokens": jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=bsh),
             "targets": jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=bsh)}
-        return _engine_step_avals(loss_fn, params, optax.adamw(1e-4),
-                                  batch_avals, sparse=sparse, has_rng=True,
-                                  mesh=mesh)
+        return (*_engine_step_avals(loss_fn, params, optax.adamw(1e-4),
+                                    batch_avals, sparse=sparse,
+                                    has_rng=True, mesh=mesh),
+                ("tokens", B * S))
 
     def resnet50():
         from autodist_tpu.models import ResNet50, train_lib
@@ -127,9 +144,11 @@ def main():
             "image": jax.ShapeDtypeStruct((B, 224, 224, 3), jnp.bfloat16,
                                           sharding=bsh),
             "label": jax.ShapeDtypeStruct((B,), jnp.int32, sharding=bsh)}
-        return _engine_step_avals(loss_fn, params,
-                                  train_lib.sgd_momentum(0.1), batch_avals,
-                                  mutable_state=state, mesh=mesh)
+        return (*_engine_step_avals(loss_fn, params,
+                                    train_lib.sgd_momentum(0.1),
+                                    batch_avals, mutable_state=state,
+                                    mesh=mesh),
+                ("images", B))
 
     def gpt_longcontext_ring():
         """The long-context pillar at scale: S=8192 sharded over a
@@ -152,9 +171,12 @@ def main():
             "tokens": jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=rsh),
             "targets": jax.ShapeDtypeStruct((B, S), jnp.int32,
                                             sharding=rsh)}
-        return _engine_step_avals(loss_fn, params, optax.adamw(1e-4),
-                                  batch_avals, sparse=sparse, has_rng=True,
-                                  mesh=ring_mesh)
+        # per-DEVICE cost stats on a 4-device mesh: units are global
+        # tokens; per-chip = global / 4
+        return (*_engine_step_avals(loss_fn, params, optax.adamw(1e-4),
+                                    batch_avals, sparse=sparse,
+                                    has_rng=True, mesh=ring_mesh),
+                ("tokens_global", B * S))
 
     builders = {
         "gpt_small_s1024_b8_flash_streaming_remat": gpt_small,

@@ -10,22 +10,22 @@ BENCH_TRACE=onchip_results/trace python bench.py | tee onchip_results/bench.json
 python tools/trace_summary.py onchip_results/trace \
     | tee onchip_results/trace_summary.txt || true
 
-# 1b. MFU push (VERDICT r3 item 2): exact space_to_depth stem + batch sweep
-# (plain `python bench.py` above already measures the s2d variant and a
-# gpt_small secondary when budget allows — these pin each config its own
-# full record)
+# 1b. PRIORITY (revised by the round-5 lever analysis,
+# records/v5e_aot/resnet_levers.json): the step is MEMORY-bound — XLA
+# counts 83.4 GB/step and the roofline matches the measured 99.8 ms
+# within 2%.  Chip time goes to PROFILING HBM traffic first, not the
+# stem/BN sweeps (predicted neutral / counterproductive):
+BENCH_TRACE=onchip_results/trace_hbm python bench.py \
+    | tee onchip_results/bench_traced.json
+python tools/trace_summary.py onchip_results/trace_hbm \
+    | tee onchip_results/trace_hbm_summary.txt || true
+
+# 1c. Lever sweeps, SECONDARY — run only to confirm the compile-time
+# predictions (s2d ~neutral, bf16-stats ~+5% bytes) against hardware:
 BENCH_STEM=space_to_depth python bench.py \
     | tee onchip_results/bench_s2d.json
-BENCH_STEM=space_to_depth BENCH_BATCH=512 python bench.py \
-    | tee onchip_results/bench_s2d_b512.json
 BENCH_BATCH=512 python bench.py | tee onchip_results/bench_b512.json
-
-# 1c. BN batch-stats reduced in bf16 (approximate stats — labeled manual
-# experiment, never the recorded default; attacks the measured 8.8 ms
-# BN-stat share of the forward pass)
 BENCH_BN_STATS=bf16 python bench.py | tee onchip_results/bench_bnbf16.json
-BENCH_STEM=space_to_depth BENCH_BN_STATS=bf16 BENCH_BATCH=512 \
-    python bench.py | tee onchip_results/bench_s2d_bnbf16_b512.json
 
 # 2. GPT long-context flagship as a recorded driver metric (item 6):
 #    S=1024, flash attention, streaming vocab loss, remat
