@@ -42,6 +42,11 @@ aot-capacity:
 aot-levers:
 	$(PY) tools/aot_levers.py
 
+# GPT flagship batch/remat lever sweep for v5e (minutes per variant);
+# writes records/v5e_aot/gpt_levers.json
+aot-gpt-levers:
+	$(PY) tools/aot_gpt_levers.py
+
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m compileall -q autodist_tpu tests examples

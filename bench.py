@@ -75,7 +75,12 @@ MODELS = {
     "gpt_small": {
         "metric": "gpt_small_train_tokens_per_sec_per_chip",
         "unit": "tokens/sec/chip",
-        "default_batch": 8,          # sequences per chip at S=1024
+        # sequences per chip at S=1024.  32 (not 8): the step is
+        # memory-bound and per-step traffic amortizes — the v5e compile
+        # sweep (records/v5e_aot/gpt_levers.json) predicts 206k tok/s at
+        # B=32+remat (3.5 GiB) vs 137k at B=8, with B=32+no-remat
+        # (BENCH_REMAT=0) at 237k/11.7 GiB as the tighter-fit experiment
+        "default_batch": 32,
         "train_flops_per_example": None,   # computed from params at run time
         # reference's closest published LM number: BERT-large @ 1x T4
         # ~11 examples/sec @ S=128 => ~1408 tokens/sec (figure1 row 5) —

@@ -28,10 +28,15 @@ BENCH_BATCH=512 python bench.py | tee onchip_results/bench_b512.json
 BENCH_BN_STATS=bf16 python bench.py | tee onchip_results/bench_bnbf16.json
 
 # 2. GPT long-context flagship as a recorded driver metric (item 6):
-#    S=1024, flash attention, streaming vocab loss, remat
+#    S=1024, flash attention, streaming vocab loss, remat.  Default batch
+#    is now 32 (compile-sweep lever, predicted 206k tok/s — gpt_levers);
+#    the no-remat variant predicts 237k at 11.7 GiB (tight fit — confirm
+#    the allocator agrees before trusting it):
 BENCH_MODEL=gpt_small python bench.py | tee onchip_results/bench_gpt.json
-BENCH_MODEL=gpt_small BENCH_BATCH=16 python bench.py \
-    | tee onchip_results/bench_gpt_b16.json
+BENCH_MODEL=gpt_small BENCH_REMAT=0 python bench.py \
+    | tee onchip_results/bench_gpt_noremat.json
+BENCH_MODEL=gpt_small BENCH_BATCH=8 python bench.py \
+    | tee onchip_results/bench_gpt_b8.json
 
 # 3. Pallas surface on the real Mosaic compile path (item 3)
 # (AUTODIST_TEST_TPU=1 stops conftest from force-pinning the cpu platform)
