@@ -1,0 +1,227 @@
+"""Ahead-of-time compilation against a deviceless TPU topology.
+
+Public form of the mechanism behind ``tools/mosaic_aot_check.py``: libtpu
+can construct a PJRT *topology description* for a known TPU generation
+with no hardware attached, and the engine's training step — built
+exactly as ``distribute()`` builds it — can be traced with
+:meth:`~autodist_tpu.kernel.graph_transformer.GraphTransformer
+.abstract_state` and compiled by the real Mosaic/XLA:TPU toolchain.
+What you get before touching a single chip:
+
+- compile errors (Mosaic tiling, VMEM budgeting, GSPMD partitioning)
+  surface at your desk, not on the pod;
+- XLA's own ``cost_analysis`` / ``memory_analysis`` for the target
+  generation (does the step fit HBM?  what's the roofline?);
+- a serializable executable (``serialize()``) for
+  compile-once-deploy-many workflows.
+
+Usage::
+
+    ad = AutoDist(resource_spec=spec, strategy_builder=Parallax())
+    aot = ad.aot_compile(loss_fn, params, optax.adamw(1e-3),
+                         batch_shapes={"tokens": ((B, S), jnp.int32),
+                                       "targets": ((B, S), jnp.int32)},
+                         topology="v5e:2x2")
+    print(aot.memory_analysis)          # HBM demand on the target
+    blob = aot.serialize()              # ship to the pod
+
+The process must not be captured by an interactive TPU platform plugin
+(run plain, or with the plugin env unset); the default jax backend (cpu)
+is untouched — only the compile targets the topology.
+"""
+import contextlib
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+# per-generation HBM (bytes/chip) keyed on the PJRT device_kind; override
+# via aot_compile(hbm_bytes_per_device=...) for kinds not listed
+HBM_BY_DEVICE_KIND = {
+    "TPU v4": 32 * 1024 ** 3,
+    "TPU v5 lite": 16 * 1024 ** 3,
+    "TPU v5": 95 * 1024 ** 3,
+    "TPU v5p": 95 * 1024 ** 3,
+    "TPU v6 lite": 32 * 1024 ** 3,
+}
+
+
+@contextlib.contextmanager
+def force_on_tpu_selection():
+    """Make backend-gated kernel auto-selection (``attention_impl="auto"``,
+    ``interpret=None``) answer as if running ON TPU, for the duration of
+    an AOT trace.  Without this, a deviceless process (default backend
+    cpu) would silently trace the XLA/interpreter fallback and the
+    compiled artifact would not be the program the chip runs — Mosaic
+    errors hidden, analyses describing the wrong executable."""
+    from autodist_tpu.ops.pallas import flash_attention as _F
+
+    prev = _F._on_tpu
+    _F._on_tpu = lambda: True
+    try:
+        yield
+    finally:
+        _F._on_tpu = prev
+
+
+@dataclasses.dataclass
+class AOTCompiledStep:
+    """A topology-compiled training step + the analyses that matter."""
+
+    topology: str
+    n_devices: int
+    device_kind: str
+    executable: Any                      # jax Compiled
+    state_avals: Any                     # abstract state pytree (shardings)
+    donate: bool = True                  # how the step was compiled
+    hbm_bytes_per_device: int = 16 * 1024 ** 3   # set from device_kind
+
+    @property
+    def cost_analysis(self) -> Dict[str, float]:
+        ca = self.executable.cost_analysis()
+        return dict(ca[0] if isinstance(ca, (list, tuple)) else ca)
+
+    @property
+    def memory_analysis(self) -> Dict[str, int]:
+        ma = self.executable.memory_analysis()
+        out = {}
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(ma, k, None)
+            if v is not None:
+                out[k] = int(v)
+        return out
+
+    def fits_hbm(self, donate: Optional[bool] = None) -> bool:
+        """HBM demand vs the target generation's budget.  ``donate``
+        defaults to how the step was actually compiled — an undonated
+        step's outputs cannot alias its inputs and count in full."""
+        if donate is None:
+            donate = self.donate
+        m = self.memory_analysis
+        demand = (m.get("argument_size_in_bytes", 0)
+                  + m.get("temp_size_in_bytes", 0)
+                  + m.get("generated_code_size_in_bytes", 0))
+        if not donate:      # outputs cannot alias the (undonated) inputs
+            demand += m.get("output_size_in_bytes", 0)
+        return demand <= self.hbm_bytes_per_device
+
+    def as_hlo_text(self) -> str:
+        return self.executable.as_text()
+
+    def serialize(self) -> bytes:
+        """Portable executable blob (jax.experimental.serialize_executable)
+        for compile-once-deploy-many."""
+        from jax.experimental.serialize_executable import serialize
+
+        out = serialize(self.executable)
+        # (payload, in_tree, out_tree) in current jax; (payload, _) before
+        return out[0] if isinstance(out, tuple) else out
+
+
+def get_topology(topology: str):
+    """Deviceless PJRT topology (e.g. "v5e:2x2", "v5e:4x4")."""
+    import os
+
+    from jax.experimental import topologies
+
+    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    return topologies.get_topology_desc(topology, "tpu")
+
+
+def aot_compile_step(
+    autodist,
+    loss_fn,
+    params,
+    optimizer,
+    *,
+    batch_shapes: Dict[str, Tuple[Tuple[int, ...], Any]],
+    topology: str = "v5e:2x2",
+    mesh_axes: Optional[Tuple[str, ...]] = None,
+    donate: bool = True,
+    sparse_vars=None,
+    has_aux: bool = False,
+    has_rng: bool = False,
+    mutable_state=None,
+    rng=None,
+    hbm_bytes_per_device: Optional[int] = None,
+    **transformer_kwargs,
+) -> AOTCompiledStep:
+    """Build the engine exactly as ``distribute()`` does, then compile the
+    step for ``topology`` without touching any device.
+
+    ``batch_shapes``: pytree of ``(shape, dtype)`` describing one global
+    batch (or a bare ``(shape, dtype)`` tuple for array batches).
+    ``mesh_axes``: axis names for the topology mesh; default is the
+    resource spec's mesh request (or a 1-D "replica" mesh).
+    """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from autodist_tpu.kernel.graph_transformer import GraphTransformer
+    from autodist_tpu.model_item import ModelItem
+
+    topo = get_topology(topology)
+    item = ModelItem(loss_fn, params, optimizer, sparse_vars=sparse_vars,
+                     has_aux=has_aux, has_rng=has_rng,
+                     mutable_state=mutable_state)
+    raw = autodist._build_or_load_strategy(item)
+    from autodist_tpu.strategy.base import StrategyCompiler
+
+    strategy = StrategyCompiler(item, autodist.resource_spec).compile(raw)
+
+    req = autodist.resource_spec.mesh_request or {}
+    if mesh_axes is None:
+        mesh_axes = tuple(req) if req else ("replica",)
+    if req and all(a in req for a in mesh_axes):
+        shape = tuple(int(req[a]) for a in mesh_axes)
+    elif len(mesh_axes) == 1:
+        # no sizing information: the single axis spans the topology
+        shape = (len(topo.devices),)
+    else:
+        raise ValueError(
+            f"mesh_axes {mesh_axes} cannot be sized: the resource spec's "
+            f"mesh request {dict(req)} does not define them and only a "
+            f"single axis can default to the whole topology")
+    n = int(np.prod(shape))
+    if n > len(topo.devices):
+        raise ValueError(
+            f"mesh {dict(zip(mesh_axes, shape))} needs {n} devices; "
+            f"topology {topology} has {len(topo.devices)}")
+    mesh = Mesh(np.array(topo.devices[:n]).reshape(shape), mesh_axes)
+    t = GraphTransformer(strategy, item, mesh, **transformer_kwargs)
+
+    bspec = tuple(t.batch_spec)
+
+    def to_aval(leaf):
+        shp, dt = leaf
+        spec = P(*bspec[:len(shp)])
+        return jax.ShapeDtypeStruct(tuple(shp), dt,
+                                    sharding=NamedSharding(mesh, spec))
+
+    batch_avals = jax.tree.map(
+        to_aval, batch_shapes,
+        is_leaf=lambda x: (isinstance(x, tuple) and len(x) == 2
+                           and isinstance(x[0], (tuple, list))))
+    state_avals = t.abstract_state(rng=rng)
+    step = t.make_train_step(donate=donate)
+    with force_on_tpu_selection():
+        lowered = step.trace(state_avals, batch_avals).lower(
+            lowering_platforms=("tpu",))
+    exe = lowered.compile()
+    kind = getattr(topo.devices[0], "device_kind", "?")
+    hbm = hbm_bytes_per_device
+    if hbm is None:
+        hbm = HBM_BY_DEVICE_KIND.get(kind)
+        if hbm is None:
+            hbm = 16 * 1024 ** 3
+            logging.warning(
+                "Unknown device kind %r — fits_hbm() assumes 16 GiB; pass "
+                "hbm_bytes_per_device to override", kind)
+    logging.info("AOT-compiled step for %s (%d x %s)", topology, n, kind)
+    return AOTCompiledStep(
+        topology=topology, n_devices=n, device_kind=kind,
+        executable=exe, state_avals=state_avals, donate=donate,
+        hbm_bytes_per_device=hbm)

@@ -273,6 +273,19 @@ class AutoDist:
         coordinator.setup(raw)  # chief launches workers; everyone joins
         return self._assemble_session(item, raw, **session_kwargs)
 
+    def aot_compile(self, loss_fn, params, optimizer, *, batch_shapes,
+                    topology="v5e:2x2", **kwargs):
+        """Compile the distributed training step for a DEVICELESS TPU
+        topology — compile errors, HBM demand, and cost analysis for the
+        target generation before a single chip is attached (the
+        deploy-before-the-pod-is-up workflow; see
+        :mod:`autodist_tpu.aot`)."""
+        from autodist_tpu.aot import aot_compile_step
+
+        return aot_compile_step(self, loss_fn, params, optimizer,
+                                batch_shapes=batch_shapes,
+                                topology=topology, **kwargs)
+
     @contextlib.contextmanager
     def scope(self):
         """Parity with the reference's ``ad.scope()`` (autodist.py:309-322).

@@ -90,6 +90,10 @@ def main():
                 "fits_hbm": demand <= HBM_BYTES,
                 "headroom_gib": round((HBM_BYTES - demand) / 1024 ** 3, 2),
                 "compile_seconds": round(time.time() - t0, 1),
+                # per-CONFIG provenance: merged records must never be
+                # re-attributed to a later run's commit
+                "git_sha": _git_sha(),
+                "recorded_unix": int(time.time()),
             }
             # roofline throughput prediction from XLA's own counts —
             # compile-time evidence, labeled, never a measured claim
@@ -206,8 +210,8 @@ def main():
 
     results["ok"] = all(c.get("ok") and c.get("fits_hbm")
                         for c in results["configs"].values())
-    results["git_sha"] = _git_sha()
-    results["recorded_unix"] = int(time.time())
+    results["last_run_git_sha"] = _git_sha()
+    results["last_run_unix"] = int(time.time())
     with open(out, "w") as f:
         json.dump(results, f, indent=2)
         f.write("\n")

@@ -128,11 +128,14 @@ def main():
             "roofline_bound": "compute" if compute_s >= mem_s else "memory",
             "pred_tokens_per_sec": round(B * S / pred_s, 1),
             "compile_seconds": round(time.time() - t0, 1),
+            # per-VARIANT provenance: merged records keep their own commit
+            "git_sha": _git_sha(),
+            "recorded_unix": int(time.time()),
         }
         print(f"[aot-gpt-levers] {name}: {results['variants'][name]}",
               flush=True)
-        results["git_sha"] = _git_sha()
-        results["recorded_unix"] = int(time.time())
+        results["last_run_git_sha"] = _git_sha()
+        results["last_run_unix"] = int(time.time())
         with open(out, "w") as f:
             json.dump(results, f, indent=2)
             f.write("\n")

@@ -129,13 +129,16 @@ def main():
             "mfu_at_pred": round(
                 MODEL_FLOPS_PER_STEP / pred_s / PEAK_FLOPS, 3),
             "compile_seconds": round(time.time() - t0, 1),
+            # per-VARIANT provenance: merged records keep their own commit
+            "git_sha": _git_sha(),
+            "recorded_unix": int(time.time()),
         }
         print(f"[aot-levers] {name}: {results['variants'][name]}",
               flush=True)
         # merge-write after EVERY variant: an external kill cannot erase
         # finished compiles
-        results["git_sha"] = _git_sha()
-        results["recorded_unix"] = int(time.time())
+        results["last_run_git_sha"] = _git_sha()
+        results["last_run_unix"] = int(time.time())
         with open(out, "w") as f:
             json.dump(results, f, indent=2)
             f.write("\n")

@@ -74,19 +74,8 @@ def _git_sha():
 # this tool exists to close).  Scoped to the trace: eager setup work
 # (model.init builds params on the host backend) must keep the honest
 # answer or it would try to EXECUTE Mosaic kernels on the CPU.
-import contextlib  # noqa: E402
-
-from autodist_tpu.ops.pallas import flash_attention as _F  # noqa: E402
-
-
-@contextlib.contextmanager
-def _pretend_on_tpu():
-    prev = _F._on_tpu
-    _F._on_tpu = lambda: True
-    try:
-        yield
-    finally:
-        _F._on_tpu = prev
+from autodist_tpu.aot import (  # noqa: E402
+    force_on_tpu_selection as _pretend_on_tpu)
 
 
 TOPO = None
