@@ -76,7 +76,10 @@ def main():
     out_dir = os.environ.get("AOT_SWEEP_DIR") or os.path.join(
         REPO, "records", "v5e_aot")
     os.makedirs(out_dir, exist_ok=True)
-    out = os.path.join(out_dir, "resnet_levers.json")
+    # non-default batches get their own file — the variants are keyed by
+    # stem/stats only, so mixing batches in one file would collide
+    out = os.path.join(out_dir, "resnet_levers.json" if B == 256
+                       else f"resnet_levers_b{B}.json")
     results = {"topology": TOPOLOGY, "batch": B,
                "model_flops_per_step": MODEL_FLOPS_PER_STEP,
                "baseline_onchip": {
