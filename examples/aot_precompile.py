@@ -25,7 +25,6 @@ if os.environ.get("PALLAS_AXON_POOL_IPS"):
               + sys.argv[1:], env)
 
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from autodist_tpu.autodist import AutoDist
