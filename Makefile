@@ -42,6 +42,12 @@ aot-capacity:
 aot-levers:
 	$(PY) tools/aot_levers.py
 
+# barrier-vs-overlap sync-schedule compiles (latency-hiding scheduler
+# flags) + the cost model's serialized/overlapped estimates; writes
+# records/v5e_aot/overlap_lever.json — the BENCH_OVERLAP lever's evidence
+aot-overlap:
+	$(PY) tools/aot_overlap.py
+
 # GPT flagship batch/remat lever sweep for v5e (minutes per variant);
 # writes records/v5e_aot/gpt_levers.json
 aot-gpt-levers:

@@ -111,14 +111,61 @@ class AOTCompiledStep:
     def as_hlo_text(self) -> str:
         return self.executable.as_text()
 
+    _BLOB_FORMAT = "autodist-aot-step-v1"
+
     def serialize(self) -> bytes:
-        """Portable executable blob (jax.experimental.serialize_executable)
-        for compile-once-deploy-many."""
+        """Standalone compile-once-deploy-many blob.
+
+        ``jax.experimental.serialize_executable.serialize`` returns the
+        executable payload PLUS the calling-convention trees ``(payload,
+        in_tree, out_tree)`` — all three are required to rebuild a runnable
+        ``Compiled`` (the bare payload the old implementation returned
+        could never load standalone; ADVICE r5).  The tuple travels as one
+        pickled blob together with the compile metadata, so the deploy
+        side needs nothing but these bytes and a matching topology."""
+        import pickle
+
         from jax.experimental.serialize_executable import serialize
 
-        out = serialize(self.executable)
-        # (payload, in_tree, out_tree) in current jax; (payload, _) before
-        return out[0] if isinstance(out, tuple) else out
+        payload, in_tree, out_tree = serialize(self.executable)
+        return pickle.dumps({
+            "format": self._BLOB_FORMAT,
+            "payload": payload, "in_tree": in_tree, "out_tree": out_tree,
+            "topology": self.topology, "n_devices": self.n_devices,
+            "device_kind": self.device_kind, "donate": self.donate,
+            "hbm_bytes_per_device": self.hbm_bytes_per_device,
+        })
+
+    @classmethod
+    def deserialize(cls, blob: bytes, backend=None) -> "AOTCompiledStep":
+        """Inverse of :meth:`serialize`: rebuild a loaded, runnable step.
+
+        Must run in a process whose ATTACHED devices match the blob's
+        compile topology (the deploy side of compile-once-deploy-many) —
+        a TPU-compiled blob only loads on the TPU backend, so on a
+        multi-backend deploy host pass ``backend="tpu"`` (forwarded to
+        ``deserialize_and_load``; default = the process default backend).
+        ``state_avals`` are not carried in the blob — the deploy process
+        rebuilds them from the same model code when it needs them."""
+        import pickle
+
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load)
+
+        try:
+            d = pickle.loads(blob)
+        except Exception as e:
+            raise ValueError(f"not an AOTCompiledStep blob: {e}") from e
+        if not (isinstance(d, dict) and d.get("format") == cls._BLOB_FORMAT):
+            raise ValueError(
+                "not an AOTCompiledStep blob (expected the pickled "
+                f"{cls._BLOB_FORMAT!r} payload from serialize())")
+        exe = deserialize_and_load(d["payload"], d["in_tree"], d["out_tree"],
+                                   backend=backend)
+        return cls(topology=d["topology"], n_devices=d["n_devices"],
+                   device_kind=d["device_kind"], executable=exe,
+                   state_avals=None, donate=d["donate"],
+                   hbm_bytes_per_device=d["hbm_bytes_per_device"])
 
 
 def get_topology(topology: str):
@@ -128,6 +175,11 @@ def get_topology(topology: str):
     from jax.experimental import topologies
 
     os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    # off-GCE hosts: libtpu's metadata-server query has no answer and can
+    # hang topology construction indefinitely; the topology is fully
+    # specified by the string, so the query is unnecessary (setdefault:
+    # a real TPU VM's own env still wins)
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
     return topologies.get_topology_desc(topology, "tpu")
 
 
@@ -210,7 +262,16 @@ def aot_compile_step(
     with force_on_tpu_selection():
         lowered = step.trace(state_avals, batch_avals).lower(
             lowering_platforms=("tpu",))
-    exe = lowered.compile()
+    # overlap schedule: the deviceless compile gets the same latency-
+    # hiding-scheduler + combine-threshold flags the on-chip runner uses
+    # (the compile TARGETS tpu even though the process backend is cpu, so
+    # this is passed explicitly rather than via the backend-keyed helper);
+    # options this libtpu build doesn't expose are dropped with a warning
+    from autodist_tpu.kernel.xla_options import (compile_lowered,
+                                                 compiler_options_for)
+
+    opts = compiler_options_for(t.sync_schedule, backend="tpu")
+    exe, _applied = compile_lowered(lowered, opts)
     kind = getattr(topo.devices[0], "device_kind", "?")
     hbm = hbm_bytes_per_device
     if hbm is None:

@@ -128,11 +128,18 @@ class AutoDist:
         clip_global_norm=None,
         param_specs=None,
         batch_mask: bool = False,
+        sync_schedule: Optional[str] = None,
     ):
         """Capture single-device code and return a distributed session.
 
         ``remat=True`` wraps the loss in ``jax.checkpoint`` — trade FLOPs
         for HBM by rematerializing activations in the backward pass.
+
+        ``sync_schedule`` overrides the strategy's gradient-sync issue
+        schedule: ``"overlap"`` pipelines per-bucket collectives behind
+        backward compute (XLA latency-hiding scheduler), ``"barrier"``
+        syncs once after the full backward; ``None`` follows the
+        strategy's ``AllReduceSynchronizer.schedule``.
 
         ``batch_mask=True`` enables uneven global batches: non-divisible
         dict batches are padded and given a ``const.BATCH_MASK_KEY`` leaf,
@@ -153,10 +160,10 @@ class AutoDist:
             item, raw, rng=rng, donate=donate, batch_mask=batch_mask,
             data_axes=data_axes, batch_spec=batch_spec,
             accum_steps=accum_steps, clip_global_norm=clip_global_norm,
-            param_specs=param_specs)
+            param_specs=param_specs, sync_schedule=sync_schedule)
 
     def _assemble_session(self, item, raw, *, rng, donate, batch_mask,
-                          **transformer_kwargs):
+                          async_authkey=None, **transformer_kwargs):
         """Shared tail of :meth:`distribute` and :meth:`launch`: verify
         cross-host agreement, compile, transform, wrap in a session."""
         from autodist_tpu.kernel.graph_transformer import GraphTransformer
@@ -202,7 +209,8 @@ class AutoDist:
                     strategy, item, run_id=raw.id,
                     num_workers=(n_nodes if n_nodes > 1
                                  else ENV.AUTODIST_NUM_PROCESSES.val),
-                    chief_host=self._resource_spec.chief)
+                    chief_host=self._resource_spec.chief,
+                    authkey=async_authkey)
             from autodist_tpu.kernel.synchronization.async_ps import (
                 AsyncPSEngineSession)
 
@@ -259,16 +267,28 @@ class AutoDist:
             # through the host PS, so there is no SPMD group to join —
             # skip jax.distributed.  The chief BINDS the service first
             # (assemble), then publishes the BOUND address into the env
-            # the workers are launched with, so an ephemeral-port
-            # (":0") request reaches them resolved.
-            import os
-
-            sess = self._assemble_session(item, raw, **session_kwargs)
+            # the workers are LAUNCHED with (launch-scoped extra_env —
+            # never the chief's own os.environ, which a second launch()
+            # in this process would read back as a stale address), so an
+            # ephemeral-port (":0") request reaches them resolved.  The
+            # chief also mints a random 256-bit session token here — it
+            # launches every worker, so the token rides the same env
+            # contract; only externally-scheduled deployments fall back
+            # to the derived authkey (async_service.resolve_authkey).
             cl = coordinator.cluster
-            if cl.num_processes > 1 and cl.is_chief:
+            chief_launches = cl.num_processes > 1 and cl.is_chief
+            authkey = None
+            if chief_launches:
+                import secrets
+
+                authkey = secrets.token_bytes(32)
+            sess = self._assemble_session(item, raw, async_authkey=authkey,
+                                          **session_kwargs)
+            if chief_launches:
+                extra = {"AUTODIST_ASYNC_PS_AUTHKEY": authkey.hex()}
                 if getattr(sess, "address", None):
-                    os.environ["AUTODIST_ASYNC_PS_ADDR"] = sess.address
-                cl.launch_workers(raw.id)
+                    extra["AUTODIST_ASYNC_PS_ADDR"] = sess.address
+                cl.launch_workers(raw.id, extra_env=extra)
             return sess
         coordinator.setup(raw)  # chief launches workers; everyone joins
         return self._assemble_session(item, raw, **session_kwargs)

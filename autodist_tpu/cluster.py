@@ -88,12 +88,17 @@ class Cluster:
 
     # -- launch (SSH deployment model) -------------------------------------
 
-    def worker_env(self, worker_address, strategy_id):
+    def worker_env(self, worker_address, strategy_id, extra_env=None):
         """Env contract the chief hands to each worker (reference
-        coordinator.py:69-79)."""
+        coordinator.py:69-79).  ``extra_env`` carries chief-runtime values
+        scoped to THIS launch — e.g. the async PS's bound address and the
+        minted session authkey — so the chief never has to mutate its own
+        ``os.environ`` to publish them (a second ``launch()`` in the same
+        process must not read a stale address)."""
         rank = self._rank_order().index(worker_address)
         from autodist_tpu.const import DEFAULT_ASYNC_PS_PORT
 
+        extra_env = dict(extra_env or {})
         env = {
             "AUTODIST_WORKER": worker_address,
             "AUTODIST_STRATEGY_ID": strategy_id or "",
@@ -102,11 +107,15 @@ class Cluster:
             "AUTODIST_COORDINATOR": self.coordinator_address,
             "AUTODIST_MIN_LOG_LEVEL": ENV.AUTODIST_MIN_LOG_LEVEL.val,
             # where the chief's async PS serves, should the strategy go
-            # async (harmless otherwise); the chief's own override wins so
-            # an ephemeral bound port can be handed down
-            "AUTODIST_ASYNC_PS_ADDR": ENV.AUTODIST_ASYNC_PS_ADDR.val
+            # async (harmless otherwise); launch-scoped extra_env wins,
+            # then the chief's own env override, so an ephemeral bound
+            # port can be handed down
+            "AUTODIST_ASYNC_PS_ADDR": extra_env.pop(
+                "AUTODIST_ASYNC_PS_ADDR", "")
+            or ENV.AUTODIST_ASYNC_PS_ADDR.val
             or f"{self._spec.chief}:{DEFAULT_ASYNC_PS_PORT}",
         }
+        env.update(extra_env)
         ssh = self._spec.ssh_config(worker_address)
         if ssh is not None:
             env.update(ssh.env)
@@ -137,13 +146,15 @@ class Cluster:
         cmd += [target, f"bash -c {shlex.quote(remote)}"]
         return cmd
 
-    def launch_workers(self, strategy_id, argv=None):
-        """Chief only: re-execute the user script on every non-chief node."""
+    def launch_workers(self, strategy_id, argv=None, extra_env=None):
+        """Chief only: re-execute the user script on every non-chief node.
+        ``extra_env``: launch-scoped additions to the worker env contract
+        (see :meth:`worker_env`)."""
         if not self.is_chief:
             return
         argv = argv or [os.path.abspath(sys.argv[0])] + sys.argv[1:]
         for addr in self._rank_order()[1:]:
-            env = self.worker_env(addr, strategy_id)
+            env = self.worker_env(addr, strategy_id, extra_env=extra_env)
             cmd = self.remote_command(addr, argv, env)
             logging.info("Launching worker on %s", addr)
             proc = subprocess.Popen(cmd, start_new_session=True)

@@ -66,6 +66,11 @@ class ENV(Enum):
     AUTODIST_NUM_PROCESSES = (lambda v: int(v) if v else 1,)
     AUTODIST_COORDINATOR = (lambda v: v or "",)
     AUTODIST_ASYNC_PS_ADDR = (lambda v: v or "",)
+    # hex-encoded random session token for the async PS transport, minted
+    # by the chief (secrets.token_bytes) and shipped through the
+    # worker_env contract; absent => the documented derived-from-strategy-
+    # id fallback (async_service._run_authkey)
+    AUTODIST_ASYNC_PS_AUTHKEY = (lambda v: v or "",)
     SYS_DATA_PATH = (lambda v: v or "",)
     SYS_RESOURCE_PATH = (lambda v: v or "",)
 

@@ -27,6 +27,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from autodist_tpu.utils import compat  # noqa: F401  (jax.shard_map shim)
 from autodist_tpu.kernel import partitioner as part
 from autodist_tpu.kernel.partitioner import Placement, SyncKind
 from autodist_tpu.kernel.synchronization import all_reduce as ar_sync
@@ -51,13 +52,15 @@ class GraphTransformer:
 
     def __init__(self, strategy, model_item, mesh, data_axes=None,
                  batch_spec=None, accum_steps=1, clip_global_norm=None,
-                 param_specs=None):
+                 param_specs=None, sync_schedule=None):
         """`data_axes`: mesh axes forming the data-parallel device set
         (default: ALL mesh axes — a pure-DP 1-D mesh, or replica x seq for
         sequence parallelism where gradients still synchronize over every
         device).  `batch_spec`: PartitionSpec prefix for batches; default
         shards dim 0 over the first data axis (and, when a "seq" axis
         exists, callers shard dim 1 over it via an explicit spec).
+        `sync_schedule`: "overlap"|"barrier" override of the strategy's
+        AllReduceSynchronizer.schedule (None = follow the strategy).
         """
         self.strategy = strategy
         self.model_item = model_item
@@ -103,6 +106,17 @@ class GraphTransformer:
         shapes = {v.name: v.shape for v in model_item.var_infos}
         dtypes = {v.name: v.dtype for v in model_item.var_infos}
         self.buckets = ar_sync.plan_buckets(self.plans, shapes, dtypes)
+        # collective issue schedule: "overlap" = per-bucket reverse-
+        # topological collectives under XLA's latency-hiding scheduler
+        # (kernel/synchronization/all_reduce.sync_overlapped); "barrier" =
+        # one bucketed sync point after the full backward pass
+        if sync_schedule is None:
+            sync_schedule = ar_sync.schedule_mode(self.plans)
+        if sync_schedule not in ("overlap", "barrier"):
+            raise ValueError(
+                f"sync_schedule must be 'overlap' or 'barrier', got "
+                f"{sync_schedule!r}")
+        self.sync_schedule = sync_schedule
         # CUSTOM (tensor-parallel) vars: specs must only name NON-data mesh
         # axes (a data axis in a custom spec would make the data-axes pmean
         # average distinct blocks); fuse their grad pmeans per (spec, dtype)
@@ -158,8 +172,9 @@ class GraphTransformer:
                 key = (str(np.dtype(plan.dtype)), plan.ps_axes or ())
                 self.ps_groups.setdefault(key, []).append(name)
         logging.info(
-            "Transform plan: %d vars, %d AR buckets, placements=%s",
-            len(self.names), len(self.buckets),
+            "Transform plan: %d vars, %d AR buckets (%s schedule), "
+            "placements=%s",
+            len(self.names), len(self.buckets), self.sync_schedule,
             {p.value: sum(1 for q in self.plans.values() if q.placement is p)
              for p in Placement},
         )
@@ -174,7 +189,8 @@ class GraphTransformer:
                  f"clip_global_norm: {self.clip_global_norm}",
                  f"AR buckets: {len(self.buckets)}  "
                  f"fused PS groups: {len(self.ps_groups)}  "
-                 f"custom groups: {len(self.custom_groups)}", ""]
+                 f"custom groups: {len(self.custom_groups)}  "
+                 f"sync_schedule: {self.sync_schedule}", ""]
         for name in self.names:
             p = self.plans[name]
             extra = ""
@@ -504,6 +520,27 @@ class GraphTransformer:
         from autodist_tpu.parallel.context import seq_axis_context
 
         A = self.accum_steps
+        # compressor state arrives stacked per device; unwrap the local
+        # copy here (rewrapped after sync)
+        comp_local = {k: jax.tree.map(lambda a: a[0], v) for k, v in comp.items()}
+        # overlap + accumulation: each microbatch's bucket collectives are
+        # emitted INSIDE the scan, as soon as that iteration's grads are
+        # final — XLA's latency-hiding scheduler hoists iteration i's
+        # reduce behind iteration i+1's forward/backward compute.  The
+        # mean-of-partial-means equals the barrier's mean-of-accumulated
+        # gradients (collectives are linear), at A× wire volume — the
+        # latency-for-bandwidth trade docs/performance.md documents.
+        # Only ELEMENTWISE codecs qualify (none/bf16 ± error feedback);
+        # block codecs applied to partial gradients (int8 re-blocking,
+        # PowerSGD's low-rank fit) compute a different approximation, so
+        # those buckets keep accumulating and sync once after the scan.
+        scan_buckets = [b for b in self.buckets if ar_sync.elementwise(b)] \
+            if (self.sync_schedule == "overlap" and A > 1) else []
+        overlap_in_scan = bool(scan_buckets)
+        post_buckets = [b for b in self.buckets if b not in scan_buckets]
+        bucket_names = frozenset(
+            n for b in scan_buckets for n in b.var_names)
+        synced = comp_new_local = None
         with replica_axis_context(axis), seq_axis_context(self.seq_axis):
             if A <= 1:
                 (loss, (maybe_mut, aux)), grads = run_vag(batch, 0, mutable)
@@ -534,11 +571,50 @@ class GraphTransformer:
                              mut_next),
                             aux_)
 
+                def scan_body_overlap(carry, mb_i):
+                    mb, i = mb_i
+                    acc_l, acc_g, mut_cur, comp_cur, acc_synced = carry
+                    (l, (mut_next, aux_)), g = run_vag(mb, i, mut_cur)
+                    if not has_mutable:
+                        mut_next = mut_cur
+                    g_leaves_ = self.treedef.flatten_up_to(g)
+                    g_names = dict(zip(self.names, g_leaves_))
+                    synced_i, comp_next = ar_sync.sync_overlapped(
+                        g_names, scan_buckets, comp_cur, axis)
+                    acc_synced = {n: acc_synced[n] + synced_i[n] / A
+                                  for n in acc_synced}
+                    # bucketed vars accumulate ONLY their synced mean (the
+                    # raw-grad accumulator stays zero for them — no double
+                    # buffering of the bucketed gradient set)
+                    acc_leaves = self.treedef.flatten_up_to(acc_g)
+                    new_acc = [a if n in bucket_names else a + gl / A
+                               for n, a, gl in zip(self.names, acc_leaves,
+                                                   g_leaves_)]
+                    return ((acc_l + l / A,
+                             self.treedef.unflatten(new_acc),
+                             mut_next, comp_next, acc_synced),
+                            aux_)
+
                 zero_g = jax.tree.map(jnp.zeros_like, full)
-                (loss, grads, mut_final), auxs = jax.lax.scan(
-                    scan_body,
-                    (jnp.zeros((), jnp.float32), zero_g, mutable),
-                    (micro, jnp.arange(A)))
+                if overlap_in_scan:
+                    zero_synced = {
+                        n: jnp.zeros_like(leaf)
+                        for n, leaf in zip(self.names,
+                                           self.treedef.flatten_up_to(full))
+                        if n in bucket_names}
+                    comp_scan = {b.key: comp_local[b.key]
+                                 for b in scan_buckets}
+                    (loss, grads, mut_final, comp_scan_new, synced), auxs = (
+                        jax.lax.scan(
+                            scan_body_overlap,
+                            (jnp.zeros((), jnp.float32), zero_g, mutable,
+                             comp_scan, zero_synced),
+                            (micro, jnp.arange(A))))
+                else:
+                    (loss, grads, mut_final), auxs = jax.lax.scan(
+                        scan_body,
+                        (jnp.zeros((), jnp.float32), zero_g, mutable),
+                        (micro, jnp.arange(A)))
                 new_mutable = mut_final if has_mutable else None
                 aux = jax.tree.map(lambda x: jnp.mean(x, axis=0), auxs)
             if has_mutable:
@@ -548,14 +624,27 @@ class GraphTransformer:
                     if jnp.issubdtype(x.dtype, jnp.floating) else x,
                     new_mutable)
 
-        g_leaves = self.treedef.flatten_up_to(grads)
-        g_by_name = dict(zip(self.names, g_leaves))
-
-        # 3. bucketed allreduce for dense AR vars (compressor state arrives
-        # stacked per device; unwrap the local copy, rewrap after)
-        comp_local = {k: jax.tree.map(lambda a: a[0], v) for k, v in comp.items()}
-        synced, comp_new_local = ar_sync.sync_bucketed(
-            g_by_name, self.buckets, comp_local, axis)
+            # 3. bucketed allreduce for dense AR vars.  barrier: one sync
+            # point here, after the full backward; overlap (A<=1): per-
+            # bucket reverse-topological collectives the latency-hiding
+            # scheduler can pipeline; overlap (A>1): elementwise-codec
+            # buckets already synced inside the scan above, block-codec
+            # buckets sync here on the accumulated gradients.
+            g_leaves = self.treedef.flatten_up_to(grads)
+            g_by_name = dict(zip(self.names, g_leaves))
+            if synced is None:
+                sync_fn = (ar_sync.sync_overlapped
+                           if self.sync_schedule == "overlap"
+                           else ar_sync.sync_bucketed)
+                synced, comp_new_local = sync_fn(
+                    g_by_name, self.buckets, comp_local, axis)
+            elif post_buckets:
+                synced_post, comp_post = ar_sync.sync_overlapped(
+                    g_by_name, post_buckets, comp_local, axis)
+                synced = {**synced, **synced_post}
+                comp_new_local = {**comp_post, **comp_scan_new}
+            else:
+                comp_new_local = {**comp_local, **comp_scan_new}
         comp_new = {k: jax.tree.map(lambda a: a[None], v)
                     for k, v in comp_new_local.items()}
 
@@ -933,7 +1022,21 @@ class GraphTransformer:
                 check_vma=False,
             )(state, batch)
 
-        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+        # overlap schedule: compile with the latency-hiding scheduler +
+        # bucket-sized combine thresholds so the per-bucket collectives
+        # actually pipeline (kernel/xla_options.py); TPU backend only —
+        # other backends reject the TPU-namespaced flags — and probed
+        # down to what this libtpu's per-compile surface supports
+        from autodist_tpu.kernel.xla_options import (compiler_options_for,
+                                                     probe_supported_options)
+
+        opts = compiler_options_for(self.sync_schedule)
+        if opts:
+            opts = probe_supported_options(opts)
+        kwargs = {"donate_argnums": (0,) if donate else ()}
+        if opts:
+            kwargs["compiler_options"] = opts
+        return jax.jit(step_fn, **kwargs)
 
 
 def get_stateful(bucket):

@@ -68,6 +68,10 @@ class VarPlan:
     group: int = 0
     compressor: int = 0
     spec: int = 0
+    # AllReduceSynchronizer.Schedule: 0 = BARRIER (sync after the full
+    # backward), 1 = OVERLAP (per-bucket reverse-topological collectives
+    # under XLA's latency-hiding scheduler)
+    schedule: int = 0
     # PS fields
     ps_sync: bool = True
     staleness: int = 0
@@ -177,6 +181,7 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
             plan.group = ar.group
             plan.compressor = ar.compressor
             plan.spec = ar.spec
+            plan.schedule = ar.schedule
         else:
             logging.debug("Variable %s node has no synchronizer; AllReduce default", v.name)
 

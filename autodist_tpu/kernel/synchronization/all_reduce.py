@@ -5,6 +5,25 @@ wraps each dense gradient in ``collective_ops.all_reduce`` with group keys
 for ScopedAllocator fusion.  Here: gradients of same (strategy group, dtype,
 compressor) are flattened into one fused buffer, reduced by the chosen codec
 over the replica mesh axis, and split back.  Runs inside ``shard_map``.
+
+Two issue schedules (``AllReduceSynchronizer.Schedule``):
+
+- :func:`sync_bucketed` — BARRIER: every bucket's collective is emitted
+  after the full backward pass, in forward-topological order.
+- :func:`sync_overlapped` — OVERLAP: buckets are issued in REVERSE
+  layer-topological order (the order backprop finalizes their gradients)
+  and elementwise codecs are split into ``DEFAULT_BUCKET_BYTES``-bounded
+  chunks, so each collective depends only on its own slice of gradients
+  and XLA's latency-hiding scheduler (``xla_tpu_enable_latency_hiding_
+  scheduler``, wired by ``kernel/xla_options.py``) can hoist it behind
+  the remaining backward compute instead of serializing one bucketed
+  barrier.  The arXiv 2004.13336 decomposition makes the same per-bucket
+  pipelining profitable for the PS (reduce-scatter) family.  Numerics are
+  IDENTICAL to the barrier schedule: chunking is only applied to
+  elementwise codecs (none/bf16, with or without error feedback), where a
+  per-chunk reduce equals the fused reduce element-for-element; block
+  codecs (int8, PowerSGD) keep their whole-bucket collective and are
+  merely reordered.
 """
 import dataclasses
 from typing import Dict, List
@@ -12,7 +31,28 @@ from typing import Dict, List
 import numpy as np
 import jax.numpy as jnp
 
+from autodist_tpu.const import DEFAULT_BUCKET_BYTES
 from autodist_tpu.kernel.synchronization.compressor import get_compressor
+from autodist_tpu.proto import synchronizers_pb2
+
+_AR = synchronizers_pb2.AllReduceSynchronizer
+# codecs that act element-for-element on the flat buffer: reducing any
+# chunking of the buffer equals reducing the fused buffer, so the overlap
+# schedule may split them at arbitrary offsets (error-feedback state is a
+# flat f32 residual and slices at the same offsets)
+_ELEMENTWISE_CODECS = frozenset(
+    (_AR.NoneCompressor, _AR.BF16Compressor, _AR.BF16CompressorEF))
+
+
+def elementwise(bucket) -> bool:
+    """True when the bucket's codec acts element-for-element on the flat
+    buffer — the codecs the overlap schedule may chunk, and the only ones
+    whose per-microbatch partial reduce (the in-scan overlap path of
+    ``graph_transformer``) is equivalent to the accumulated barrier reduce
+    up to rounding.  Block codecs (int8 blocks, PowerSGD factors) applied
+    to PARTIAL gradients compute a genuinely different approximation, so
+    they must sync once on the accumulated gradient."""
+    return bucket.compressor in _ELEMENTWISE_CODECS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,20 +108,97 @@ def init_compressor_states(buckets):
     return states
 
 
+def _bucket_buf(grads_by_name, b):
+    # native-dtype wire: a bf16-grad bucket under NoneCompressor rides the
+    # ICI at bf16 (the r1 verdict's "weak #3" — upcasting to f32 doubled
+    # wire bytes); codecs needing f32 math cast internally
+    flats = [jnp.ravel(grads_by_name[n]) for n in b.var_names]
+    return jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+
+
+def _unpack_bucket(b, reduced, grads_by_name, synced):
+    off = 0
+    for n, sz, shp in zip(b.var_names, b.sizes, b.shapes):
+        synced[n] = jnp.reshape(reduced[off:off + sz], shp).astype(
+            grads_by_name[n].dtype)
+        off += sz
+
+
 def sync_bucketed(grads_by_name, buckets, comp_states, axis_name):
     """AllReduce all buckets; returns (synced grads dict, new comp states)."""
     synced = {}
     new_states = dict(comp_states)
     for b in buckets:
         comp = get_compressor(b.compressor)
-        # native-dtype wire: a bf16-grad bucket under NoneCompressor rides the
-        # ICI at bf16 (the r1 verdict's "weak #3" — upcasting to f32 doubled
-        # wire bytes); codecs needing f32 math cast internally
-        flats = [jnp.ravel(grads_by_name[n]) for n in b.var_names]
-        buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        buf = _bucket_buf(grads_by_name, b)
         reduced, new_states[b.key] = comp.all_reduce(buf, comp_states[b.key], axis_name)
-        off = 0
-        for n, sz, shp in zip(b.var_names, b.sizes, b.shapes):
-            synced[n] = jnp.reshape(reduced[off:off + sz], shp).astype(grads_by_name[n].dtype)
-            off += sz
+        _unpack_bucket(b, reduced, grads_by_name, synced)
     return synced, new_states
+
+
+def _chunk_sizes(total_elems, dtype, max_bytes):
+    """Split ``total_elems`` into contiguous chunks of <= ``max_bytes``."""
+    itemsize = np.dtype(dtype).itemsize
+    per_chunk = max(1, int(max_bytes) // itemsize)
+    n_chunks = -(-total_elems // per_chunk)
+    base = total_elems // n_chunks
+    rem = total_elems - base * n_chunks
+    return [base + (1 if i < rem else 0) for i in range(n_chunks)]
+
+
+def sync_overlapped(grads_by_name, buckets, comp_states, axis_name,
+                    max_chunk_bytes=DEFAULT_BUCKET_BYTES):
+    """Per-bucket pipelined sync (``schedule="overlap"``).
+
+    Buckets are issued in REVERSE layer-topological order — backprop
+    finalizes the deepest layers' gradients first, so this is the order in
+    which each collective's inputs become ready — and elementwise codecs
+    are further split into ``max_chunk_bytes``-bounded chunks.  Each
+    emitted collective therefore depends only on its own gradient slice;
+    under ``xla_tpu_enable_latency_hiding_scheduler`` XLA hoists it behind
+    the remaining backward compute (pipelined communication) instead of
+    draining everything at one bucketed barrier.  Numerically equal to
+    :func:`sync_bucketed` for every codec (see module docstring).
+    """
+    synced = {}
+    new_states = dict(comp_states)
+    for b in reversed(buckets):
+        comp = get_compressor(b.compressor)
+        buf = _bucket_buf(grads_by_name, b)
+        nbytes = b.total * np.dtype(b.dtype).itemsize
+        if b.compressor in _ELEMENTWISE_CODECS and nbytes > max_chunk_bytes:
+            sizes = _chunk_sizes(b.total, b.dtype, max_chunk_bytes)
+            pieces, state_pieces, off = [], [], 0
+            for sz in sizes:
+                # EF residual state is a flat f32 buffer aligned with the
+                # bucket: slice it at the same offsets as the wire chunks
+                st = (comp_states[b.key][off:off + sz] if comp.stateful
+                      else comp_states[b.key])
+                red, nst = comp.all_reduce(buf[off:off + sz], st, axis_name)
+                pieces.append(red)
+                state_pieces.append(nst)
+                off += sz
+            reduced = jnp.concatenate(pieces)
+            new_states[b.key] = (jnp.concatenate(state_pieces)
+                                 if comp.stateful else comp_states[b.key])
+        else:
+            # block codecs (int8 blocks, PowerSGD factor matrices) reduce
+            # whole-bucket so their state/blocking stays bit-identical to
+            # the barrier schedule; they still reorder for latency hiding
+            reduced, new_states[b.key] = comp.all_reduce(
+                buf, comp_states[b.key], axis_name)
+        _unpack_bucket(b, reduced, grads_by_name, synced)
+    return synced, new_states
+
+
+def schedule_mode(plans):
+    """Engine-level issue schedule: ``"overlap"`` when any dense
+    AR-replicated plan requests ``Schedule.OVERLAP``, else ``"barrier"``."""
+    from autodist_tpu.kernel.partitioner import Placement, SyncKind
+
+    for plan in plans.values():
+        if (plan.sync == SyncKind.ALL_REDUCE
+                and plan.placement == Placement.REPLICATED
+                and not plan.sparse and plan.schedule == _AR.OVERLAP):
+            return "overlap"
+    return "barrier"

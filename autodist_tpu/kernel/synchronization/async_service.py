@@ -23,11 +23,16 @@ the wire is a numpy pytree (pickled by the manager).
    arbitrary code in the serving process.  The default bind address is
    loopback; bind a routable address only inside a private, trusted
    cluster network (the same trust model as the reference's gRPC PS,
-   which also ran unauthenticated inside the job's network).  The
-   front-door :class:`AsyncPSClusterSession` derives its authkey from
-   the run's strategy id rather than a well-known constant, so two
-   concurrent runs cannot cross-connect by accident — this is run
-   isolation, NOT an authentication boundary.
+   which also ran unauthenticated inside the job's network).  When the
+   chief launches its own workers, the front-door
+   :class:`AsyncPSClusterSession` authenticates with a chief-minted
+   random 256-bit token (``secrets.token_bytes``) shipped through the
+   ``worker_env`` contract (``AUTODIST_ASYNC_PS_AUTHKEY``).  Externally-
+   scheduled deployments that cannot receive the token fall back to an
+   authkey DERIVED from the run's strategy id (:func:`_run_authkey`) —
+   that fallback is run isolation only (two concurrent runs cannot
+   cross-connect by accident), NOT an authentication boundary, because
+   the strategy id is predictable (a timestamp + pid + counter).
 """
 import hashlib
 import threading
@@ -139,10 +144,33 @@ def connect_async_ps(address, authkey=b"autodist-async-ps", retries=40,
 
 
 def _run_authkey(run_id):
-    """Per-run authkey from the shared RAW strategy id (every process holds
-    it via the chief→worker strategy handoff).  Run isolation, not an
-    authentication boundary — see the module warning."""
+    """Documented FALLBACK authkey, derived from the shared RAW strategy
+    id (every process holds it via the chief→worker strategy handoff).
+    Run isolation, not an authentication boundary — the id is predictable
+    — see the module warning.  Chief-launched clusters use a random
+    token instead (:func:`resolve_authkey`)."""
     return hashlib.sha256(b"autodist-async-ps:" + run_id.encode()).digest()
+
+
+def resolve_authkey(run_id, token=None):
+    """The session's transport authkey, strongest source first:
+
+    1. ``token`` — the chief-minted random 256-bit token
+       (``secrets.token_bytes(32)``), passed in-process on the chief and
+       shipped hex-encoded through the ``worker_env`` contract;
+    2. ``AUTODIST_ASYNC_PS_AUTHKEY`` — the same token arriving in a
+       launched worker's environment;
+    3. the derived-from-strategy-id fallback for externally-scheduled
+       deployments that cannot receive a token (run isolation only).
+    """
+    from autodist_tpu.const import ENV
+
+    if token:
+        return token if isinstance(token, bytes) else bytes.fromhex(token)
+    env_tok = ENV.AUTODIST_ASYNC_PS_AUTHKEY.val
+    if env_tok:
+        return bytes.fromhex(env_tok)
+    return _run_authkey(run_id)
 
 
 class AsyncPSClusterSession:
@@ -159,12 +187,15 @@ class AsyncPSClusterSession:
 
     The endpoint comes from ``AUTODIST_ASYNC_PS_ADDR`` (``host:port``; the
     chief may bind port 0 and hand the BOUND address to workers it
-    launches) and defaults to ``chief_host:DEFAULT_ASYNC_PS_PORT``; the
-    authkey derives from the raw strategy id shared by the handoff.
+    launches) and defaults to ``chief_host:DEFAULT_ASYNC_PS_PORT``.  The
+    transport authkey resolves via :func:`resolve_authkey`: a chief-minted
+    random token when the chief launches the workers (``AutoDist.launch``
+    ships it through ``worker_env``), else the derived fallback.
     """
 
     def __init__(self, strategy, model_item, *, run_id, num_workers=None,
-                 worker_id=None, address=None, chief_host=None):
+                 worker_id=None, address=None, chief_host=None,
+                 authkey=None):
         from autodist_tpu.const import DEFAULT_ASYNC_PS_PORT, ENV
         from autodist_tpu.kernel.synchronization.async_ps import (
             resolve_async_plans)
@@ -187,7 +218,7 @@ class AsyncPSClusterSession:
         self.history = []                       # (worker, version, loss)
         self.aux_history = []
 
-        authkey = _run_authkey(run_id)
+        authkey = resolve_authkey(run_id, authkey)
         if address is None:
             address = ENV.AUTODIST_ASYNC_PS_ADDR.val or (
                 f"{chief_host or '127.0.0.1'}:{DEFAULT_ASYNC_PS_PORT}")

@@ -180,7 +180,7 @@ def _tpu_params(dimension_semantics):
     from jax.experimental.pallas import tpu as pltpu
     try:
         return pltpu.CompilerParams(dimension_semantics=dimension_semantics)
-    except TypeError:  # older jax spelling
+    except (TypeError, AttributeError):  # older jax spelling
         return pltpu.TPUCompilerParams(dimension_semantics=dimension_semantics)
 
 

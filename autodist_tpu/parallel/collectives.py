@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from autodist_tpu.const import DEFAULT_BUCKET_BYTES
+from autodist_tpu.utils import compat  # noqa: F401  (jax.lax.axis_size shim)
 
 
 def all_reduce_mean(x, axis_name):

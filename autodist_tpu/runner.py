@@ -25,6 +25,18 @@ class DistributedSession:
         self._mesh = transformer.mesh
         self._axis = transformer.axis
         self.state = transformer.init_state(rng=rng)
+        if transformer.sync_schedule == "overlap":
+            # the step compiles with the latency-hiding scheduler + bucket-
+            # sized combine thresholds on TPU (kernel/xla_options.py, via
+            # make_train_step); log what this backend actually gets so an
+            # overlap run's compile configuration is auditable
+            from autodist_tpu.kernel.xla_options import compiler_options_for
+
+            opts = compiler_options_for("overlap")
+            logging.info(
+                "Overlap sync schedule on %s backend: compiler options %s",
+                jax.default_backend(),
+                opts or "none (TPU-only flags skipped)")
         self._step = transformer.make_train_step(donate=donate)
         self._batch_spec = transformer.batch_spec
         self._multi_host = jax.process_count() > 1

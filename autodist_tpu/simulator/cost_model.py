@@ -23,6 +23,14 @@ Model (per step, seconds):
                ring-AR and reduce-scatter+all-gather are IDENTICAL (that
                equivalence is how the engine realizes PS), so this term
                is what genuinely separates the dense strategies.
+  overlap    ~ strategies with ``schedule="overlap"`` price comm and
+               compute as max(comm, compute) + exposed-tail instead of
+               the serialized hi + 0.7*lo: the per-bucket collectives
+               pipeline behind remaining backward FLOPs under XLA's
+               latency-hiding scheduler, except the topologically last
+               bucket whose reduce has nothing left to hide behind.  The
+               overlapped total is clamped to never exceed the serialized
+               one (tests/test_overlap_sync.py pins this).
 """
 import dataclasses
 import json
@@ -46,13 +54,37 @@ class CostEstimate:
     compute_s: float
     comm_s: float
     breakdown: dict
+    # AllReduceSynchronizer.Schedule of the strategy's dense AR family:
+    # "overlap" prices the per-bucket pipelined schedule (max(comm,
+    # compute) + exposed tail), "barrier" the serialized one
+    schedule: str = "barrier"
+
+    @property
+    def serialized_s(self):
+        """Barrier-schedule step time: collectives overlap with compute
+        only incidentally; assume the larger dominates with 30% credit."""
+        lo, hi = sorted((self.compute_s, self.comm_s))
+        return hi + 0.7 * lo
+
+    @property
+    def overlapped_s(self):
+        """Overlap-schedule step time: per-bucket collectives pipeline
+        behind remaining backward FLOPs under the latency-hiding
+        scheduler, so comm and compute cost ``max(comm, compute)`` instead
+        of ``comm + compute`` — plus the EXPOSED tail: the topologically
+        last bucket (the first layers' gradients) finalizes when no
+        backward compute remains to hide behind, so one bucket's worth of
+        comm always serializes.  Clamped by ``serialized_s``: pipelining
+        can never cost more than not pipelining."""
+        exposed = self.breakdown.get("overlap_exposed_s", 0.0)
+        return min(self.serialized_s,
+                   max(self.compute_s, self.comm_s) + exposed)
 
     @property
     def total_s(self):
-        # collectives overlap with compute only partially; assume the larger
-        # dominates with 30% overlap credit
-        lo, hi = sorted((self.compute_s, self.comm_s))
-        return hi + 0.7 * lo
+        if self.schedule == "overlap":
+            return self.overlapped_s
+        return self.serialized_s
 
     def calibrated_total(self, calibration):
         """Measured-data-corrected step time: the analytic terms scaled by
@@ -63,7 +95,9 @@ class CostEstimate:
 
     def to_json(self):
         return {"compute_s": self.compute_s, "comm_s": self.comm_s,
-                "total_s": self.total_s, **self.breakdown}
+                "total_s": self.total_s, "schedule": self.schedule,
+                "serialized_s": self.serialized_s,
+                "overlapped_s": self.overlapped_s, **self.breakdown}
 
 
 def calibrate(pairs):
@@ -141,6 +175,11 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
 
     ar_bytes = ps_bytes = gather_bytes = sparse_bytes = 0
     update_bytes = 0.0
+    # overlap schedule bookkeeping: which dense-AR vars request
+    # Schedule.OVERLAP, and how many buckets they split into (one per
+    # (group, dtype, compressor) — mirrors all_reduce.plan_buckets)
+    ar_overlap = False
+    ar_bucket_keys = set()
     for v in model_item.var_infos:
         plan = plans.get(v.name)
         if plan is None:
@@ -150,10 +189,16 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         # the parameter (+ moments) per chip — SHARDED storage AND sync-PS
         # (the engine's PS is reduce-scatter → shard-local update →
         # all-gather even for replicated storage, graph_transformer.py);
-        # replicated-AR / DIVERGENT update the full var on every chip
-        sharded_update = (plan.placement == Placement.SHARDED
-                          or (plan.sync == SyncKind.PS
-                              and plan.placement != Placement.DIVERGENT))
+        # replicated-AR / DIVERGENT update the full var on every chip.
+        # async PS (ps_sync=False) updates FULL params on the host server
+        # (async_ps/async_service runtimes), so only SYNCHRONOUS plans
+        # earn the 1/R term — an async strategy (even a partitioned one)
+        # must not inherit the HBM-bound discount in rankings (ADVICE r5)
+        async_ps = plan.sync == SyncKind.PS and not plan.ps_sync
+        sharded_update = not async_ps and (
+            plan.placement == Placement.SHARDED
+            or (plan.sync == SyncKind.PS
+                and plan.placement != Placement.DIVERGENT))
         update_bytes += nbytes / R if sharded_update else nbytes
         if plan.sparse:
             rows = avg_sparse_rows or batch_per_chip
@@ -188,6 +233,10 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
             from autodist_tpu.proto import synchronizers_pb2
 
             _C = synchronizers_pb2.AllReduceSynchronizer
+            if plan.schedule == _C.OVERLAP:
+                ar_overlap = True
+            ar_bucket_keys.add((plan.group, str(plan.dtype),
+                                plan.compressor))
             if plan.compressor == _C.PowerSGDCompressor:
                 # PowerSGD: wire = r*(rows+cols) floats
                 from autodist_tpu.kernel.synchronization.compressor import (
@@ -224,12 +273,21 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                     + _ring_time(subset_ps_bytes / subset_R, subset_other, bw))
         comm_s += subset_s
     update_s = opt_bytes_factor * update_bytes / (hbm_gbps * 1e9)
+    # overlap schedule (arXiv 2004.13336-style pipelining under the
+    # latency-hiding scheduler): the per-bucket collectives hide behind
+    # remaining backward FLOPs — total becomes max(comm, compute) — except
+    # the topologically LAST bucket, whose reduce has no backward left to
+    # hide behind; one bucket's share of the AR ring time stays exposed
+    ar_ring_s = _ring_time(ar_bytes, R, bw)
+    exposed_s = ar_ring_s / max(1, len(ar_bucket_keys))
     return CostEstimate(compute_s + update_s, comm_s, {
         "ar_bytes": ar_bytes, "ps_bytes": ps_bytes,
         "gather_bytes": gather_bytes, "sparse_bytes": sparse_bytes,
         "subset_ps_bytes": subset_ps_bytes, "subset_ps_s": subset_s,
         "update_bytes": update_bytes, "update_s": update_s,
-        "num_replicas": R})
+        "ar_buckets": len(ar_bucket_keys), "overlap_exposed_s": exposed_s,
+        "num_replicas": R},
+        schedule="overlap" if ar_overlap else "barrier")
 
 
 def rank_strategies(builders, model_item, resource_spec, calibration=None, **kw):

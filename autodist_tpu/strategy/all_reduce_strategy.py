@@ -10,7 +10,8 @@ all-gather sparse path is single-node only); here sparse variables are
 handled by the sparse all-gather synchronizer, matching in capability.
 """
 from autodist_tpu.proto import synchronizers_pb2
-from autodist_tpu.strategy.base import Strategy, StrategyBuilder, resolve_compressor
+from autodist_tpu.strategy.base import (Strategy, StrategyBuilder,
+                                        resolve_compressor, resolve_schedule)
 
 _SPECS = {
     "AUTO": synchronizers_pb2.AllReduceSynchronizer.AUTO,
@@ -23,12 +24,20 @@ _SPECS = {
 
 
 class AllReduce(StrategyBuilder):
-    def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor"):
+    def __init__(self, chunk_size=128, all_reduce_spec="AUTO",
+                 compressor="NoneCompressor", schedule="barrier"):
+        """``schedule="overlap"`` emits per-bucket collectives in reverse
+        layer-topological order and compiles with XLA's latency-hiding
+        scheduler so each bucket's reduce hoists behind remaining backward
+        compute; ``"barrier"`` (default) syncs all buckets after the full
+        backward pass (docs/performance.md "Overlap scheduler")."""
         if chunk_size < 1:
             raise ValueError("The chunk_size must be greater than zero")
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
+        resolve_schedule(schedule)  # fail at construction, not build
+        self.schedule = schedule
 
     def _fill_node(self, n, v, group):
         n.var_name = v.name
@@ -38,6 +47,7 @@ class AllReduce(StrategyBuilder):
                              synchronizers_pb2.AllReduceSynchronizer.AUTO)
         ar.compressor = resolve_compressor(self.compressor)
         ar.group = group
+        ar.schedule = resolve_schedule(self.schedule)
 
     def build(self, model_item, resource_spec):
         s = Strategy()

@@ -18,12 +18,14 @@ def default_candidates():
     return [
         AllReduce(),
         AllReduce(compressor="BF16Compressor"),
+        AllReduce(schedule="overlap"),
         PS(),
         PSLoadBalancing(),
         PartitionedPS(),
         UnevenPartitionedPS(),
         PartitionedAR(),
         Parallax(),
+        Parallax(schedule="overlap"),
         Parallax(compressor="BF16Compressor"),
     ]
 

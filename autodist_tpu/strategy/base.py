@@ -141,6 +141,25 @@ def resolve_compressor(name_or_value):
         )
 
 
+_SCHEDULE_ALIASES = {
+    "barrier": synchronizers_pb2.AllReduceSynchronizer.BARRIER,
+    "overlap": synchronizers_pb2.AllReduceSynchronizer.OVERLAP,
+}
+
+
+def resolve_schedule(name_or_value):
+    """Map a user-facing ``schedule="overlap"|"barrier"`` knob (or the raw
+    proto enum) to ``AllReduceSynchronizer.Schedule``."""
+    if isinstance(name_or_value, int):
+        return name_or_value
+    try:
+        return _SCHEDULE_ALIASES[str(name_or_value).lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown schedule {name_or_value!r}; valid: "
+            f"{sorted(_SCHEDULE_ALIASES)}")
+
+
 class StrategyCompiler:
     """Resolve + prune a strategy against the concrete cluster.
 

@@ -11,8 +11,9 @@ from autodist_tpu.strategy.partitioned_ps_strategy import get_num_shards
 
 class PartitionedAR(AllReduce):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor",
-                 max_shards=None):
-        super().__init__(chunk_size, all_reduce_spec, compressor)
+                 max_shards=None, schedule="barrier"):
+        super().__init__(chunk_size, all_reduce_spec, compressor,
+                         schedule=schedule)
         self._max_shards = max_shards
 
     def _shards_for(self, v, num_devices):

@@ -1,0 +1,49 @@
+"""Version compatibility shims for the jax API surface the engine uses.
+
+The engine (and its tests) target the current jax API: ``jax.shard_map``
+with the ``check_vma`` knob and the ``jax.P`` PartitionSpec alias.  Older
+jax releases (< 0.5) ship the same functionality as
+``jax.experimental.shard_map.shard_map(..., check_rep=...)`` and have no
+``jax.P``.  ``install()`` bridges the gap in-process so one codebase runs
+on both — it only ever FILLS missing attributes, never overrides a real
+jax implementation, so on current jax it is a no-op.
+"""
+import jax
+
+
+def _shard_map_compat(f=None, *, mesh, in_specs, out_specs, check_vma=True,
+                      **kwargs):
+    """``jax.shard_map`` signature adapter over the experimental API.
+
+    ``check_vma`` (current name) maps onto ``check_rep`` (old name); both
+    toggle the same replication/varying-manual-axes check.
+    """
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def bind(fn):
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma, **kwargs)
+
+    return bind if f is None else bind(f)
+
+
+def _axis_size_compat(axis_name):
+    """``jax.lax.axis_size`` for older jax: ``psum`` of a unit weight over
+    the axis constant-folds to the static axis size (a Python int) inside
+    any axis-binding context (shard_map / pmap), tuple axes included."""
+    return jax.lax.psum(1, axis_name)
+
+
+def install():
+    """Fill in missing current-jax attributes on older jax (idempotent)."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    if not hasattr(jax, "P"):
+        from jax.sharding import PartitionSpec
+
+        jax.P = PartitionSpec
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size_compat
+
+
+install()

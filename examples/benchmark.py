@@ -227,15 +227,33 @@ def _real_pipeline(args, cap, B, sess):
     return DevicePrefetcher(rebuild(), sess, depth=2)
 
 
+def _make_builder(args, strategy_name):
+    """``Name`` or ``Name:overlap`` / ``Name:barrier`` (the AllReduce-family
+    sync schedule); ``--ar_chunk_size`` sets the family's bucket-group
+    granularity so the overlap term has buckets to pipeline."""
+    from autodist_tpu import strategy as S
+
+    name, _, variant = strategy_name.partition(":")
+    builder_cls = getattr(S, name)
+    kwargs = {}
+    if variant:
+        if variant not in ("overlap", "barrier"):
+            raise SystemExit(f"unknown strategy variant {variant!r} in "
+                             f"{strategy_name!r} (overlap | barrier)")
+        kwargs["schedule"] = variant
+    if args.ar_chunk_size and issubclass(builder_cls, S.AllReduce):
+        kwargs["chunk_size"] = args.ar_chunk_size
+    return builder_cls(**kwargs)
+
+
 def run_one(args, strategy_name, cap, n_chips):
     """Build a session under one strategy; measure; return (eps, record)."""
-    from autodist_tpu import strategy as S
     from autodist_tpu.autodist import AutoDist
     from autodist_tpu.resource_spec import ResourceSpec
     from autodist_tpu.simulator.cost_model import measure_and_record
 
     B = args.batch_per_chip * n_chips
-    builder = getattr(S, strategy_name)()
+    builder = _make_builder(args, strategy_name)
     ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
                   strategy_builder=builder)
     sess = ad.distribute(cap["loss_fn"], cap["params"], cap["optimizer"],
@@ -314,7 +332,8 @@ def sweep(args):
         pairs.append((est, record.step_time_s))
         if records_dir:
             record.dump(os.path.join(
-                records_dir, f"{args.model}_{name}.json"))
+                records_dir,
+                f"{args.model}_{name.replace(':', '_')}.json"))
         del sess
 
     measured_rank = sorted(measured, key=measured.get)
@@ -323,6 +342,7 @@ def sweep(args):
         "model": args.model, "chips": n_chips,
         "backend": jax.default_backend(),   # "cpu" = pipeline validation
         "batch_per_chip": args.batch_per_chip,
+        "ar_chunk_size": args.ar_chunk_size or None,
         "measured_step_s": measured, "estimated_step_s": estimated,
         "measured_rank": measured_rank, "estimated_rank": estimated_rank,
         "top_choice_agrees": measured_rank[0] == estimated_rank[0],
@@ -351,7 +371,13 @@ def main():
                          "AllReduce | PartitionedAR | RandomAxisPartitionAR | Parallax")
     ap.add_argument("--strategies", default="",
                     help="comma list -> per-strategy sweep + cost-model "
-                         "validation (e.g. 'AllReduce,PS,PartitionedPS,Parallax')")
+                         "validation (e.g. 'AllReduce,PS,PartitionedPS,"
+                         "Parallax'); an AllReduce-family entry takes an "
+                         "optional ':overlap'/':barrier' sync-schedule "
+                         "suffix")
+    ap.add_argument("--ar_chunk_size", type=int, default=0,
+                    help="bucket-group granularity (vars per group) for "
+                         "AllReduce-family builders; 0 = builder default")
     ap.add_argument("--records_dir", default="",
                     help="dump AutoSync-style RuntimeRecords + summary here")
     ap.add_argument("--data", choices=("synthetic", "real"),
@@ -384,7 +410,7 @@ def main():
         os.makedirs(args.records_dir, exist_ok=True)
         record.dump(os.path.join(
             args.records_dir,
-            f"{args.model}_{args.autodist_strategy}.json"))
+            f"{args.model}_{args.autodist_strategy.replace(':', '_')}.json"))
 
 
 if __name__ == "__main__":

@@ -28,6 +28,9 @@ else:
 
 import pytest  # noqa: E402
 
+# current-jax API surface (jax.shard_map / jax.P) on older jax releases
+from autodist_tpu.utils import compat  # noqa: E402,F401
+
 
 def pytest_addoption(parser):
     parser.addoption(

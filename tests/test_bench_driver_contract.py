@@ -200,3 +200,73 @@ def test_cpu_records_never_persist(harness, tmp_path):
 
     harness(script)
     assert not (tmp_path / "m.json").exists()
+
+
+def test_gpt_any_failure_falls_back_to_measured_batch(harness, monkeypatch):
+    """ADVICE r5: at the new gpt_small B=32 default, ANY child failure —
+    not just a narrowly-matched OOM — retries at the previously-measured
+    B=8 configuration, so an unrecognized failure mode can't lose the
+    round's headline metric."""
+    monkeypatch.setenv("BENCH_MODEL", "gpt_small")
+    seen = []
+
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True}, "", ""
+        seen.append(dict(env))
+        if "BENCH_BATCH" not in env:
+            # a failure with NO OOM marker anywhere in the output
+            return None, "rc=1: some exotic runtime failure", "exotic"
+        return _fake_rec(GPT, 0.3), "", ""
+
+    rec = harness(script)
+    assert rec["metric"] == GPT and rec["mfu"] == 0.3
+    assert [e.get("BENCH_BATCH") for e in seen] == [None, "8"]
+    assert rec["fallback_batch_used"] == 8
+    assert "exotic" in rec["fallback_reason"] or "rc=1" in rec[
+        "fallback_reason"]
+
+
+def test_resnet_nonoom_failure_does_not_halve_batch(harness):
+    """resnet keeps the narrow contract: only a recognized OOM halves the
+    batch; a non-OOM failure retries at the same configuration."""
+    seen = []
+
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True}, "", ""
+        model = env.get("BENCH_MODEL", "resnet50")
+        if model == "gpt_small":
+            return _fake_rec(GPT, 0.3), "", ""
+        seen.append(dict(env))
+        if len(seen) == 1:
+            return None, "rc=1: transient failure", "no oom marker here"
+        return _fake_rec(RESNET, 0.4,
+                         stem=env.get("BENCH_STEM", "conv")), "", ""
+
+    rec = harness(script)
+    assert rec["metric"] == RESNET
+    assert "BENCH_BATCH" not in seen[1]
+
+
+def test_resnet_oom_failure_still_halves_batch(harness):
+    seen = []
+
+    def script(env, timeout_s):
+        if env.get("_BENCH_PROBE"):
+            return {"probe_ok": True}, "", ""
+        model = env.get("BENCH_MODEL", "resnet50")
+        if model == "gpt_small":
+            return _fake_rec(GPT, 0.3), "", ""
+        seen.append(dict(env))
+        if len(seen) == 1:
+            return None, "rc=1: died", "RESOURCE_EXHAUSTED: out of memory"
+        return _fake_rec(RESNET, 0.4,
+                         stem=env.get("BENCH_STEM", "conv")), "", ""
+
+    rec = harness(script)
+    assert rec["metric"] == RESNET
+    assert seen[1]["BENCH_BATCH"] == str(
+        bench.MODELS["resnet50"]["default_batch"] // 2)
+    assert rec["fallback_batch_used"] == bench.MODELS[
+        "resnet50"]["default_batch"] // 2

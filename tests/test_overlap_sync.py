@@ -1,0 +1,340 @@
+"""Overlap gradient-sync schedule: proto threading, kernel equivalence,
+engine equivalence, and the cost model's overlap term.
+
+The overlap schedule (``AllReduceSynchronizer.Schedule.OVERLAP``) must be
+a pure SCHEDULING change: per-bucket reverse-topological collectives
+(chunked for elementwise codecs) that XLA's latency-hiding scheduler can
+pipeline, with numerics equal to the barrier schedule for every
+compressor family.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.kernel import partitioner as part
+from autodist_tpu.kernel.synchronization import all_reduce as ar
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.proto import synchronizers_pb2
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce, Parallax, PartitionedAR
+
+_C = synchronizers_pb2.AllReduceSynchronizer
+
+SPEC8 = ResourceSpec(resource_info={
+    "nodes": [{"address": "localhost", "chips": list(range(8))}]})
+
+
+def _item():
+    params = {"w1": jnp.zeros((32, 16)), "b1": jnp.zeros((16,)),
+              "w2": jnp.zeros((16, 4))}
+    return ModelItem(lambda p, b: 0.0, params)
+
+
+# -- proto -> builder -> plan -> transformer threading ----------------------
+
+@pytest.mark.parametrize("builder_cls", [AllReduce, PartitionedAR, Parallax])
+def test_schedule_threads_builder_to_proto(builder_cls):
+    s = builder_cls(schedule="overlap").build(_item(), SPEC8)
+    scheds = set()
+    for n in s.node_config:
+        for src in (n, *n.part_config):
+            if src.WhichOneof("synchronizer") == "AllReduceSynchronizer":
+                scheds.add(src.AllReduceSynchronizer.schedule)
+    assert scheds == {_C.OVERLAP}
+    # default stays BARRIER (enum value 0 => wire-compatible with old blobs)
+    s0 = builder_cls().build(_item(), SPEC8)
+    for n in s0.node_config:
+        if n.WhichOneof("synchronizer") == "AllReduceSynchronizer":
+            assert n.AllReduceSynchronizer.schedule == _C.BARRIER
+
+
+def test_schedule_survives_strategy_serialization(tmp_path):
+    s = AllReduce(schedule="overlap").build(_item(), SPEC8)
+    path = s.serialize(str(tmp_path / "strategy"))
+    from autodist_tpu.strategy.base import Strategy
+
+    loaded = Strategy.deserialize(path=path)
+    assert (loaded.node_config[0].AllReduceSynchronizer.schedule
+            == _C.OVERLAP)
+
+
+def test_schedule_reaches_plans_and_transformer():
+    from autodist_tpu.kernel.graph_transformer import GraphTransformer
+    from autodist_tpu.strategy.base import StrategyCompiler
+
+    item = _item()
+    strat = StrategyCompiler(item, SPEC8).compile(
+        AllReduce(schedule="overlap").build(item, SPEC8))
+    plans = part.build_var_plans(strat, item, 8)
+    assert all(p.schedule == _C.OVERLAP for p in plans.values())
+    assert ar.schedule_mode(plans) == "overlap"
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+    t = GraphTransformer(strat, item, mesh)
+    assert t.sync_schedule == "overlap"
+    assert "sync_schedule: overlap" in t.plan_summary()
+    # constructor override beats the strategy
+    t2 = GraphTransformer(strat, item, mesh, sync_schedule="barrier")
+    assert t2.sync_schedule == "barrier"
+    with pytest.raises(ValueError):
+        GraphTransformer(strat, item, mesh, sync_schedule="bogus")
+
+
+def test_invalid_schedule_name_rejected():
+    with pytest.raises(ValueError):
+        AllReduce(schedule="eager")
+
+
+# -- kernel-level equivalence for every compressor family ------------------
+
+_ALL_CODECS = ["NoneCompressor", "BF16Compressor", "BF16CompressorEF",
+               "Int8Compressor", "Int8CompressorEF", "PowerSGDCompressor"]
+
+
+def _toy_buckets(comp_enum):
+    """Two buckets (two strategy groups) of f32 vars, odd sizes."""
+    shapes = {"a": (33,), "b": (17, 3), "c": (41,), "d": (8, 8)}
+    dtypes = {n: np.dtype(np.float32) for n in shapes}
+    plans = {}
+    for i, name in enumerate(sorted(shapes)):
+        plans[name] = part.VarPlan(
+            name=name, shape=shapes[name], dtype=np.float32,
+            placement=part.Placement.REPLICATED,
+            sync=part.SyncKind.ALL_REDUCE,
+            group=i // 2, compressor=comp_enum)
+    buckets = ar.plan_buckets(plans, shapes, dtypes)
+    assert len(buckets) == 2
+    return shapes, buckets
+
+
+@pytest.mark.parametrize("comp", _ALL_CODECS)
+def test_sync_overlapped_matches_bucketed(comp):
+    """Overlapped sync == barrier sync for every codec, INCLUDING the
+    chunked elementwise path (tiny max_chunk_bytes forces many chunks) and
+    stateful codecs across two consecutive steps (state threading)."""
+    comp_enum = getattr(_C, comp)
+    shapes, buckets = _toy_buckets(comp_enum)
+    R = 8
+    mesh = Mesh(np.array(jax.devices()[:R]), ("r",))
+    r = np.random.RandomState(0)
+    # stacked per-device gradients, device i reads row i
+    gstack = {n: r.randn(R, int(np.prod(s))).astype(np.float32)
+              for n, s in shapes.items()}
+
+    def make(sync_fn, **kw):
+        def body(gs):
+            grads1 = {n: gs[n][0].reshape(shapes[n]) for n in shapes}
+            grads2 = {n: (gs[n][0] * 1.7 - 0.3).reshape(shapes[n])
+                      for n in shapes}
+            states = ar.init_compressor_states(buckets)
+            s1, states = sync_fn(grads1, buckets, states, "r", **kw)
+            s2, _ = sync_fn(grads2, buckets, states, "r", **kw)
+            return s1, s2
+
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P("r"), out_specs=P(),
+            check_vma=False))(gstack)
+
+    b1, b2 = make(ar.sync_bucketed)
+    kw = ({"max_chunk_bytes": 64} if ar.elementwise(buckets[0]) else {})
+    o1, o2 = make(ar.sync_overlapped, **kw)
+    for n in shapes:
+        np.testing.assert_allclose(np.asarray(b1[n]), np.asarray(o1[n]),
+                                   rtol=0, atol=1e-6, err_msg=f"{comp}/{n}")
+        np.testing.assert_allclose(np.asarray(b2[n]), np.asarray(o2[n]),
+                                   rtol=0, atol=1e-6,
+                                   err_msg=f"{comp}/{n} step2")
+
+
+# -- engine-level equivalence through the public strategy API --------------
+
+def _train(schedule, compressor="NoneCompressor", accum=1, steps=2):
+    from autodist_tpu.autodist import AutoDist
+
+    r = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(r.randn(32, 16), jnp.float32),
+              "b1": jnp.zeros((16,), jnp.float32),
+              "w2": jnp.asarray(r.randn(16, 4), jnp.float32)}
+
+    def loss(p, b):
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - b["y"]) ** 2)
+
+    batch = {"x": r.randn(32, 32).astype(np.float32),
+             "y": r.randn(32, 4).astype(np.float32)}
+    ad = AutoDist(resource_spec=SPEC8, strategy_builder=AllReduce(
+        compressor=compressor, schedule=schedule))
+    sess = ad.distribute(loss, params, optax.sgd(0.1), accum_steps=accum)
+    assert sess._t.sync_schedule == schedule
+    for _ in range(steps):
+        m = sess.run(batch)
+    return sess.params(), float(m["loss"])
+
+
+def test_engine_overlap_matches_barrier_end_to_end():
+    pb, lb = _train("barrier")
+    po, lo = _train("overlap")
+    assert lb == lo
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-7),
+                 pb, po)
+
+
+def test_engine_overlap_accum_scan_matches_barrier():
+    """accum_steps>1 + overlap: the per-microbatch in-scan sync (mean of
+    partial pmeans) equals the barrier's accumulated pmean (linearity)."""
+    pb, _ = _train("barrier", accum=4)
+    po, _ = _train("overlap", accum=4)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                 pb, po)
+
+
+def test_engine_overlap_accum_block_codec_exact():
+    """Block codecs (PowerSGD) must NOT sync per microbatch — their
+    low-rank fit of partial grads is a different approximation — so
+    overlap + accumulation stays exactly the barrier result for them."""
+    pb, _ = _train("barrier", compressor="PowerSGDCompressor", accum=2)
+    po, _ = _train("overlap", compressor="PowerSGDCompressor", accum=2)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-7),
+                 pb, po)
+
+
+# -- cost model: overlap term ----------------------------------------------
+
+def test_overlap_estimate_never_exceeds_serialized():
+    from autodist_tpu.simulator.cost_model import estimate
+
+    item = _item()
+    for flops in (0.0, 1e9, 1e12):
+        est = estimate(AllReduce(schedule="overlap").build(item, SPEC8),
+                       item, SPEC8, flops_per_example=flops)
+        assert est.schedule == "overlap"
+        assert est.overlapped_s <= est.serialized_s + 1e-18
+        assert est.total_s == est.overlapped_s
+        assert est.breakdown["overlap_exposed_s"] >= 0.0
+
+
+def test_overlap_changes_dense_ranking():
+    """The overlap term must separate otherwise-identical strategies:
+    AllReduce(overlap) prices strictly below AllReduce(barrier) on a
+    multi-chip mesh (comm pipelines behind the update phase).  With a
+    SINGLE bucket there is nothing to pipeline against — the whole ring
+    is the exposed tail — so the multi-bucket case is the one that wins;
+    the one-bucket case must price exactly the serialized time."""
+    from autodist_tpu.simulator.cost_model import estimate, rank_strategies
+
+    item = _item()
+    one_bucket = estimate(AllReduce(schedule="overlap").build(item, SPEC8),
+                          item, SPEC8)
+    assert one_bucket.breakdown["ar_buckets"] == 1
+    assert one_bucket.total_s == one_bucket.serialized_s
+    barrier = estimate(AllReduce(chunk_size=1).build(item, SPEC8),
+                       item, SPEC8)
+    overlap = estimate(
+        AllReduce(chunk_size=1, schedule="overlap").build(item, SPEC8),
+        item, SPEC8)
+    assert barrier.schedule == "barrier"
+    assert overlap.breakdown["ar_buckets"] == 3
+    assert overlap.total_s < barrier.total_s
+    ranking = rank_strategies(
+        [AllReduce(chunk_size=1),
+         AllReduce(chunk_size=1, schedule="overlap")], item, SPEC8)
+    assert ranking[0][2].schedule == "overlap"
+
+
+def test_async_ps_gets_no_sharded_update_discount():
+    """ADVICE r5: async PS updates full params on the host server, so the
+    1/R HBM-bound optimizer term only applies to SYNCHRONOUS plans."""
+    from autodist_tpu.simulator.cost_model import estimate
+    from autodist_tpu.strategy import PartitionedPS, PS
+
+    item = _item()
+    sync_ps = estimate(PS().build(item, SPEC8), item, SPEC8)
+    async_ps = estimate(PS(sync=False, staleness=2).build(item, SPEC8),
+                        item, SPEC8)
+    assert async_ps.breakdown["update_bytes"] \
+        > sync_ps.breakdown["update_bytes"]
+    sync_pps = estimate(PartitionedPS().build(item, SPEC8), item, SPEC8)
+    async_pps = estimate(
+        PartitionedPS(sync=False, staleness=2).build(item, SPEC8),
+        item, SPEC8)
+    assert async_pps.breakdown["update_bytes"] \
+        > sync_pps.breakdown["update_bytes"]
+
+
+# -- AOT serialize round-trip (compile-once-deploy-many) -------------------
+
+def test_aot_step_serialize_roundtrip():
+    """AOTCompiledStep.serialize() must carry the FULL (payload, in_tree,
+    out_tree) calling convention so deserialize() rebuilds a RUNNABLE step
+    from nothing but the blob (ADVICE r5: the bare payload never loaded)."""
+    from autodist_tpu.aot import AOTCompiledStep
+
+    def f(x, y):
+        return {"out": x @ y, "trace": jnp.trace(x @ y)}
+
+    xa = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    exe = jax.jit(f).lower(xa, xa).compile()
+    step = AOTCompiledStep(topology="cpu-test", n_devices=1,
+                           device_kind="cpu", executable=exe,
+                           state_avals=None, donate=False,
+                           hbm_bytes_per_device=1 << 30)
+    blob = step.serialize()
+    assert isinstance(blob, bytes)
+    loaded = AOTCompiledStep.deserialize(blob)
+    assert loaded.topology == "cpu-test"
+    assert loaded.device_kind == "cpu"
+    r = np.random.RandomState(0)
+    x = r.randn(8, 8).astype(np.float32)
+    y = r.randn(8, 8).astype(np.float32)
+    want = jax.jit(f)(x, y)
+    got = loaded.executable(x, y)
+    np.testing.assert_allclose(np.asarray(got["out"]),
+                               np.asarray(want["out"]), atol=1e-6)
+    with pytest.raises(ValueError):
+        AOTCompiledStep.deserialize(b"not a blob")
+
+
+# -- launch env scoping + async authkey (ADVICE r5) ------------------------
+
+def test_worker_env_extra_is_launch_scoped(monkeypatch):
+    """The chief publishes the bound PS address + session token through
+    the worker_env contract, NOT by mutating its own os.environ."""
+    from autodist_tpu.cluster import Cluster
+
+    spec = ResourceSpec(resource_info={"nodes": [
+        {"address": "10.0.0.1", "chips": [0], "chief": True},
+        {"address": "10.0.0.2", "chips": [0]}]})
+    cl = Cluster(spec)
+    extra = {"AUTODIST_ASYNC_PS_ADDR": "10.0.0.1:43999",
+             "AUTODIST_ASYNC_PS_AUTHKEY": "ab" * 32}
+    env = cl.worker_env("10.0.0.2", "sid-1", extra_env=extra)
+    assert env["AUTODIST_ASYNC_PS_ADDR"] == "10.0.0.1:43999"
+    assert env["AUTODIST_ASYNC_PS_AUTHKEY"] == "ab" * 32
+    # nothing leaked into the chief's own process env
+    import os
+
+    assert os.environ.get("AUTODIST_ASYNC_PS_ADDR") != "10.0.0.1:43999"
+    # without extras the contract still defaults sensibly
+    env2 = cl.worker_env("10.0.0.2", "sid-1")
+    assert env2["AUTODIST_ASYNC_PS_ADDR"].startswith("10.0.0.1:")
+    assert "AUTODIST_ASYNC_PS_AUTHKEY" not in env2
+
+
+def test_async_authkey_resolution_order(monkeypatch):
+    from autodist_tpu.kernel.synchronization.async_service import (
+        _run_authkey, resolve_authkey)
+
+    token = bytes(range(32))
+    # 1. explicit token (chief in-process) wins
+    assert resolve_authkey("rid", token) == token
+    assert resolve_authkey("rid", token.hex()) == token
+    # 2. the shipped env token (launched worker)
+    monkeypatch.setenv("AUTODIST_ASYNC_PS_AUTHKEY", token.hex())
+    assert resolve_authkey("rid") == token
+    # 3. derived fallback: deterministic per run id, still 32 bytes
+    monkeypatch.delenv("AUTODIST_ASYNC_PS_AUTHKEY")
+    assert resolve_authkey("rid") == _run_authkey("rid")
+    assert len(_run_authkey("rid")) == 32
+    assert _run_authkey("rid") != _run_authkey("rid2")
