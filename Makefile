@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -56,6 +56,17 @@ aot-gpt-levers:
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m compileall -q autodist_tpu tests examples
+
+# static strategy verification, no TPU needed (docs/analysis.md): every
+# recorded sweep strategy must verify clean, and the canonical rejected
+# case (--selftest) must still produce its three ERROR findings
+verify:
+	$(PY) tools/verify_strategy.py records/cpu_mesh/*.json
+	$(PY) tools/verify_strategy.py --selftest
+
+# the pre-merge static gate: lint + strategy verification
+# (tests/test_analysis.py runs the same chain, so tier-1 exercises it)
+check: lint verify
 
 clean:
 	$(MAKE) -C native clean

@@ -1,0 +1,24 @@
+"""Static strategy verification (compile-free SPMD analysis).
+
+``verify_strategy`` traces the transformed train step to a deviceless
+``ClosedJaxpr`` (the AOT abstract-eval path — runs on CPU in CI) and runs
+pluggable passes producing a severity-ranked :class:`Report`:
+
+- ``sharding``     — strategy/PartitionSpec lint against the mesh
+- ``hbm-static``   — params+opt+grads footprint vs the per-chip budget
+- ``collectives``  — SPMD deadlock analysis (branch-divergent collectives,
+  ppermute validity, wire-dtype overflow)
+- ``donation``     — donation-safety (use-after-donation, wasted donation)
+- ``hbm-traced``   — liveness-based activation peak vs the budget
+
+Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
+(CLI, ``make verify``), the ``verify=`` knob on
+:meth:`AutoDist.distribute`, and ``AutoStrategy`` candidate screening.
+See ``docs/analysis.md``.
+"""
+from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
+                                          StrategyVerificationError)
+from autodist_tpu.analysis.passes import (PASS_REGISTRY, STATIC_PASSES,  # noqa: F401
+                                          TRACE_PASSES)
+from autodist_tpu.analysis.verify import (AnalysisContext, verify_strategy,  # noqa: F401
+                                          verify_transformer)

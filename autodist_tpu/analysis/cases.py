@@ -1,0 +1,56 @@
+"""Canonical verification cases.
+
+:func:`build_rejected_case` is the worked example from ``docs/analysis.md``
+— one hand-built strategy carrying all three classic failure modes at
+once, used by ``tools/verify_strategy.py --selftest`` and the test suite:
+
+(a) a collective issued inside ONE branch of a ``lax.cond`` whose
+    predicate depends on device-local data (an SPMD deadlock on real
+    hardware: devices taking the other branch never reach the
+    rendezvous) -> ``C001``;
+(b) a user PartitionSpec naming a mesh axis that does not exist ->
+    ``S011``;
+(c) a per-chip HBM budget smaller than params + optimizer state + grads
+    -> ``H001``.
+"""
+
+EXPECTED_ERROR_CODES = ("C001", "S011", "H001")
+
+
+def build_rejected_case(num_chips=8):
+    """Returns kwargs for :func:`~autodist_tpu.analysis.verify_strategy`
+    describing the three-failure strategy above."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    params = {"w": jnp.zeros((256, 64)), "b": jnp.zeros((64,))}
+
+    def loss_fn(p, batch):
+        h = batch["x"] @ p["w"][:64] + p["b"]
+        local = jnp.mean(h * h) + sum(
+            jnp.sum(jnp.square(x)) for x in jax.tree.leaves(p))
+        # (a) the bug: "skip the expensive sync when my local loss is
+        # small" — the predicate varies per device, the pmean is a
+        # collective, and devices that take the false branch leave the
+        # others waiting forever on a real pod
+        pred = local > 0.5
+        return jax.lax.cond(
+            pred, lambda v: jax.lax.pmean(v, "replica"), lambda v: v, local)
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 2, 64), "float32")},
+        param_specs={"b": P("model")},       # (b) no "model" axis exists
+        hbm_bytes_per_device=64 * 1024,      # (c) 64 KiB "budget"
+    )

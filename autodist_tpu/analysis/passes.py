@@ -1,0 +1,547 @@
+"""The verifier's pluggable analysis passes.
+
+Each pass is a function ``(ctx: AnalysisContext) -> list[Finding]``
+registered in :data:`PASS_REGISTRY`.  Static passes (``sharding``,
+``hbm-static``) need only the strategy + model metadata; trace passes
+(``collectives``, ``donation``, ``hbm-traced``) additionally need
+``ctx.jaxpr`` — the deviceless ``ClosedJaxpr`` of the transformed train
+step (the AOT abstract-eval path, so everything runs on CPU in CI).
+
+Finding codes (stable; tests and tools match on them):
+
+  C001 ERROR   cond branches issue different collectives, predicate may
+               vary across devices -> SPMD deadlock
+  C002 INFO    cond branches differ but predicate is replicated (safe)
+  C003 ERROR   while loop with collectives and a possibly-varying
+               predicate -> divergent trip counts deadlock the collective
+  C010 ERROR   ppermute permutation invalid (duplicate source/dest or
+               index out of axis range)
+  C011 WARNING ppermute is not a total permutation cycle
+  C020 ERROR   psum over a sub-32-bit integer wire dtype (accumulator
+               wraps -> silent overflow)
+  C021 WARNING psum over a reduced-precision float wire with a large
+               axis (mantissa exhaustion)
+  S001 ERROR   mesh axis sizes do not multiply to the replica count
+  S002 ERROR   duplicate node config for one variable
+  S003 WARNING node config names a variable absent from the model
+  S004 ERROR   more than one partition axis
+  S005 ERROR   partition axis out of range for the variable's rank
+  S006 WARNING more shards than rows along the partition axis (the pad
+               plan keeps it valid, but whole shards are padding)
+  S007 INFO    partition axis not divisible -> pad plan
+  S008 ERROR   "mesh:<axes>" reduction destination names a missing axis
+  S010 WARNING int8 wire compressor precision/overflow risk
+  S011 ERROR   PartitionSpec names a nonexistent mesh axis
+  S012 ERROR   PartitionSpec uses one mesh axis for two dimensions
+  S013 WARNING sharded dimension not divisible by its mesh axis
+  D001 ERROR   value read (or returned) after an inner jit donated it
+  D002 WARNING donated input has no alias-compatible output (donation
+               is wasted; the buffer counts in full toward HBM)
+  D003 INFO    donated input is never used
+  H001 ERROR   static footprint (params+opt+grads) exceeds the HBM budget
+  H002 ERROR   traced liveness peak exceeds the HBM budget
+  H003 WARNING traced liveness peak above 90% of the HBM budget
+  H004 INFO    footprint summary (cost-model cross-check)
+  T001 ERROR   tracing the strategy's train step failed
+  T002 INFO    trace skipped (trace passes did not run)
+"""
+import numpy as np
+
+from jax import core as jax_core
+
+from autodist_tpu.analysis.jaxpr_utils import (
+    collective_axes, collective_signature, find_shard_map_bodies,
+    liveness_peak_bytes, subjaxprs, varying_out, _as_jaxpr, _read,
+)
+from autodist_tpu.analysis.report import Finding, Severity
+
+# axis size beyond which a bf16/f16 psum has lost every mantissa bit to
+# same-sign accumulation (8 mantissa bits for bf16)
+REDUCED_PRECISION_PSUM_AXIS = 256
+# replica count beyond which int8 requantization of the reduced chunk
+# costs more precision than bf16 would
+INT8_WIRE_REPLICA_WARN = 64
+
+
+def _f(sev, code, pass_name, msg, subject=""):
+    return Finding(Severity(sev), code, pass_name, msg, subject)
+
+
+# ---------------------------------------------------------------------------
+# collective-consistency pass
+# ---------------------------------------------------------------------------
+
+
+def _check_ppermute(eqn, axis_sizes, findings):
+    perm = eqn.params.get("perm") or ()
+    axes = collective_axes(eqn)
+    size = 1
+    for a in axes:
+        size *= int(axis_sizes.get(a, 1))
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    where = f"ppermute over {axes}"
+    if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+        findings.append(_f(
+            Severity.ERROR, "C010", "collectives",
+            f"permutation {tuple(perm)} repeats a source or destination — "
+            f"two peers would send to (or receive from) the same device",
+            where))
+        return
+    bad = [i for i in srcs + dsts if not (0 <= i < size)]
+    if bad:
+        findings.append(_f(
+            Severity.ERROR, "C010", "collectives",
+            f"permutation index(es) {sorted(set(bad))} out of range for "
+            f"axis size {size}", where))
+        return
+    if perm and (set(srcs) != set(range(size)) or set(dsts) != set(range(size))):
+        findings.append(_f(
+            Severity.WARNING, "C011", "collectives",
+            f"permutation {tuple(perm)} is not a total cycle over the "
+            f"{size}-device axis; non-participating devices receive zeros",
+            where))
+
+
+def _check_psum_wire(eqn, axis_sizes, findings):
+    axes = collective_axes(eqn)
+    size = 1
+    for a in axes:
+        size *= int(axis_sizes.get(a, 1))
+    if size <= 1:
+        return
+    for a in eqn.invars:
+        dt = np.dtype(getattr(a.aval, "dtype", np.float32))
+        if dt.kind in "iu" and dt.itemsize < 4:
+            findings.append(_f(
+                Severity.ERROR, "C020", "collectives",
+                f"psum over {axes} accumulates in the {dt.name} wire dtype: "
+                f"summing {size} terms wraps silently — reduce in >=32-bit "
+                f"or use the all_to_all/dequant-sum int8 recipe", str(dt)))
+        elif (dt.kind == "f" and dt.itemsize < 4
+              and size >= REDUCED_PRECISION_PSUM_AXIS):
+            findings.append(_f(
+                Severity.WARNING, "C021", "collectives",
+                f"psum of a {dt.name} wire over {size} devices: same-sign "
+                f"accumulation exhausts the mantissa; accumulate in f32",
+                str(dt)))
+
+
+def _sig_str(sig, limit=160):
+    s = str(sig)
+    return s if len(s) <= limit else s[:limit] + "..."
+
+
+def _walk_collectives(jaxpr, in_varying, axis_sizes, findings, depth=0):
+    """Recursive checker: per-eqn varying-axes env + structural checks."""
+    jaxpr = _as_jaxpr(jaxpr)
+    env, _ = varying_out(jaxpr, in_varying)
+    for eqn in jaxpr.eqns:
+        ins = [_read(env, a) for a in eqn.invars]
+        union = frozenset().union(*ins) if ins else frozenset()
+        name = eqn.primitive.name
+        if name == "ppermute":
+            _check_ppermute(eqn, axis_sizes, findings)
+        elif name == "psum":
+            _check_psum_wire(eqn, axis_sizes, findings)
+        elif name == "cond":
+            sigs = [collective_signature(b) for b in eqn.params["branches"]]
+            if len(set(sigs)) > 1:
+                pred_varying = ins[0]
+                if pred_varying:
+                    findings.append(_f(
+                        Severity.ERROR, "C001", "collectives",
+                        f"cond branches issue different collective "
+                        f"sequences ({' vs '.join(_sig_str(s) for s in sigs)}) "
+                        f"and the predicate may vary across mesh axes "
+                        f"{sorted(pred_varying)}: devices taking different "
+                        f"branches rendezvous on mismatched collectives — "
+                        f"SPMD deadlock", "cond"))
+                else:
+                    findings.append(_f(
+                        Severity.INFO, "C002", "collectives",
+                        "cond branches issue different collectives but the "
+                        "predicate is replicated; every device takes the "
+                        "same branch (e.g. periodic averaging)", "cond"))
+            for b in eqn.params["branches"]:
+                _walk_collectives(b, ins[1:], axis_sizes, findings, depth + 1)
+        elif name == "while":
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            cconsts, bconsts = ins[:cn], ins[cn:cn + bn]
+            carry = list(ins[cn + bn:])
+            for _ in range(16):
+                _, new = varying_out(eqn.params["body_jaxpr"],
+                                     list(bconsts) + carry)
+                merged = [c | n for c, n in zip(carry, new)]
+                if merged == carry:
+                    break
+                carry = merged
+            _, pred_out = varying_out(eqn.params["cond_jaxpr"],
+                                      list(cconsts) + carry)
+            pred_varying = pred_out[0] if pred_out else frozenset()
+            body_sig = collective_signature(eqn.params["body_jaxpr"])
+            cond_sig = collective_signature(eqn.params["cond_jaxpr"])
+            if (body_sig or cond_sig) and pred_varying:
+                findings.append(_f(
+                    Severity.ERROR, "C003", "collectives",
+                    f"while loop contains collectives "
+                    f"({_sig_str(body_sig or cond_sig)}) and its predicate "
+                    f"may vary across mesh axes {sorted(pred_varying)}: "
+                    f"devices disagree on the trip count and hang at the "
+                    f"next collective", "while"))
+            _walk_collectives(eqn.params["body_jaxpr"],
+                              list(bconsts) + carry, axis_sizes, findings,
+                              depth + 1)
+        elif name == "scan":
+            # body invars are (consts, carry, xs-slices); widen the carry
+            # to its fixpoint first — a value that only becomes varying via
+            # the carry after iteration 1 must still flag iteration 2's cond
+            nc, ncar = eqn.params["num_consts"], eqn.params["num_carry"]
+            consts, carry, xs = ins[:nc], list(ins[nc:nc + ncar]), ins[nc + ncar:]
+            body = eqn.params["jaxpr"]
+            for _ in range(16):
+                _, new = varying_out(body, list(consts) + carry + list(xs))
+                merged = [c | n for c, n in zip(carry, new[:ncar])]
+                if merged == carry:
+                    break
+                carry = merged
+            _walk_collectives(body, list(consts) + carry + list(xs),
+                              axis_sizes, findings, depth + 1)
+        else:
+            for sub in subjaxprs(eqn):
+                sub_j = _as_jaxpr(sub)
+                if len(sub_j.invars) == len(ins):
+                    _walk_collectives(sub_j, ins, axis_sizes, findings,
+                                      depth + 1)
+                else:
+                    _walk_collectives(sub_j,
+                                      [union] * len(sub_j.invars),
+                                      axis_sizes, findings, depth + 1)
+
+
+def collectives_pass(ctx):
+    """SPMD deadlock + wire-dtype analysis over every shard_map body."""
+    findings = []
+    if ctx.jaxpr is None:
+        return findings
+    bodies = find_shard_map_bodies(ctx.jaxpr)
+    for body, mesh, in_varying in bodies:
+        sizes = dict(getattr(mesh, "shape", {}) or ctx.axis_sizes)
+        _walk_collectives(body, in_varying, sizes, findings)
+    if not bodies:
+        # no shard_map (e.g. a plain jit function under test): analyze the
+        # top jaxpr with replicated inputs
+        _walk_collectives(ctx.jaxpr,
+                          [frozenset()] * len(_as_jaxpr(ctx.jaxpr).invars),
+                          ctx.axis_sizes, findings)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# sharding / strategy lint pass
+# ---------------------------------------------------------------------------
+
+
+def sharding_pass(ctx):
+    findings = []
+    axis_names = list(ctx.axis_names)
+    axis_sizes = dict(ctx.axis_sizes)
+    R = ctx.num_replicas
+    proto = ctx.strategy.proto
+
+    replicas = list(proto.graph_config.replicas)
+    mesh_prod = 1
+    for s in proto.graph_config.mesh.axis_sizes:
+        mesh_prod *= int(s)
+    if replicas and proto.graph_config.mesh.axis_sizes and \
+            mesh_prod != len(replicas):
+        findings.append(_f(
+            Severity.ERROR, "S001", "sharding",
+            f"mesh {dict(zip(proto.graph_config.mesh.axis_names, proto.graph_config.mesh.axis_sizes))} "
+            f"spans {mesh_prod} devices but the strategy lists "
+            f"{len(replicas)} replicas", "mesh"))
+
+    var_infos = {v.name: v for v in ctx.model_item.var_infos} \
+        if ctx.model_item is not None else {}
+    seen = set()
+    for node in proto.node_config:
+        name = node.var_name
+        if name in seen:
+            findings.append(_f(
+                Severity.ERROR, "S002", "sharding",
+                "duplicate node config: two synchronizers for one variable "
+                "would issue conflicting collectives", name))
+            continue
+        seen.add(name)
+        v = var_infos.get(name)
+        if var_infos and v is None:
+            findings.append(_f(
+                Severity.WARNING, "S003", "sharding",
+                "node config names a variable absent from the model "
+                "(the strategy compiler will prune it)", name))
+            continue
+
+        parts = list(node.partition)
+        active = [i for i, k in enumerate(parts) if k > 1]
+        if len(active) > 1:
+            findings.append(_f(
+                Severity.ERROR, "S004", "sharding",
+                f"partition {parts} is active on {len(active)} axes; only "
+                f"one partition axis is supported", name))
+        elif active and v is not None:
+            ax = active[0]
+            if ax >= len(v.shape):
+                findings.append(_f(
+                    Severity.ERROR, "S005", "sharding",
+                    f"partition axis {ax} out of range for shape "
+                    f"{tuple(v.shape)}", name))
+            else:
+                dim = v.shape[ax]
+                if R > dim:
+                    findings.append(_f(
+                        Severity.WARNING, "S006", "sharding",
+                        f"axis {ax} has {dim} rows but the mesh shards it "
+                        f"{R} ways: the pad plan keeps it valid, but some "
+                        f"devices hold pure-padding (zero-gradient) shards "
+                        f"— prefer replicating variables this small", name))
+                elif dim % R:
+                    padded = -(-dim // R) * R
+                    findings.append(_f(
+                        Severity.INFO, "S007", "sharding",
+                        f"axis {ax} size {dim} not divisible by {R}; pad "
+                        f"plan: padded to {padded} (pad rows carry zero "
+                        f"gradients)", name))
+
+        for src in (node, *node.part_config):
+            which = src.WhichOneof("synchronizer")
+            if which == "PSSynchronizer":
+                dest = src.PSSynchronizer.reduction_destination
+                if dest.startswith("mesh:"):
+                    axes = tuple(a for a in dest[5:].split(",") if a)
+                    missing = [a for a in axes if a not in axis_names]
+                    if missing:
+                        findings.append(_f(
+                            Severity.ERROR, "S008", "sharding",
+                            f"reduction destination {dest!r} names mesh "
+                            f"axis(es) {missing} but the mesh has "
+                            f"{axis_names}", name))
+            elif which == "AllReduceSynchronizer":
+                from autodist_tpu.proto import synchronizers_pb2
+
+                _C = synchronizers_pb2.AllReduceSynchronizer
+                comp = src.AllReduceSynchronizer.compressor
+                if comp in (_C.Int8Compressor, _C.Int8CompressorEF) \
+                        and R >= INT8_WIRE_REPLICA_WARN:
+                    findings.append(_f(
+                        Severity.WARNING, "S010", "sharding",
+                        f"int8 wire over {R} replicas: requantizing the "
+                        f"{R}-way reduced chunk costs ~log2({R}) bits of "
+                        f"the 7-bit mantissa; prefer bf16 at this scale",
+                        name))
+
+    findings.extend(lint_param_specs(ctx.param_specs, axis_names, axis_sizes,
+                                     var_infos))
+    return findings
+
+
+def lint_param_specs(param_specs, axis_names, axis_sizes, var_infos):
+    """Validate user PartitionSpecs against the mesh.  Returns findings;
+    entries producing ERRORs are reported with their pattern as subject so
+    the verifier can drop them before tracing."""
+    findings = []
+    for pat, spec in (param_specs or {}).items():
+        entries = tuple(spec)
+        used = []
+        for d, entry in enumerate(entries):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            for a in names:
+                missing = a not in axis_names
+                if missing:
+                    findings.append(_f(
+                        Severity.ERROR, "S011", "sharding",
+                        f"PartitionSpec {spec} names mesh axis {a!r} but "
+                        f"the mesh axes are {axis_names}", pat))
+                elif a in used:
+                    findings.append(_f(
+                        Severity.ERROR, "S012", "sharding",
+                        f"PartitionSpec {spec} uses mesh axis {a!r} for "
+                        f"two different dimensions", pat))
+                used.append(a)
+        # divisibility of the sharded dims, for exact-name patterns
+        v = var_infos.get(pat)
+        if v is None:
+            continue
+        for d, entry in enumerate(entries[:len(v.shape)]):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            k = 1
+            for a in names:
+                k *= int(axis_sizes.get(a, 1))
+            if k > 1 and v.shape[d] % k:
+                findings.append(_f(
+                    Severity.WARNING, "S013", "sharding",
+                    f"dim {d} (size {v.shape[d]}) is not divisible by the "
+                    f"{k}-way mesh axes {names}", pat))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# donation-safety pass
+# ---------------------------------------------------------------------------
+
+
+def _donation_walk(jaxpr, findings):
+    jaxpr = _as_jaxpr(jaxpr)
+    outvars = set(v for v in jaxpr.outvars if isinstance(v, jax_core.Var))
+    for i, eqn in enumerate(jaxpr.eqns):
+        di = eqn.params.get("donated_invars")
+        if di and any(di):
+            for flag, a in zip(di, eqn.invars):
+                if not flag or not isinstance(a, jax_core.Var):
+                    continue
+                readers = [j for j in range(i + 1, len(jaxpr.eqns))
+                           if a in jaxpr.eqns[j].invars]
+                if readers or a in outvars:
+                    after = (f"eqn #{readers[0]} "
+                             f"({jaxpr.eqns[readers[0]].primitive.name})"
+                             if readers else "the jaxpr outputs")
+                    findings.append(_f(
+                        Severity.ERROR, "D001", "donation",
+                        f"buffer donated to inner call "
+                        f"'{eqn.params.get('name', eqn.primitive.name)}' "
+                        f"(eqn #{i}) is read again by {after}: the donated "
+                        f"buffer may already be overwritten — "
+                        f"use-after-donation", str(a)))
+        for sub in subjaxprs(eqn):
+            _donation_walk(sub, findings)
+
+
+def donation_pass(ctx):
+    findings = []
+    if ctx.jaxpr is None:
+        return findings
+    jaxpr = _as_jaxpr(ctx.jaxpr)
+    _donation_walk(jaxpr, findings)
+
+    donated = ctx.donated_invars or []
+    if not any(donated):
+        return findings
+    used = set()
+    for eqn in jaxpr.eqns:
+        used.update(a for a in eqn.invars if isinstance(a, jax_core.Var))
+    out_slots = {}
+    for v in jaxpr.outvars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            key = (tuple(aval.shape), np.dtype(aval.dtype).str)
+            out_slots[key] = out_slots.get(key, 0) + 1
+    for flag, v in zip(donated, jaxpr.invars):
+        if not flag:
+            continue
+        if v not in used and v not in set(jaxpr.outvars):
+            findings.append(_f(
+                Severity.INFO, "D003", "donation",
+                "donated input is never used; its buffer is freed but the "
+                "donation bought nothing", str(v)))
+            continue
+        key = (tuple(v.aval.shape), np.dtype(v.aval.dtype).str)
+        if out_slots.get(key, 0) > 0:
+            out_slots[key] -= 1
+        else:
+            findings.append(_f(
+                Severity.WARNING, "D002", "donation",
+                f"donated input {v.aval.shape}/{np.dtype(v.aval.dtype).name} "
+                f"has no shape/dtype-compatible output to alias: XLA cannot "
+                f"honor the donation and the buffer counts in full toward "
+                f"HBM", str(v)))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HBM footprint passes
+# ---------------------------------------------------------------------------
+
+
+def _gib(b):
+    for unit, div in (("GiB", 1024 ** 3), ("MiB", 1024 ** 2), ("KiB", 1024)):
+        if b >= div:
+            return f"{b / div:.3f} {unit}"
+    return f"{int(b)} B"
+
+
+def hbm_static_pass(ctx):
+    """Params + optimizer state + gradient footprint from the cost model,
+    cross-checked against the per-chip budget."""
+    from autodist_tpu.simulator.cost_model import hbm_footprint
+
+    findings = []
+    if ctx.model_item is None:
+        return findings
+    fp = hbm_footprint(ctx.strategy, ctx.model_item, ctx.num_replicas,
+                       mesh_axis_sizes=ctx.axis_sizes,
+                       param_specs=ctx.safe_param_specs)
+    ctx.static_footprint = fp
+    budget = ctx.hbm_bytes_per_device
+    summary = (f"static per-chip footprint: params {_gib(fp['param_bytes'])} "
+               f"+ opt {_gib(fp['opt_bytes'])} + grads "
+               f"{_gib(fp['grad_bytes'])} = {_gib(fp['total_bytes'])}"
+               + (f" (budget {_gib(budget)})" if budget else ""))
+    findings.append(_f(Severity.INFO, "H004", "hbm-static", summary))
+    if budget and fp["total_bytes"] > budget:
+        findings.append(_f(
+            Severity.ERROR, "H001", "hbm-static",
+            f"static footprint {_gib(fp['total_bytes'])} exceeds the "
+            f"per-chip HBM budget {_gib(budget)} — the step cannot fit "
+            f"before activations are even counted", "footprint"))
+    return findings
+
+
+def hbm_traced_pass(ctx):
+    """Liveness-based activation peak over the per-device program."""
+    findings = []
+    if ctx.jaxpr is None or not ctx.hbm_bytes_per_device:
+        return findings
+    budget = ctx.hbm_bytes_per_device
+    bodies = find_shard_map_bodies(ctx.jaxpr)
+    if bodies:
+        peak = 0
+        for body, _mesh, _varying in bodies:
+            peak = max(peak, liveness_peak_bytes(body))
+    else:
+        R = max(1, ctx.num_replicas)
+        peak = liveness_peak_bytes(ctx.jaxpr) // R
+    ctx.traced_peak_bytes = peak
+    static_total = (ctx.static_footprint or {}).get("total_bytes", 0)
+    findings.append(_f(
+        Severity.INFO, "H004", "hbm-traced",
+        f"traced per-device liveness peak {_gib(peak)} "
+        f"(static cross-check {_gib(static_total)}, "
+        f"budget {_gib(budget)})"))
+    if peak > budget:
+        findings.append(_f(
+            Severity.ERROR, "H002", "hbm-traced",
+            f"liveness peak {_gib(peak)} exceeds the per-chip HBM budget "
+            f"{_gib(budget)}: the traced step cannot fit", "liveness"))
+    elif peak > 0.9 * budget:
+        findings.append(_f(
+            Severity.WARNING, "H003", "hbm-traced",
+            f"liveness peak {_gib(peak)} is within 10% of the per-chip "
+            f"HBM budget {_gib(budget)}; fragmentation or compiler "
+            f"temporaries may tip it over", "liveness"))
+    return findings
+
+
+PASS_REGISTRY = {
+    "sharding": sharding_pass,
+    "hbm-static": hbm_static_pass,
+    "collectives": collectives_pass,
+    "donation": donation_pass,
+    "hbm-traced": hbm_traced_pass,
+}
+
+STATIC_PASSES = ("sharding", "hbm-static")
+TRACE_PASSES = ("collectives", "donation", "hbm-traced")

@@ -1,0 +1,127 @@
+"""Structured findings for the strategy verifier.
+
+Every analysis pass (:mod:`autodist_tpu.analysis.passes`) produces
+:class:`Finding`s collected into one :class:`Report`.  Findings carry a
+stable short code (``C001``, ``S011``, ``H001``, ...) so tools and tests can
+match classes of problems without parsing prose, a severity, and the
+subject (variable / equation / axis) they attach to.  ERROR findings mean
+the strategy must not run (``raise_for_errors`` /
+:class:`StrategyVerificationError`); WARNINGs are risks worth a look;
+INFOs are observations (e.g. a pad plan) that need no action.
+"""
+import dataclasses
+import enum
+import json
+from typing import List, Optional
+
+
+class Severity(enum.IntEnum):
+    """Ordered so ``max(severities)`` is the report's overall level."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One verdict from one pass about one subject."""
+
+    severity: Severity
+    code: str            # stable id, e.g. "C001"
+    pass_name: str       # which pass produced it, e.g. "collectives"
+    message: str
+    subject: str = ""    # var name / axis / eqn description, when applicable
+
+    def __str__(self):
+        where = f" [{self.subject}]" if self.subject else ""
+        return f"{self.severity:<7} {self.code} ({self.pass_name}){where}: " \
+               f"{self.message}"
+
+    def to_json(self):
+        return {"severity": str(self.severity), "code": self.code,
+                "pass": self.pass_name, "subject": self.subject,
+                "message": self.message}
+
+
+class Report:
+    """Severity-ranked collection of findings for one strategy."""
+
+    def __init__(self, strategy_id: str = "", findings: Optional[List[Finding]] = None):
+        self.strategy_id = strategy_id
+        self.findings: List[Finding] = list(findings or [])
+
+    # -- accumulation ------------------------------------------------------
+
+    def add(self, severity, code, pass_name, message, subject=""):
+        self.findings.append(Finding(Severity(severity), code, pass_name,
+                                     message, subject))
+
+    def extend(self, findings):
+        self.findings.extend(findings)
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == Severity.ERROR]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    @property
+    def ok(self):
+        """True when the strategy may run (no ERROR findings)."""
+        return not self.errors
+
+    def by_code(self, code):
+        return [f for f in self.findings if f.code == code]
+
+    def error_codes(self):
+        """Distinct ERROR codes, in first-appearance order."""
+        seen = []
+        for f in self.errors:
+            if f.code not in seen:
+                seen.append(f.code)
+        return seen
+
+    def raise_for_errors(self):
+        if not self.ok:
+            raise StrategyVerificationError(self)
+
+    # -- rendering ---------------------------------------------------------
+
+    def sorted_findings(self):
+        """Most severe first; stable within a severity."""
+        return sorted(self.findings, key=lambda f: -int(f.severity))
+
+    def __str__(self):
+        head = (f"Strategy {self.strategy_id or '<unnamed>'}: "
+                f"{len(self.errors)} error(s), {len(self.warnings)} "
+                f"warning(s), {len(self.findings)} finding(s)")
+        lines = [head] + [f"  {f}" for f in self.sorted_findings()]
+        return "\n".join(lines)
+
+    def to_json(self):
+        return {"strategy_id": self.strategy_id,
+                "ok": self.ok,
+                "error_codes": self.error_codes(),
+                "findings": [f.to_json() for f in self.sorted_findings()]}
+
+    def dump(self, path):
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2)
+        return path
+
+
+class StrategyVerificationError(ValueError):
+    """Raised when a verified strategy has ERROR-level findings; carries
+    the full :class:`Report` as ``.report``."""
+
+    def __init__(self, report: Report):
+        self.report = report
+        super().__init__(str(report))

@@ -1,0 +1,248 @@
+"""verify_strategy: the static strategy verifier entry point.
+
+Runs the registered analysis passes (:mod:`autodist_tpu.analysis.passes`)
+against a (strategy, model, resources) triple and returns a
+severity-ranked :class:`~autodist_tpu.analysis.report.Report`:
+
+1. **static passes** — sharding/strategy lint + static HBM footprint —
+   need no devices and no tracing;
+2. **trace passes** — collective consistency, donation safety, liveness
+   HBM peak — run over the ``ClosedJaxpr`` of the transformed train step,
+   traced devicelessly via the AOT abstract-eval path
+   (:meth:`GraphTransformer.trace_step`), so a CPU-only CI host verifies
+   the exact SPMD program a pod would run.
+
+``param_specs`` entries that fail the lint (nonexistent axis, duplicate
+axis) are REPORTED and then dropped for the trace, so one broken spec
+does not mask every other finding behind a trace error.
+"""
+import dataclasses
+from typing import Any, Dict, Optional
+
+from autodist_tpu.analysis.passes import (PASS_REGISTRY, STATIC_PASSES,
+                                          TRACE_PASSES)
+from autodist_tpu.analysis.report import Report, Severity
+from autodist_tpu.utils import logging
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Everything a pass may consult.  Trace fields stay ``None`` until
+    (unless) the step is traced."""
+
+    strategy: Any
+    model_item: Any = None
+    resource_spec: Any = None
+    num_replicas: int = 1
+    axis_names: tuple = ("replica",)
+    axis_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    param_specs: Optional[dict] = None
+    safe_param_specs: Optional[dict] = None   # lint-approved subset
+    batch_shapes: Any = None
+    donate: bool = True
+    hbm_bytes_per_device: Optional[int] = None
+    transformer_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # filled by tracing / passes
+    traced: Any = None
+    jaxpr: Any = None
+    donated_invars: Any = None
+    static_footprint: Optional[dict] = None
+    traced_peak_bytes: Optional[int] = None
+
+
+def _mesh_info(strategy, resource_spec, mesh):
+    """(axis_names, axis_sizes, num_replicas) from the best source."""
+    if mesh is not None:
+        sizes = dict(mesh.shape)
+        names = tuple(mesh.axis_names)
+        R = 1
+        for s in sizes.values():
+            R *= int(s)
+        return names, sizes, R
+    gm = strategy.proto.graph_config.mesh
+    if gm.axis_names:
+        names = tuple(gm.axis_names)
+        sizes = {a: int(s) for a, s in zip(gm.axis_names, gm.axis_sizes)}
+        R = 1
+        for s in sizes.values():
+            R *= int(s)
+        return names, sizes, max(1, R)
+    if resource_spec is not None:
+        R = max(1, resource_spec.num_accelerators)
+        req = resource_spec.mesh_request
+        if req:
+            return tuple(req), {a: int(s) for a, s in req.items()}, R
+        return ("replica",), {"replica": R}, R
+    return ("replica",), {"replica": 1}, 1
+
+
+def _drop_bad_specs(param_specs, findings):
+    """Remove param_specs entries with ERROR findings so tracing can run."""
+    if not param_specs:
+        return param_specs
+    bad = {f.subject for f in findings
+           if f.severity == Severity.ERROR and f.code in ("S011", "S012")}
+    return {k: v for k, v in param_specs.items() if k not in bad}
+
+
+def _build_transformer(ctx, mesh, report):
+    """Build the GraphTransformer on a concrete (CPU) mesh; failures
+    become findings rather than exceptions."""
+    import jax
+
+    from autodist_tpu.kernel.graph_transformer import GraphTransformer
+
+    if mesh is None:
+        devices = jax.devices()
+        if len(devices) < ctx.num_replicas:
+            report.add(Severity.INFO, "T002", "trace",
+                       f"trace skipped: mesh needs {ctx.num_replicas} "
+                       f"devices, process has {len(devices)} — trace "
+                       f"passes did not run")
+            return None
+        import numpy as np
+        from jax.sharding import Mesh
+
+        shape = tuple(int(ctx.axis_sizes[a]) for a in ctx.axis_names)
+        mesh = Mesh(np.array(devices[:ctx.num_replicas]).reshape(shape),
+                    ctx.axis_names)
+    try:
+        return GraphTransformer(ctx.strategy, ctx.model_item, mesh,
+                                param_specs=ctx.safe_param_specs or None,
+                                **ctx.transformer_kwargs)
+    except Exception as e:
+        report.add(Severity.ERROR, "T001", "trace",
+                   f"building the graph transformer failed: "
+                   f"{type(e).__name__}: {e}")
+        return None
+
+
+def _run_trace(ctx, report, transformer, rng):
+    """Trace the step devicelessly (the AOT abstract-eval path); any
+    failure becomes a T001 ERROR finding rather than an exception."""
+    import jax
+
+    try:
+        state_avals = transformer.abstract_state(rng=rng)
+        traced = transformer.trace_step(ctx.batch_shapes, donate=ctx.donate,
+                                        rng=rng, state_avals=state_avals)
+    except Exception as e:  # surface as a finding, not a crash
+        report.add(Severity.ERROR, "T001", "trace",
+                   f"tracing the train step failed: {type(e).__name__}: {e}")
+        return None
+    attach_traced(ctx, traced, n_state_leaves=len(jax.tree.leaves(state_avals)))
+    return traced
+
+
+def attach_traced(ctx, traced, n_state_leaves):
+    """Record a ``jax.stages.Traced`` step (and its donation mask: the
+    first ``n_state_leaves`` flattened args are the donated state) so the
+    trace passes can run against it."""
+    ctx.traced = traced
+    ctx.jaxpr = traced.jaxpr
+    n_in = len(ctx.jaxpr.jaxpr.invars)
+    ctx.donated_invars = [ctx.donate and i < n_state_leaves
+                          for i in range(n_in)]
+
+
+def verify_transformer(transformer, batch_shapes, *, donate=True,
+                       hbm_bytes_per_device=None, rng=None,
+                       passes=None) -> Report:
+    """Verify an already-built :class:`GraphTransformer` (the engine's
+    in-session entry: the runner's ``verify=`` knob and ``aot_compile``
+    reuse the transformer they already hold instead of rebuilding one)."""
+    ctx = AnalysisContext(
+        strategy=transformer.strategy, model_item=transformer.model_item,
+        num_replicas=transformer.num_replicas,
+        axis_names=tuple(transformer.mesh.axis_names),
+        axis_sizes=dict(transformer.mesh.shape),
+        batch_shapes=batch_shapes, donate=donate,
+        hbm_bytes_per_device=hbm_bytes_per_device)
+    report = Report(strategy_id=getattr(transformer.strategy, "id", ""))
+    selected = tuple(passes) if passes is not None else \
+        STATIC_PASSES + TRACE_PASSES
+    for name in selected:
+        if name in STATIC_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
+    trace_selected = [p for p in selected if p in TRACE_PASSES]
+    if trace_selected:
+        _run_trace(ctx, report, transformer, rng)
+        for name in trace_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+    return report
+
+
+def verify_strategy(strategy, model_item=None, resource_spec=None, *,
+                    mesh=None, batch_shapes=None, param_specs=None,
+                    donate=True, hbm_bytes_per_device=None, passes=None,
+                    rng=None, **transformer_kwargs) -> Report:
+    """Statically verify a strategy before any compile.
+
+    Args:
+      strategy: a :class:`~autodist_tpu.strategy.base.Strategy` (raw or
+        compiled).
+      model_item: the captured :class:`ModelItem` (required for every pass
+        beyond the bare mesh lint).
+      resource_spec / mesh: sizing; the strategy's own ``graph_config.mesh``
+        is used when neither pins it.
+      batch_shapes: ``(shape, dtype)`` pytree of one global batch — enables
+        the trace passes (collectives / donation / liveness HBM).  ``None``
+        runs the static passes only.
+      param_specs: optional user PartitionSpecs (tensor parallelism) to
+        lint; ERROR-level entries are dropped before tracing.
+      hbm_bytes_per_device: per-chip budget for the HBM passes (e.g.
+        ``aot.HBM_BY_DEVICE_KIND["TPU v5 lite"]``); ``None`` skips the
+        budget comparison but still reports the footprint.
+      passes: iterable of pass names to run (default: all applicable).
+      transformer_kwargs: forwarded to :class:`GraphTransformer`
+        (``data_axes``, ``batch_spec``, ``accum_steps``, ...).
+
+    Returns a :class:`Report`; call ``report.raise_for_errors()`` to turn
+    ERROR findings into :class:`StrategyVerificationError`.
+    """
+    axis_names, axis_sizes, R = _mesh_info(strategy, resource_spec, mesh)
+    ctx = AnalysisContext(
+        strategy=strategy, model_item=model_item,
+        resource_spec=resource_spec, num_replicas=R,
+        axis_names=axis_names, axis_sizes=axis_sizes,
+        param_specs=param_specs, batch_shapes=batch_shapes, donate=donate,
+        hbm_bytes_per_device=hbm_bytes_per_device,
+        transformer_kwargs=transformer_kwargs)
+    report = Report(strategy_id=getattr(strategy, "id", ""))
+
+    selected = tuple(passes) if passes is not None else \
+        STATIC_PASSES + TRACE_PASSES
+    unknown = [p for p in selected if p not in PASS_REGISTRY]
+    if unknown:
+        raise ValueError(f"Unknown analysis pass(es) {unknown}; "
+                         f"registered: {sorted(PASS_REGISTRY)}")
+
+    for name in selected:
+        if name not in STATIC_PASSES:
+            continue
+        if name == "hbm-static" and model_item is None:
+            continue
+        report.extend(PASS_REGISTRY[name](ctx))
+        if name == "sharding":
+            ctx.safe_param_specs = _drop_bad_specs(param_specs,
+                                                   report.findings)
+    if ctx.safe_param_specs is None:
+        ctx.safe_param_specs = param_specs
+
+    trace_selected = [p for p in selected if p in TRACE_PASSES]
+    if trace_selected:
+        if batch_shapes is None or model_item is None:
+            report.add(Severity.INFO, "T002", "trace",
+                       "trace skipped: no batch_shapes/model given — trace "
+                       "passes did not run")
+        else:
+            t = _build_transformer(ctx, mesh, report)
+            if t is not None:
+                _run_trace(ctx, report, t, rng)
+        for name in trace_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+
+    logging.debug("verify_strategy(%s): %d findings (%d errors)",
+                  report.strategy_id, len(report.findings),
+                  len(report.errors))
+    return report

@@ -199,6 +199,7 @@ def aot_compile_step(
     mutable_state=None,
     rng=None,
     hbm_bytes_per_device: Optional[int] = None,
+    verify: bool = False,
     **transformer_kwargs,
 ) -> AOTCompiledStep:
     """Build the engine exactly as ``distribute()`` does, then compile the
@@ -208,9 +209,14 @@ def aot_compile_step(
     batch (or a bare ``(shape, dtype)`` tuple for array batches).
     ``mesh_axes``: axis names for the topology mesh; default is the
     resource spec's mesh request (or a 1-D "replica" mesh).
+
+    ``verify=True`` runs the static strategy verifier
+    (:mod:`autodist_tpu.analysis`) over the traced step — with the target
+    generation's HBM budget — and raises ``StrategyVerificationError``
+    BEFORE the (minutes-long) Mosaic/XLA:TPU compile is attempted.
     """
     import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
 
     from autodist_tpu.kernel.graph_transformer import GraphTransformer
     from autodist_tpu.model_item import ModelItem
@@ -245,23 +251,44 @@ def aot_compile_step(
     mesh = Mesh(np.array(topo.devices[:n]).reshape(shape), mesh_axes)
     t = GraphTransformer(strategy, item, mesh, **transformer_kwargs)
 
-    bspec = tuple(t.batch_spec)
+    kind = getattr(topo.devices[0], "device_kind", "?")
+    hbm = hbm_bytes_per_device
+    if hbm is None:
+        hbm = HBM_BY_DEVICE_KIND.get(kind)
+        if hbm is None:
+            hbm = 16 * 1024 ** 3
+            logging.warning(
+                "Unknown device kind %r — fits_hbm() assumes 16 GiB; pass "
+                "hbm_bytes_per_device to override", kind)
 
-    def to_aval(leaf):
-        shp, dt = leaf
-        spec = P(*bspec[:len(shp)])
-        return jax.ShapeDtypeStruct(tuple(shp), dt,
-                                    sharding=NamedSharding(mesh, spec))
-
-    batch_avals = jax.tree.map(
-        to_aval, batch_shapes,
-        is_leaf=lambda x: (isinstance(x, tuple) and len(x) == 2
-                           and isinstance(x[0], (tuple, list))))
     state_avals = t.abstract_state(rng=rng)
-    step = t.make_train_step(donate=donate)
     with force_on_tpu_selection():
-        lowered = step.trace(state_avals, batch_avals).lower(
-            lowering_platforms=("tpu",))
+        traced = t.trace_step(batch_shapes, donate=donate, rng=rng,
+                              state_avals=state_avals)
+    if verify:
+        # static verification of the traced program against the TARGET
+        # generation's HBM budget; an infeasible strategy raises here,
+        # before the minutes-long TPU compile
+        from autodist_tpu.analysis.passes import (PASS_REGISTRY,
+                                                  STATIC_PASSES,
+                                                  TRACE_PASSES)
+        from autodist_tpu.analysis.report import Report
+        from autodist_tpu.analysis.verify import (AnalysisContext,
+                                                  attach_traced)
+
+        ctx = AnalysisContext(
+            strategy=strategy, model_item=item,
+            num_replicas=t.num_replicas,
+            axis_names=tuple(mesh.axis_names), axis_sizes=dict(mesh.shape),
+            donate=donate, hbm_bytes_per_device=hbm)
+        attach_traced(ctx, traced,
+                      n_state_leaves=len(jax.tree.leaves(state_avals)))
+        report = Report(strategy_id=strategy.id)
+        for pass_name in STATIC_PASSES + TRACE_PASSES:
+            report.extend(PASS_REGISTRY[pass_name](ctx))
+        logging.info("AOT strategy verification:\n%s", report)
+        report.raise_for_errors()
+    lowered = traced.lower(lowering_platforms=("tpu",))
     # overlap schedule: the deviceless compile gets the same latency-
     # hiding-scheduler + combine-threshold flags the on-chip runner uses
     # (the compile TARGETS tpu even though the process backend is cpu, so
@@ -272,15 +299,6 @@ def aot_compile_step(
 
     opts = compiler_options_for(t.sync_schedule, backend="tpu")
     exe, _applied = compile_lowered(lowered, opts)
-    kind = getattr(topo.devices[0], "device_kind", "?")
-    hbm = hbm_bytes_per_device
-    if hbm is None:
-        hbm = HBM_BY_DEVICE_KIND.get(kind)
-        if hbm is None:
-            hbm = 16 * 1024 ** 3
-            logging.warning(
-                "Unknown device kind %r — fits_hbm() assumes 16 GiB; pass "
-                "hbm_bytes_per_device to override", kind)
     logging.info("AOT-compiled step for %s (%d x %s)", topology, n, kind)
     return AOTCompiledStep(
         topology=topology, n_devices=n, device_kind=kind,

@@ -129,8 +129,18 @@ class AutoDist:
         param_specs=None,
         batch_mask: bool = False,
         sync_schedule: Optional[str] = None,
+        verify: bool = False,
     ):
         """Capture single-device code and return a distributed session.
+
+        ``verify=True`` runs the static strategy verifier
+        (:mod:`autodist_tpu.analysis`, docs/analysis.md): the strategy and
+        sharding lint runs immediately (build time), and the first
+        ``run()`` abstractly re-traces the step against the real batch
+        shapes to check collective consistency, donation safety and the
+        HBM liveness peak — raising
+        :class:`~autodist_tpu.analysis.StrategyVerificationError` on
+        ERROR-level findings instead of hanging a pod.
 
         ``remat=True`` wraps the loss in ``jax.checkpoint`` — trade FLOPs
         for HBM by rematerializing activations in the backward pass.
@@ -158,12 +168,13 @@ class AutoDist:
         raw = self._build_or_load_strategy(item)
         return self._assemble_session(
             item, raw, rng=rng, donate=donate, batch_mask=batch_mask,
-            data_axes=data_axes, batch_spec=batch_spec,
+            verify=verify, data_axes=data_axes, batch_spec=batch_spec,
             accum_steps=accum_steps, clip_global_norm=clip_global_norm,
             param_specs=param_specs, sync_schedule=sync_schedule)
 
     def _assemble_session(self, item, raw, *, rng, donate, batch_mask,
-                          async_authkey=None, **transformer_kwargs):
+                          async_authkey=None, verify=False,
+                          **transformer_kwargs):
         """Shared tail of :meth:`distribute` and :meth:`launch`: verify
         cross-host agreement, compile, transform, wrap in a session."""
         from autodist_tpu.kernel.graph_transformer import GraphTransformer
@@ -182,6 +193,7 @@ class AutoDist:
             unsupported = {
                 k: v for k, v in dict(
                     batch_mask=batch_mask or None, rng=rng,
+                    verify=verify or None,
                     **{kk: vv for kk, vv in transformer_kwargs.items()
                        if vv is not None
                        and not (kk == "accum_steps" and vv == 1)},
@@ -215,10 +227,21 @@ class AutoDist:
                 AsyncPSEngineSession)
 
             return AsyncPSEngineSession(strategy, item)
+        if verify:
+            # build-time half of the verifier: strategy/sharding lint +
+            # static HBM terms fail FAST (the traced passes run on the
+            # session's first step, when batch shapes are known)
+            from autodist_tpu.analysis import STATIC_PASSES, verify_strategy
+
+            report = verify_strategy(
+                strategy, item, self._resource_spec,
+                param_specs=transformer_kwargs.get("param_specs"),
+                passes=STATIC_PASSES)
+            report.raise_for_errors()
         transformer = GraphTransformer(strategy, item, self.mesh,
                                        **transformer_kwargs)
         return DistributedSession(transformer, rng=rng, donate=donate,
-                                  batch_mask=batch_mask)
+                                  batch_mask=batch_mask, verify=verify)
 
     # parity alias with the reference's create_distributed_session
     create_distributed_session = distribute

@@ -372,6 +372,36 @@ class GraphTransformer:
             "rng": replicated(rng_shapes),
         }
 
+    def batch_avals(self, batch_shapes):
+        """``(shape, dtype)`` pytree -> abstract global batch with the
+        engine's sharding (``batch_spec`` prefix per leaf rank), for
+        deviceless tracing.  A bare ``(shape, dtype)`` tuple describes an
+        array batch."""
+        bspec = tuple(self.batch_spec)
+
+        def to_aval(leaf):
+            shp, dt = leaf
+            spec = P(*bspec[:len(shp)])
+            return jax.ShapeDtypeStruct(
+                tuple(shp), dt, sharding=NamedSharding(self.mesh, spec))
+
+        return jax.tree.map(
+            to_aval, batch_shapes,
+            is_leaf=lambda x: (isinstance(x, tuple) and len(x) == 2
+                               and isinstance(x[0], (tuple, list))))
+
+    def trace_step(self, batch_shapes, donate=True, rng=None,
+                   state_avals=None):
+        """Abstractly trace the train step: no devices touched, nothing
+        compiled.  The shared AOT abstract-eval path — ``aot.py`` lowers
+        the result for a TPU topology, the strategy verifier
+        (:mod:`autodist_tpu.analysis`) walks its ``.jaxpr``, and both see
+        the exact SPMD program ``make_train_step`` would run."""
+        if state_avals is None:
+            state_avals = self.abstract_state(rng=rng)
+        step = self.make_train_step(donate=donate)
+        return step.trace(state_avals, self.batch_avals(batch_shapes))
+
     def init_state(self, params=None, rng=None):
         """Build the global, correctly-sharded DistributedState dict."""
         params = self.model_item.params if params is None else params

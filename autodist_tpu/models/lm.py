@@ -43,8 +43,6 @@ class LSTMBody(nn.Module):
         for i in range(c.num_layers):
             cell = nn.OptimizedLSTMCell(c.hidden_dim, dtype=c.dtype,
                                         name=f"lstm_{i}")
-            B = x.shape[0]
-            carry = cell.initialize_carry(jax.random.PRNGKey(0), (B, x.shape[-1]))
             scan = nn.RNN(cell, name=f"rnn_{i}")
             x = scan(x)
         logits = nn.Dense(c.vocab_size, dtype=jnp.float32, name="softmax")(x)

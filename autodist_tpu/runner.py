@@ -20,7 +20,8 @@ from autodist_tpu.utils import logging
 
 
 class DistributedSession:
-    def __init__(self, transformer, rng=None, donate=True, batch_mask=False):
+    def __init__(self, transformer, rng=None, donate=True, batch_mask=False,
+                 verify=False, hbm_bytes_per_device=None):
         self._t = transformer
         self._mesh = transformer.mesh
         self._axis = transformer.axis
@@ -47,6 +48,15 @@ class DistributedSession:
         self._batch_mask = batch_mask
         self._warned_uneven = False
         self._dumped_artifacts = False
+        # opt-in static verification (docs/analysis.md): the first run()
+        # re-traces the step abstractly — batch shapes are only known then
+        # — and raises StrategyVerificationError on ERROR-level findings
+        # BEFORE the step executes (a deadlocking collective would hang a
+        # pod, not raise)
+        self._verify = verify
+        self._verify_budget = hbm_bytes_per_device
+        self._donate = donate
+        self._verified = False
 
     # -- feeds (reference remapper._remap_feed analog) ---------------------
 
@@ -212,9 +222,40 @@ class DistributedSession:
 
     # -- steady-state step (reference WrappedSession.run) ------------------
 
+    def verify(self, batch, hbm_bytes_per_device=None, raise_on_error=True):
+        """Statically verify the session's program against this batch's
+        shapes (collective consistency, donation safety, HBM liveness —
+        :mod:`autodist_tpu.analysis`).  Returns the Report; with
+        ``raise_on_error`` ERROR findings raise StrategyVerificationError.
+        """
+        return self._verify_gbatch(self._shard_batch(batch),
+                                   hbm_bytes_per_device=hbm_bytes_per_device,
+                                   raise_on_error=raise_on_error)
+
+    def _verify_gbatch(self, gbatch, hbm_bytes_per_device=None,
+                       raise_on_error=True):
+        from autodist_tpu.analysis import verify_transformer
+
+        batch_shapes = jax.tree.map(
+            lambda x: (tuple(x.shape), x.dtype), gbatch)
+        report = verify_transformer(
+            self._t, batch_shapes, donate=self._donate,
+            hbm_bytes_per_device=(hbm_bytes_per_device
+                                  or self._verify_budget))
+        if report.findings:
+            logging.info("Strategy verification:\n%s", report)
+        if raise_on_error:
+            report.raise_for_errors()
+        return report
+
     def run(self, batch, trace_dir=None):
         """One training step on a global batch; returns metrics dict."""
         gbatch = self._shard_batch(batch)
+        if self._verify and not self._verified:
+            # first step: abstractly re-trace and verify against this
+            # batch's shapes before anything executes
+            self._verified = True
+            self._verify_gbatch(gbatch)
         if not self._dumped_artifacts:
             # 4-stage program-evolution dump (no-op unless
             # AUTODIST_DUMP_HLO): plan -> StableHLO -> optimized HLO ->

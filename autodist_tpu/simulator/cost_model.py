@@ -290,6 +290,145 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         schedule="overlap" if ar_overlap else "barrier")
 
 
+class _FracBox:
+    """Opaque leaf carrying (expected update-space shape, per-chip
+    fraction) through ``optax.tree_map_params`` (see
+    ``graph_transformer._SpecBox``)."""
+
+    __slots__ = ("shape", "frac")
+
+    def __init__(self, shape, frac):
+        self.shape = shape
+        self.frac = frac
+
+
+def hbm_footprint(strategy, model_item, num_replicas, *,
+                  mesh_axis_sizes=None, param_specs=None, opt_slots=2):
+    """Static per-chip HBM demand of realizing ``strategy`` (bytes).
+
+    The memory counterpart of :func:`estimate`'s time terms, and the
+    cross-check the analysis subsystem's HBM pass
+    (``autodist_tpu/analysis``) compares its traced liveness peak against:
+
+    - ``param_bytes``: storage per chip — replicated/PS vars keep a full
+      (gathered) copy everywhere; SHARDED storage holds 1/R of the padded
+      axis; DIVERGENT keeps one full local copy; CUSTOM divides by the
+      product of its spec's mesh axes (``mesh_axis_sizes``).
+    - ``opt_bytes``: optimizer state mirrors the *update space* — 1/R for
+      weight-update-sharded (sync PS) and SHARDED plans, full otherwise.
+      Computed from the real optimizer via ``eval_shape`` when the
+      ModelItem carries one (scalar statistics count once, replicated);
+      otherwise ``opt_slots`` update-space copies (adam-class default 2).
+    - ``grad_bytes``: the transient full-gradient tree the backward pass
+      materializes before scatter/reduce (conservative: counted in full).
+
+    Activations are deliberately absent — they depend on the traced
+    program and are measured by the liveness pass.
+    """
+    import jax
+
+    R = max(1, num_replicas)
+    plans = build_var_plans(strategy, model_item, R, param_specs=param_specs)
+
+    def custom_frac(plan):
+        if plan.custom_spec is None or not mesh_axis_sizes:
+            return 1.0
+        k = 1
+        for entry in tuple(plan.custom_spec):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            for a in names:
+                k *= int(mesh_axis_sizes.get(a, 1))
+        return 1.0 / max(1, k)
+
+    param_bytes = grad_bytes = 0.0
+    u_frac = {}    # name -> per-chip fraction of the update space
+    for v in model_item.var_infos:
+        plan = plans.get(v.name)
+        if plan is None:
+            continue
+        nbytes = v.byte_size
+        if plan.placement == Placement.SHARDED:
+            dim = max(1, v.shape[plan.partition_axis])
+            padded = nbytes * (plan.padded_dim / dim)
+            param_bytes += padded / R
+            grad_bytes += nbytes
+            u_frac[v.name] = 1.0 / R
+        elif plan.placement == Placement.DIVERGENT:
+            param_bytes += nbytes
+            grad_bytes += nbytes
+            # update space is the (R, *shape) stack sharded over the axis:
+            # per chip that is one full local copy, i.e. 1/R of the stack
+            u_frac[v.name] = 1.0 / R
+        elif plan.placement == Placement.CUSTOM:
+            f = custom_frac(plan)
+            param_bytes += nbytes * f
+            grad_bytes += nbytes * f
+            u_frac[v.name] = f
+        elif plan.sync == SyncKind.PS and plan.ps_sync:
+            param_bytes += nbytes    # gathered copy lives on every chip
+            grad_bytes += nbytes
+            u_frac[v.name] = 1.0 / R
+        else:                        # replicated AR / async PS
+            param_bytes += nbytes
+            grad_bytes += nbytes
+            u_frac[v.name] = 1.0
+
+    import numpy as _np
+
+    from autodist_tpu.kernel.partitioner import update_space_shape
+
+    def u_bytes(v):
+        shp = update_space_shape(plans[v.name], R)
+        return float(_np.prod(shp)) * _np.dtype(v.dtype).itemsize \
+            if shp else _np.dtype(v.dtype).itemsize
+
+    opt = model_item.optimizer
+    if opt is None:
+        opt_bytes = opt_slots * sum(
+            u_bytes(v) * u_frac[v.name]
+            for v in model_item.var_infos if v.name in u_frac)
+    else:
+        import optax
+
+        from autodist_tpu.model_item import path_name
+
+        leaves = jax.tree_util.tree_leaves_with_path(model_item.params)
+        treedef = jax.tree_util.tree_structure(model_item.params)
+        names = [path_name(p) for p, _ in leaves]
+        u_avals = treedef.unflatten([
+            jax.ShapeDtypeStruct(
+                update_space_shape(plans[n], R) if n in plans else l.shape,
+                _np.dtype(l.dtype))
+            for n, (_, l) in zip(names, leaves)])
+        opt_shapes = jax.eval_shape(opt.init, u_avals)
+        boxes = treedef.unflatten([
+            _FracBox(update_space_shape(plans[n], R) if n in plans else None,
+                     u_frac.get(n, 1.0))
+            for n in names])
+        boxed_state = optax.tree_map_params(
+            opt, lambda _leaf, box: box, opt_shapes, boxes,
+            transform_non_params=lambda _leaf: _FracBox(None, 1.0),
+            is_leaf=lambda x: isinstance(x, _FracBox))
+        opt_bytes = 0.0
+        for leaf, box in zip(jax.tree.leaves(opt_shapes),
+                             jax.tree.leaves(
+                                 boxed_state,
+                                 is_leaf=lambda x: isinstance(x, _FracBox))):
+            nbytes = float(_np.prod(leaf.shape)) * _np.dtype(leaf.dtype).itemsize \
+                if leaf.shape else _np.dtype(leaf.dtype).itemsize
+            frac = box.frac if (box.shape is not None
+                                and tuple(leaf.shape) == tuple(box.shape)) \
+                else 1.0
+            opt_bytes += nbytes * frac
+
+    total = param_bytes + opt_bytes + grad_bytes
+    return {"param_bytes": param_bytes, "opt_bytes": opt_bytes,
+            "grad_bytes": grad_bytes, "total_bytes": total,
+            "num_replicas": R}
+
+
 def rank_strategies(builders, model_item, resource_spec, calibration=None, **kw):
     """Rank candidate builders by estimated step time (cheapest first);
     with ``calibration`` (from :func:`calibrate`) the measured-corrected

@@ -2,8 +2,10 @@
 
 The reference's "automatic strategy optimization" pipeline (AutoSync) lives
 outside its repo (``docs/design/rationale.rst``); this in-repo version
-closes the loop analytically: enumerate the builder space, rank with the
-cost model, build with the winner.
+closes the loop analytically: enumerate the builder space, screen out
+statically-infeasible candidates with the strategy verifier
+(:mod:`autodist_tpu.analysis` — a candidate the verifier rejects is never
+ranked), rank the survivors with the cost model, build with the winner.
 """
 from autodist_tpu.strategy.base import Strategy, StrategyBuilder
 from autodist_tpu.utils import logging
@@ -32,14 +34,25 @@ def default_candidates():
 
 class AutoStrategy(StrategyBuilder):
     def __init__(self, candidates=None, flops_per_example=0.0,
-                 batch_per_chip=32, calibration=None):
+                 batch_per_chip=32, calibration=None, verify=True,
+                 hbm_bytes_per_device=None):
         """``calibration``: a dict from :func:`simulator.cost_model.calibrate`
         or a path to a benchmark sweep summary JSON (``examples/benchmark.py
         --strategies ... --records_dir``) — grounds the analytic ranking in
-        measured step times (the AutoSync loop)."""
+        measured step times (the AutoSync loop).
+
+        ``verify`` (default on) screens every candidate with the static
+        verifier passes (sharding lint + HBM footprint) BEFORE ranking;
+        rejected candidates are recorded in ``last_rejected`` and never
+        ranked.  ``hbm_bytes_per_device`` supplies the per-chip budget for
+        the feasibility check (e.g. ``aot.HBM_BY_DEVICE_KIND["TPU v5
+        lite"]``); ``None`` skips the budget comparison but keeps the lint.
+        """
         self._candidates = candidates
         self._flops = flops_per_example
         self._batch = batch_per_chip
+        self._verify = verify
+        self._hbm_budget = hbm_bytes_per_device
         if isinstance(calibration, str):
             import json
 
@@ -55,11 +68,43 @@ class AutoStrategy(StrategyBuilder):
                     f"cost_model.calibrate() dict")
         self._calibration = calibration
         self.last_ranking = None
+        self.last_rejected = None
+
+    def _screen(self, cands, model_item, resource_spec):
+        """Verifier feasibility gate: (feasible builders, rejected list)."""
+        from autodist_tpu.analysis import STATIC_PASSES, verify_strategy
+
+        feasible, rejected = [], []
+        for b in cands:
+            s = b.build(model_item, resource_spec)
+            report = verify_strategy(
+                s, model_item, resource_spec,
+                hbm_bytes_per_device=self._hbm_budget,
+                passes=STATIC_PASSES)
+            if report.ok:
+                feasible.append(b)
+            else:
+                rejected.append((type(b).__name__, report))
+                logging.warning(
+                    "AutoStrategy: rejecting infeasible candidate %s: %s",
+                    type(b).__name__,
+                    "; ".join(f.message for f in report.errors))
+        return feasible, rejected
 
     def build(self, model_item, resource_spec) -> Strategy:
         from autodist_tpu.simulator.cost_model import rank_strategies
 
         cands = self._candidates or default_candidates()
+        if self._verify:
+            cands, self.last_rejected = self._screen(
+                cands, model_item, resource_spec)
+            if not cands:
+                from autodist_tpu.analysis import StrategyVerificationError
+
+                names = [n for n, _ in self.last_rejected]
+                raise StrategyVerificationError(self.last_rejected[0][1]) \
+                    from ValueError(
+                        f"every candidate strategy is infeasible: {names}")
         ranking = rank_strategies(cands, model_item, resource_spec,
                                   flops_per_example=self._flops,
                                   batch_per_chip=self._batch,

@@ -130,15 +130,27 @@ _COMPRESSOR_ALIASES = {
 }
 
 
+def _enum_choices(aliases):
+    """Render an alias map as 'Name (=value)' lines for error messages."""
+    return ", ".join(f"{k!r} (={v})" for k, v in sorted(aliases.items()))
+
+
 def resolve_compressor(name_or_value):
+    """Map a compressor name (reference or TPU-native) or raw proto enum
+    value to ``AllReduceSynchronizer.Compressor``; unknown inputs raise
+    with the full accepted name/value table."""
     if isinstance(name_or_value, int):
-        return name_or_value
+        if name_or_value in set(_COMPRESSOR_ALIASES.values()):
+            return name_or_value
+        raise ValueError(
+            f"Unknown compressor enum value {name_or_value}; accepted "
+            f"names/values: {_enum_choices(_COMPRESSOR_ALIASES)}")
     try:
         return _COMPRESSOR_ALIASES[name_or_value]
     except KeyError:
         raise ValueError(
-            f"Unknown compressor {name_or_value!r}; valid: {sorted(_COMPRESSOR_ALIASES)}"
-        )
+            f"Unknown compressor {name_or_value!r}; accepted names/values: "
+            f"{_enum_choices(_COMPRESSOR_ALIASES)}") from None
 
 
 _SCHEDULE_ALIASES = {
@@ -149,15 +161,20 @@ _SCHEDULE_ALIASES = {
 
 def resolve_schedule(name_or_value):
     """Map a user-facing ``schedule="overlap"|"barrier"`` knob (or the raw
-    proto enum) to ``AllReduceSynchronizer.Schedule``."""
+    proto enum) to ``AllReduceSynchronizer.Schedule``; unknown inputs
+    raise with the full accepted name/value table."""
     if isinstance(name_or_value, int):
-        return name_or_value
+        if name_or_value in set(_SCHEDULE_ALIASES.values()):
+            return name_or_value
+        raise ValueError(
+            f"Unknown schedule enum value {name_or_value}; accepted "
+            f"names/values: {_enum_choices(_SCHEDULE_ALIASES)}")
     try:
         return _SCHEDULE_ALIASES[str(name_or_value).lower()]
     except KeyError:
         raise ValueError(
-            f"Unknown schedule {name_or_value!r}; valid: "
-            f"{sorted(_SCHEDULE_ALIASES)}")
+            f"Unknown schedule {name_or_value!r}; accepted names/values: "
+            f"{_enum_choices(_SCHEDULE_ALIASES)}") from None
 
 
 class StrategyCompiler:

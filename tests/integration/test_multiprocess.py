@@ -52,7 +52,9 @@ def _oracle(steps=3):
     p = {"w": jnp.asarray(np.linspace(1, 2, 6, dtype=np.float32))}
     opt = optax.sgd(0.1)
     st = opt.init(p)
-    loss = lambda p_, b: jnp.mean((b @ p_["w"]) ** 2)
+    def loss(p_, b):
+        return jnp.mean((b @ p_["w"]) ** 2)
+
     for _ in range(steps):
         g = jax.grad(loss)(p, jnp.asarray(full))
         u, st = opt.update(g, st, p)
@@ -88,7 +90,9 @@ def test_two_process_uneven_feed_matches_oracle(tmp_path):
     p = {"w": jnp.asarray(np.linspace(1, 2, 6, dtype=np.float32))}
     opt = optax.sgd(0.1)
     st = opt.init(p)
-    loss = lambda p_, b: jnp.mean((b @ p_["w"]) ** 2)
+    def loss(p_, b):
+        return jnp.mean((b @ p_["w"]) ** 2)
+
     for _ in range(3):
         g = jax.grad(loss)(p, jnp.asarray(full))
         u, st = opt.update(g, st, p)

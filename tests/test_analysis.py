@@ -1,0 +1,389 @@
+"""Strategy-verifier tests (autodist_tpu/analysis + tools/verify_strategy.py).
+
+Covers the four passes (collective consistency, sharding lint, donation
+safety, HBM footprint), the wiring (AutoStrategy screening, the runner's
+``verify=`` knob), and the ``make check`` chain (lint + record
+verification + selftest) so tier-1 exercises the whole static gate.
+"""
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.analysis import (AnalysisContext, Severity,
+                                   StrategyVerificationError,
+                                   verify_strategy)
+from autodist_tpu.analysis.cases import (EXPECTED_ERROR_CODES,
+                                         build_rejected_case)
+from autodist_tpu.analysis.passes import (collectives_pass, donation_pass,
+                                          sharding_pass)
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce, PS, PartitionedPS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPEC8 = ResourceSpec(resource_info={
+    "nodes": [{"address": "localhost", "chips": list(range(8))}]})
+
+
+def _quad_loss(p, batch):
+    return jnp.mean((batch["x"] @ p["w"]) ** 2) + sum(
+        jnp.sum(jnp.square(x)) for x in jax.tree.leaves(p))
+
+
+def _item(shape=(64, 64)):
+    return ModelItem(_quad_loss, {"w": jnp.zeros(shape)}, optax.adam(1e-3))
+
+
+def _batch_shapes(d=64):
+    return {"x": ((16, d), "float32")}
+
+
+# -- jaxpr-level unit helpers ----------------------------------------------
+
+
+def _mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("r",))
+
+
+def _collect(body, n_args=1):
+    """Run the collectives pass over a shard_map'ed body function."""
+    mesh = _mesh8()
+    f = jax.shard_map(body, mesh=mesh,
+                      in_specs=tuple(P("r") for _ in range(n_args)),
+                      out_specs=P("r"), check_vma=False)
+    avals = [jax.ShapeDtypeStruct((8, 4), "float32") for _ in range(n_args)]
+    ctx = AnalysisContext(strategy=None, axis_sizes={"r": 8})
+    ctx.jaxpr = jax.jit(f).trace(*avals).jaxpr
+    return collectives_pass(ctx)
+
+
+# -- collective-consistency pass -------------------------------------------
+
+
+def test_one_sided_cond_collective_varying_pred_is_deadlock():
+    def body(x):
+        pred = jnp.sum(x) > 0  # device-local data -> varying predicate
+        return jax.lax.cond(pred,
+                            lambda v: jax.lax.psum(v, "r"),
+                            lambda v: v, x)
+
+    codes = [f.code for f in _collect(body)]
+    assert "C001" in codes
+
+
+def test_one_sided_cond_collective_uniform_pred_is_safe():
+    def body(x):
+        u = jax.lax.pmean(jnp.sum(x), "r")   # psum output is replicated
+        return jax.lax.cond(u > 0,
+                            lambda v: jax.lax.psum(v, "r"),
+                            lambda v: v, x)
+
+    findings = _collect(body)
+    assert not [f for f in findings if f.severity == Severity.ERROR]
+    assert "C002" in [f.code for f in findings]
+
+
+def test_matched_cond_collectives_are_clean():
+    def body(x):
+        pred = jnp.sum(x) > 0
+        return jax.lax.cond(pred,
+                            lambda v: jax.lax.psum(v * 2, "r"),
+                            lambda v: jax.lax.psum(v, "r"), x)
+
+    assert not [f for f in _collect(body) if f.severity == Severity.ERROR]
+
+
+def test_while_collective_with_varying_trip_count_is_deadlock():
+    def body(x):
+        def cond(c):
+            return jnp.sum(c) < 100.0  # depends on device-local c
+        def step(c):
+            return jax.lax.psum(c, "r") + c
+        return jax.lax.while_loop(cond, step, x)
+
+    assert "C003" in [f.code for f in _collect(body)]
+
+
+def test_while_uniform_trip_count_is_safe():
+    def body(x):
+        u = jax.lax.pmean(x, "r")
+        def cond(c):
+            return jnp.sum(c) < 100.0  # c stays replicated through the loop
+        def step(c):
+            return jax.lax.psum(c, "r")
+        return jax.lax.while_loop(cond, step, u) + x
+
+    assert not [f for f in _collect(body) if f.code == "C003"]
+
+
+def test_ppermute_total_cycle_clean_duplicate_error_partial_warn():
+    def total(x):
+        return jax.lax.ppermute(x, "r", [(i, (i + 1) % 8) for i in range(8)])
+
+    def dup(x):
+        return jax.lax.ppermute(x, "r", [(0, 1), (2, 1)])
+
+    def partial(x):
+        return jax.lax.ppermute(x, "r", [(0, 1), (1, 0)])
+
+    assert not [f for f in _collect(total) if f.code.startswith("C01")]
+    assert "C010" in [f.code for f in _collect(dup)]
+    assert "C011" in [f.code for f in _collect(partial)]
+
+
+def test_int8_wire_psum_overflows():
+    def body(x):
+        q = jnp.clip(x, -1, 1).astype(jnp.int8)
+        return jax.lax.psum(q, "r").astype(jnp.float32)
+
+    assert "C020" in [f.code for f in _collect(body)]
+
+
+# -- sharding lint ----------------------------------------------------------
+
+
+def test_partition_spec_bad_axis_and_duplicate_axis():
+    item = _item()
+    s = AllReduce().build(item, SPEC8)
+    ctx = AnalysisContext(strategy=s, model_item=item, num_replicas=8,
+                          axis_names=("replica",),
+                          axis_sizes={"replica": 8},
+                          param_specs={"w": P("model", "replica")})
+    codes = [f.code for f in sharding_pass(ctx)]
+    assert "S011" in codes
+    ctx2 = AnalysisContext(strategy=s, model_item=item, num_replicas=8,
+                           axis_names=("replica",),
+                           axis_sizes={"replica": 8},
+                           param_specs={"w": P("replica", "replica")})
+    assert "S012" in [f.code for f in sharding_pass(ctx2)]
+
+
+def test_mesh_subset_ps_axes_must_exist():
+    item = _item()
+    s = PS(ps_axes=("ici",)).build(item, SPEC8)  # 1-D "replica" mesh
+    report = verify_strategy(s, item, SPEC8, passes=("sharding",))
+    assert "S008" in report.error_codes()
+
+
+def test_duplicate_node_config_flagged():
+    item = _item()
+    s = AllReduce().build(item, SPEC8)
+    s.node_config.add().CopyFrom(s.node_config[0])
+    report = verify_strategy(s, item, SPEC8, passes=("sharding",))
+    assert "S002" in report.error_codes()
+
+
+# -- donation safety --------------------------------------------------------
+
+
+def test_inner_donation_read_after_is_error():
+    inner = jax.jit(lambda x: x * 2, donate_argnums=0)
+
+    def g(x):
+        y = inner(x)
+        return y + x  # reads x after donating it to `inner`
+
+    ctx = AnalysisContext(strategy=None)
+    ctx.jaxpr = jax.jit(g).trace(
+        jax.ShapeDtypeStruct((128,), "float32")).jaxpr
+    assert "D001" in [f.code for f in donation_pass(ctx)]
+
+
+def test_wasted_donation_is_warning_and_clean_donation_is_not():
+    def shrink(x):
+        return jnp.sum(x)  # no same-shape output to alias
+
+    ctx = AnalysisContext(strategy=None, donate=True)
+    ctx.jaxpr = jax.jit(shrink).trace(
+        jax.ShapeDtypeStruct((128,), "float32")).jaxpr
+    ctx.donated_invars = [True]
+    assert "D002" in [f.code for f in donation_pass(ctx)]
+
+    def update(x):
+        return x + 1.0  # alias-compatible output
+
+    ctx2 = AnalysisContext(strategy=None, donate=True)
+    ctx2.jaxpr = jax.jit(update).trace(
+        jax.ShapeDtypeStruct((128,), "float32")).jaxpr
+    ctx2.donated_invars = [True]
+    assert not donation_pass(ctx2)
+
+
+# -- HBM footprint ----------------------------------------------------------
+
+
+def test_hbm_footprint_ps_shards_opt_state():
+    from autodist_tpu.simulator.cost_model import hbm_footprint
+
+    item = _item((512, 512))
+    ar = hbm_footprint(AllReduce().build(item, SPEC8), item, 8)
+    ps = hbm_footprint(PS().build(item, SPEC8), item, 8)
+    pb = 512 * 512 * 4
+    assert abs(ar["opt_bytes"] - 2 * pb) < 0.05 * pb     # adam: 2 moments
+    assert abs(ps["opt_bytes"] - 2 * pb / 8) < 0.05 * pb  # sharded 1/8
+    assert ar["param_bytes"] == ps["param_bytes"] == pb
+    sharded = hbm_footprint(PartitionedPS().build(item, SPEC8), item, 8)
+    assert sharded["param_bytes"] <= pb / 8 + 1024
+
+
+def test_over_budget_strategy_rejected_end_to_end():
+    item = _item((512, 512))
+    s = AllReduce().build(item, SPEC8)
+    report = verify_strategy(s, item, SPEC8,
+                             batch_shapes=_batch_shapes(512),
+                             hbm_bytes_per_device=256 * 1024)
+    assert "H001" in report.error_codes()
+    with pytest.raises(StrategyVerificationError):
+        report.raise_for_errors()
+
+
+def test_liveness_peak_at_least_param_bytes():
+    item = _item((256, 256))
+    s = AllReduce().build(item, SPEC8)
+    report = verify_strategy(s, item, SPEC8,
+                             batch_shapes=_batch_shapes(256),
+                             hbm_bytes_per_device=16 * 1024 ** 3)
+    assert report.ok
+    ctx_peak = [f for f in report.findings if f.pass_name == "hbm-traced"]
+    assert ctx_peak  # the traced summary is reported
+
+
+# -- the canonical rejected case -------------------------------------------
+
+
+def test_rejected_case_has_three_distinct_errors():
+    report = verify_strategy(**build_rejected_case())
+    assert not report.ok
+    assert set(EXPECTED_ERROR_CODES) <= set(report.error_codes())
+    # and they are three DISTINCT codes
+    assert len(set(EXPECTED_ERROR_CODES)) == 3
+
+
+def test_clean_strategies_verify_ok():
+    item = _item()
+    for b in (AllReduce(), AllReduce(schedule="overlap"), PS(),
+              PartitionedPS(), PS(staleness=3)):
+        s = b.build(item, SPEC8)
+        report = verify_strategy(s, item, SPEC8,
+                                 batch_shapes=_batch_shapes(),
+                                 hbm_bytes_per_device=16 * 1024 ** 3)
+        assert report.ok, f"{type(b).__name__}: {report}"
+    # the staleness cond (collective in one branch, replicated predicate)
+    # must be INFO C002, never the C001 deadlock
+    s = PS(staleness=3).build(item, SPEC8)
+    report = verify_strategy(s, item, SPEC8, batch_shapes=_batch_shapes())
+    codes = [f.code for f in report.findings]
+    assert "C002" in codes and "C001" not in codes
+
+
+# -- AutoStrategy screening -------------------------------------------------
+
+
+def test_auto_strategy_never_ranks_rejected_candidates():
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    item = _item((512, 512))
+    pb = 512 * 512 * 4
+    # budget fits params + grads + sharded opt (PS family) but NOT the
+    # replicated-opt AllReduce family
+    budget = int(pb + pb + 2 * pb / 8 + 0.2 * pb)
+    auto = AutoStrategy(hbm_bytes_per_device=budget)
+    auto.build(item, SPEC8)
+    rejected = {n for n, _ in auto.last_rejected}
+    ranked = {n for n, _ in auto.last_ranking}
+    assert "AllReduce" in rejected
+    assert "AllReduce" not in ranked
+    assert ranked  # PS-family survivors were ranked
+    for _name, rep in auto.last_rejected:
+        assert "H001" in rep.error_codes()
+
+
+def test_auto_strategy_all_infeasible_raises():
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    item = _item((512, 512))
+    auto = AutoStrategy(hbm_bytes_per_device=1024)  # fits nothing
+    with pytest.raises(StrategyVerificationError):
+        auto.build(item, SPEC8)
+
+
+# -- engine verify= knob ----------------------------------------------------
+
+
+def test_distribute_verify_rejects_deadlock_on_first_run():
+    from autodist_tpu.autodist import AutoDist
+
+    case = build_rejected_case()
+    ad = AutoDist(resource_spec=SPEC8, strategy_builder=AllReduce())
+    sess = ad.distribute(case["model_item"].loss_fn,
+                         case["model_item"].params, optax.adam(1e-3),
+                         verify=True)
+    with pytest.raises(StrategyVerificationError) as e:
+        sess.run({"x": np.ones((16, 64), np.float32)})
+    assert "C001" in e.value.report.error_codes()
+
+
+def test_distribute_verify_passes_clean_model():
+    from autodist_tpu.autodist import AutoDist
+
+    ad = AutoDist(resource_spec=SPEC8, strategy_builder=AllReduce())
+    sess = ad.distribute(lambda p, b: jnp.mean((b["x"] @ p["w"]) ** 2),
+                         {"w": jnp.ones((8, 8))}, optax.sgd(0.1),
+                         verify=True)
+    m = sess.run({"x": np.ones((16, 8), np.float32)})
+    assert np.isfinite(float(m["loss"]))
+
+
+# -- make check: lint + record sweep + selftest -----------------------------
+
+
+def _load_tool(name):
+    import importlib.util
+
+    path = os.path.join(REPO, "tools", name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_make_check_chain_lint_and_records_clean():
+    """The `make check` gate, in-process: tools/lint.py over the default
+    roots AND tools/verify_strategy.py over every cpu_mesh record plus the
+    selftest — all green, from tier-1."""
+    lint = _load_tool("lint.py")
+    assert lint.main([os.path.join(REPO, d)
+                      for d in ("autodist_tpu", "tests", "examples",
+                                "tools")]) == 0
+
+    vs = _load_tool("verify_strategy.py")
+    records_dir = os.path.join(REPO, "records", "cpu_mesh")
+    records = sorted(os.path.join(records_dir, f)
+                     for f in os.listdir(records_dir) if f.endswith(".json"))
+    assert records, "cpu_mesh sweep records are missing"
+    assert vs.main(records + ["--selftest"]) == 0
+
+
+def test_cli_rejects_hand_built_case_via_subprocess(tmp_path):
+    """The acceptance contract end-to-end: the CLI exits nonzero on the
+    hand-built bad strategy and prints its three distinct ERROR codes."""
+    case_file = tmp_path / "bad_case.py"
+    case_file.write_text(
+        "from autodist_tpu.analysis.cases import build_rejected_case\n"
+        "def get_case():\n"
+        "    return build_rejected_case()\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "verify_strategy.py"),
+         "--case", str(case_file)],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for code in EXPECTED_ERROR_CODES:
+        assert code in proc.stdout

@@ -96,7 +96,8 @@ def test_gqa_matches_repeated_heads(kv_heads):
     q = _rand((2, 64, h, 16), seed=0)
     k = _rand((2, 64, kv_heads, 16), seed=1)
     v = _rand((2, 64, kv_heads, 16), seed=2)
-    rep = lambda t: jnp.repeat(t, h // kv_heads, axis=2)
+    def rep(t):
+        return jnp.repeat(t, h // kv_heads, axis=2)
 
     def f_gqa(q, k, v):
         return jnp.sum(jnp.sin(flash_attention(q, k, v, causal=True,

@@ -199,8 +199,11 @@ def test_remat_is_value_exact():
     ids = jnp.asarray(np.random.RandomState(1).randint(0, 1024, (2, 32)))
     m0, m1 = bert.Bert(bcfg0), bert.Bert(bcfg1)
     p = m0.init(jax.random.PRNGKey(0), ids)["params"]
-    f0 = lambda p_: jnp.sum(jnp.sin(m0.apply({"params": p_}, ids)[0]))
-    f1 = lambda p_: jnp.sum(jnp.sin(m1.apply({"params": p_}, ids)[0]))
+    def f0(p_):
+        return jnp.sum(jnp.sin(m0.apply({"params": p_}, ids)[0]))
+
+    def f1(p_):
+        return jnp.sum(jnp.sin(m1.apply({"params": p_}, ids)[0]))
     v0, gg0 = jax.value_and_grad(f0)(p)
     v1, gg1 = jax.value_and_grad(f1)(p)
     assert float(jnp.abs(v0 - v1)) == 0.0

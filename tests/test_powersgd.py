@@ -16,7 +16,9 @@ def test_rank1_gradient_captured_exactly():
     ad = AutoDist(resource_spec=SPEC,
                   strategy_builder=AllReduce(compressor="PowerSGDCompressor"))
     p = {"w": jnp.zeros((64, 32))}
-    loss = lambda p_, b: jnp.mean((b @ p_["w"]).sum(1))
+    def loss(p_, b):
+        return jnp.mean((b @ p_["w"]).sum(1))
+
     sess = ad.distribute(loss, p, optax.sgd(0.01))
     b = np.random.RandomState(0).randn(16, 64).astype(np.float32)
     for _ in range(20):
@@ -37,7 +39,9 @@ def test_error_feedback_recovers_full_rank():
     target = r.randn(32, 16).astype(np.float32)  # full-rank constant gradient
 
     # loss with constant gradient -target (so w -> lr*steps*target)
-    loss = lambda p_, b: -jnp.sum(p_["w"] * jnp.asarray(target)) + 0.0 * jnp.sum(b)
+    def loss(p_, b):
+        return -jnp.sum(p_["w"] * jnp.asarray(target)) + 0.0 * jnp.sum(b)
+
     sess = ad.distribute(loss, {"w": jnp.zeros((32, 16))}, optax.sgd(0.1))
     b = np.zeros((8, 1), np.float32)
     for _ in range(200):

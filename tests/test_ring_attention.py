@@ -10,7 +10,9 @@ from autodist_tpu.parallel.ring_attention import all_to_all_attention, ring_atte
 
 def _qkv(B=2, S=64, H=4, D=8, seed=0):
     r = np.random.RandomState(seed)
-    mk = lambda: jnp.asarray(r.randn(B, S, H, D), jnp.float32)
+    def mk():
+        return jnp.asarray(r.randn(B, S, H, D), jnp.float32)
+
     return mk(), mk(), mk()
 
 

@@ -172,3 +172,58 @@ def test_mesh_request_in_graph_config(item):
     s = AllReduce().build(item, spec)
     assert list(s.graph_config.mesh.axis_names) == ["replica", "model"]
     assert list(s.graph_config.mesh.axis_sizes) == [4, 2]
+
+
+def test_prune_nodes_interleaved_ghosts_keep_order(item, spec):
+    """_prune_nodes drops vars absent from the model and keeps the
+    surviving node order stable (the engine's bucket grouping depends on
+    node order, so pruning must not reshuffle)."""
+    base = PS().build(item, spec)
+    real = list(base.node_config)
+    s2 = Strategy()
+    s2.proto.graph_config.CopyFrom(base.proto.graph_config)
+    for i, n in enumerate(real):
+        ghost = s2.node_config.add()
+        ghost.var_name = f"ghost/{i}"
+        ghost.PSSynchronizer.sync = True
+        s2.node_config.add().CopyFrom(n)
+    c = StrategyCompiler(item, spec).compile(s2)
+    assert [n.var_name for n in c.node_config] == \
+        [n.var_name for n in real]
+
+
+def test_prune_nodes_without_model_is_noop(spec):
+    s = Strategy()
+    n = s.node_config.add()
+    n.var_name = "anything/at/all"
+    n.PSSynchronizer.sync = True
+    c = StrategyCompiler(None, spec).compile(s)
+    assert [x.var_name for x in c.node_config] == ["anything/at/all"]
+
+
+def test_resolve_compressor_errors_enumerate_choices():
+    from autodist_tpu.strategy.base import resolve_compressor
+
+    with pytest.raises(ValueError) as e:
+        resolve_compressor("FancyCompressor")
+    msg = str(e.value)
+    # the full accepted name/value table, not just the bad input
+    assert "'BF16Compressor' (=1)" in msg
+    assert "'PowerSGDCompressor'" in msg
+    # raw enum values are validated too
+    with pytest.raises(ValueError) as e2:
+        resolve_compressor(99)
+    assert "accepted names/values" in str(e2.value)
+    assert resolve_compressor("Int8Compressor") == resolve_compressor(3)
+
+
+def test_resolve_schedule_errors_enumerate_choices():
+    from autodist_tpu.strategy.base import resolve_schedule
+
+    with pytest.raises(ValueError) as e:
+        resolve_schedule("pipelined")
+    msg = str(e.value)
+    assert "'barrier' (=0)" in msg and "'overlap' (=1)" in msg
+    with pytest.raises(ValueError):
+        resolve_schedule(7)
+    assert resolve_schedule("OVERLAP") == 1

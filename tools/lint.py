@@ -8,8 +8,10 @@ codebase:
   F401  unused import
   F811  duplicate/shadowed import name
   E722  bare ``except:``
+  E731  lambda assigned to a name (use ``def``)
   B006  mutable default argument
   E711  comparison to None with ``==`` / ``!=``
+  F841  local variable assigned but never used
   W291  trailing whitespace
   W191  tab indentation
   F502  f-string without placeholders
@@ -77,6 +79,7 @@ class Checker(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node):
         self._check_defaults(node)
+        self._check_unused_locals(node)
         self._depth += 1
         self.generic_visit(node)
         self._depth -= 1
@@ -84,12 +87,57 @@ class Checker(ast.NodeVisitor):
     def visit_AsyncFunctionDef(self, node):
         self.visit_FunctionDef(node)
 
+    # -- F841: locals assigned but never used ------------------------------
+
+    _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+    def _check_unused_locals(self, func):
+        """Plain ``name = ...`` bindings in this function's own scope that
+        no Load anywhere in the function (closures included) ever reads.
+        Tuple-unpacking targets, augmented assigns, loop/with targets and
+        underscore names are exempt (matching flake8's defaults closely
+        enough for this codebase)."""
+        stores = {}      # name -> first assignment lineno
+        declared = set()  # global/nonlocal names are not locals
+
+        def collect_stores(n):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        stores.setdefault(t.id, t.lineno)
+            elif isinstance(n, ast.AnnAssign):
+                if n.value is not None and isinstance(n.target, ast.Name):
+                    stores.setdefault(n.target.id, n.lineno)
+            elif isinstance(n, (ast.Global, ast.Nonlocal)):
+                declared.update(n.names)
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, self._SCOPE_NODES + (ast.ClassDef,)):
+                    continue  # nested scope: its stores are not our locals
+                collect_stores(child)
+
+        loads = set()
+        for n in ast.walk(func):
+            if isinstance(n, ast.Name) and isinstance(n.ctx,
+                                                      (ast.Load, ast.Del)):
+                loads.add(n.id)
+        for stmt in func.body:
+            collect_stores(stmt)
+        for name, lineno in sorted(stores.items(), key=lambda kv: kv[1]):
+            if name in loads or name in declared or name.startswith("_"):
+                continue
+            self.add(lineno, "F841",
+                     f"local variable {name!r} assigned but never used")
+
     def visit_Assign(self, node):
         if (any(getattr(t, "id", "") == "__all__" for t in node.targets)
                 and isinstance(node.value, (ast.List, ast.Tuple))):
             for elt in node.value.elts:
                 if isinstance(elt, ast.Constant):
                     self._all_names.add(str(elt.value))
+        if isinstance(node.value, ast.Lambda) and any(
+                isinstance(t, ast.Name) for t in node.targets):
+            self.add(node.lineno, "E731",
+                     "lambda assigned to a name (use 'def')")
         self.generic_visit(node)
 
     def visit_Compare(self, node):

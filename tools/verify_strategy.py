@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Static strategy verification CLI (``make verify`` / ``make check``).
+
+Verifies strategies WITHOUT a TPU (or any accelerator): the engine's train
+step is traced devicelessly over a virtual CPU mesh (the AOT abstract-eval
+path) and the analysis passes of :mod:`autodist_tpu.analysis` run over the
+jaxpr — SPMD deadlocks, invalid PartitionSpecs, donation hazards and HBM
+overflows surface as severity-ranked findings instead of pod hangs.
+
+Targets:
+
+- ``records/cpu_mesh/*.json`` — AutoSync-style RuntimeRecords (the sweep
+  artifacts): the embedded ModelItemDef is rebuilt as a synthetic model
+  (zero params + a quadratic loss, so the strategy's full synchronization
+  program is traced) and verified against the embedded strategy proto.
+- ``--case FILE.py`` — a python file defining ``get_case() -> dict`` of
+  ``verify_strategy`` kwargs (hand-built scenarios).
+- ``--selftest`` — the canonical rejected case
+  (:mod:`autodist_tpu.analysis.cases`): asserts the verifier still
+  produces its three distinct ERROR findings (C001 deadlock, S011 bad
+  mesh axis, H001 HBM overflow).
+
+Exit status: 0 when every target is free of ERROR findings (and the
+selftest, when requested, fires correctly); 1 otherwise.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _force_cpu_devices(n=8):
+    """Give the deviceless trace a virtual CPU mesh BEFORE jax loads."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _synthetic_loss(params, batch):
+    """Quadratic loss over every trainable leaf: differentiable for every
+    variable (so the full gradient-sync program is traced) and tolerant of
+    engine-provided leaves like ShardedTable (a registered pytree whose
+    leaf is the local block)."""
+    import jax
+    import jax.numpy as jnp
+
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(params):
+        total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    x = jax.tree.leaves(batch)[0]
+    return total * jnp.mean(jnp.ones_like(x, jnp.float32))
+
+
+def _record_case(path, hbm_bytes):
+    """RuntimeRecord JSON -> verify_strategy kwargs."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.proto import modelitem_pb2
+    from autodist_tpu.simulator.cost_model import RuntimeRecord
+    from autodist_tpu.strategy.base import Strategy
+    from autodist_tpu.proto import strategy_pb2
+
+    rec = RuntimeRecord.load(path)
+    mdef = modelitem_pb2.ModelItemDef()
+    mdef.ParseFromString(rec.model_def)
+    params = {v.name: jnp.zeros(tuple(v.shape), np.dtype(v.dtype))
+              for v in mdef.variables}
+    sparse = [v.name for v in mdef.variables if v.sparse_gradient]
+    item = ModelItem(_synthetic_loss, params, optax.adam(1e-3),
+                     sparse_vars=sparse or None)
+    pb = strategy_pb2.Strategy()
+    pb.ParseFromString(rec.strategy_pb)
+    strategy = Strategy(pb)
+    R = 1
+    for s in pb.graph_config.mesh.axis_sizes:
+        R *= int(s)
+    R = max(1, R)
+    return dict(strategy=strategy, model_item=item,
+                batch_shapes={"x": ((2 * R, 4), "float32")},
+                hbm_bytes_per_device=hbm_bytes)
+
+
+def _load_case_file(path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("verify_case", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.get_case()
+
+
+def _print_report(name, report, verbose):
+    status = "OK" if report.ok else "REJECTED"
+    print(f"[{status}] {name}: {len(report.errors)} error(s), "
+          f"{len(report.warnings)} warning(s), "
+          f"{len(report.findings)} finding(s)")
+    for f in report.sorted_findings():
+        if verbose or int(f.severity) > 0:
+            print(f"    {f}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("targets", nargs="*",
+                    help="RuntimeRecord JSON files (e.g. records/cpu_mesh/*.json)")
+    ap.add_argument("--case", action="append", default=[],
+                    help="python file with get_case() -> verify_strategy kwargs")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the canonical rejected case and assert the "
+                         "three expected ERROR findings fire")
+    ap.add_argument("--hbm-gib", type=float, default=16.0,
+                    help="per-chip HBM budget in GiB (default: 16, v5e)")
+    ap.add_argument("--device-kind", default=None,
+                    help="take the budget from aot.HBM_BY_DEVICE_KIND "
+                         "(e.g. 'TPU v5 lite')")
+    ap.add_argument("--static-only", action="store_true",
+                    help="skip the trace passes (no devices needed at all)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write all reports as JSON to this path")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print INFO findings")
+    args = ap.parse_args(argv)
+
+    _force_cpu_devices()
+    from autodist_tpu.analysis import (STATIC_PASSES, verify_strategy)
+    from autodist_tpu.analysis.cases import (EXPECTED_ERROR_CODES,
+                                             build_rejected_case)
+
+    hbm_bytes = int(args.hbm_gib * 1024 ** 3)
+    if args.device_kind:
+        from autodist_tpu.aot import HBM_BY_DEVICE_KIND
+
+        if args.device_kind not in HBM_BY_DEVICE_KIND:
+            ap.error(f"unknown --device-kind {args.device_kind!r}; "
+                     f"known: {sorted(HBM_BY_DEVICE_KIND)}")
+        hbm_bytes = HBM_BY_DEVICE_KIND[args.device_kind]
+
+    passes = STATIC_PASSES if args.static_only else None
+    results = {}
+    failed = False
+
+    for path in args.targets:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except Exception as e:
+            print(f"[ERROR] {path}: cannot read: {e}")
+            failed = True
+            continue
+        if not {"model_def", "strategy"} <= set(d):
+            # sweep directories hold summary JSONs beside the records
+            print(f"[SKIP] {os.path.basename(path)}: not a RuntimeRecord")
+            continue
+        try:
+            case = _record_case(path, hbm_bytes)
+        except Exception as e:
+            print(f"[ERROR] {path}: cannot load record: {e}")
+            failed = True
+            continue
+        report = verify_strategy(passes=passes, **case)
+        results[path] = report
+        _print_report(os.path.basename(path), report, args.verbose)
+        failed = failed or not report.ok
+
+    for path in args.case:
+        case = _load_case_file(path)
+        case.setdefault("hbm_bytes_per_device", hbm_bytes)
+        report = verify_strategy(passes=passes, **case)
+        results[path] = report
+        _print_report(os.path.basename(path), report, args.verbose)
+        failed = failed or not report.ok
+
+    if args.selftest:
+        report = verify_strategy(passes=passes, **build_rejected_case())
+        results["<selftest>"] = report
+        _print_report("selftest (expected REJECTED)", report, args.verbose)
+        missing = [c for c in EXPECTED_ERROR_CODES
+                   if c not in report.error_codes()]
+        if missing:
+            print(f"[ERROR] selftest: expected ERROR codes {missing} did "
+                  f"not fire (got {report.error_codes()})")
+            failed = True
+        else:
+            print(f"selftest passed: rejected with distinct ERROR codes "
+                  f"{list(EXPECTED_ERROR_CODES)}")
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({k: r.to_json() for k, r in results.items()}, f,
+                      indent=2)
+        print(f"wrote {args.json_out}")
+
+    if not results:
+        ap.error("nothing to verify: pass record files, --case, or --selftest")
+    print(f"{len(results)} target(s) verified; "
+          + ("FAILURES above" if failed else "all clean"))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
