@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify telemetry-check check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -64,9 +64,17 @@ verify:
 	$(PY) tools/verify_strategy.py records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --selftest
 
-# the pre-merge static gate: lint + strategy verification
-# (tests/test_analysis.py runs the same chain, so tier-1 exercises it)
-check: lint verify
+# live telemetry gate (docs/observability.md): a 5-step CPU-mesh session
+# with telemetry on must emit a schema-valid JSONL manifest with per-step
+# walls / throughput / MFU / memory snapshots, render through
+# tools/telemetry_report.py, and calibrate from its RuntimeRecord
+telemetry-check:
+	$(PY) tools/telemetry_check.py
+
+# the pre-merge gate: lint + strategy verification + live telemetry
+# (tests/test_analysis.py + test_telemetry.py run the same chains, so
+# tier-1 exercises it)
+check: lint verify telemetry-check
 
 clean:
 	$(MAKE) -C native clean

@@ -115,6 +115,18 @@ class Cluster:
             or ENV.AUTODIST_ASYNC_PS_ADDR.val
             or f"{self._spec.chief}:{DEFAULT_ASYNC_PS_PORT}",
         }
+        # telemetry rides the same contract: a chief with telemetry on
+        # hands every worker the flag AND the shared run directory, so all
+        # hosts write worker_<rank>.jsonl into one place the chief can
+        # merge (telemetry/aggregate.py; shared-fs assumption as for the
+        # strategy handoff)
+        from autodist_tpu import telemetry
+
+        if telemetry.enabled():
+            env.setdefault("AUTODIST_TELEMETRY", "1")
+            run_dir = telemetry.configured_run_dir()
+            if run_dir:
+                env.setdefault("AUTODIST_TELEMETRY_DIR", run_dir)
         env.update(extra_env)
         ssh = self._spec.ssh_config(worker_address)
         if ssh is not None:
@@ -163,11 +175,26 @@ class Cluster:
             t.start()
             self._monitor_threads.append(t)
 
-    def _monitor(self, addr, proc):
+    def _monitor(self, addr, proc, poll_s=0.5):
         """Fail fast: a dead worker kills the chief (reference
         coordinator.py:98-110 uses os._exit(1)).  Intentional shutdown via
-        :meth:`terminate` must not count as a failure."""
-        code = proc.wait()
+        :meth:`terminate` must not count as a failure.
+
+        The monitor doubles as the telemetry heartbeat channel: while its
+        worker lives, the thread refreshes a per-worker liveness gauge
+        (``cluster.worker_alive_t{addr}``), and every exit — clean or not
+        — lands in the ``cluster.worker_exits`` counter, so a merged run
+        manifest shows which hosts were up for how long (no-ops when
+        telemetry is off)."""
+        import time as _time
+
+        from autodist_tpu import telemetry
+
+        while proc.poll() is None:
+            telemetry.gauge("cluster.worker_alive_t", _time.time(), addr=addr)
+            _time.sleep(poll_s)
+        code = proc.returncode
+        telemetry.counter("cluster.worker_exits", exit_code=code, addr=addr)
         if code != 0 and not self._terminating:
             logging.error("Worker %s exited with %d; terminating chief", addr, code)
             os._exit(1)
@@ -178,6 +205,20 @@ class Cluster:
             if proc.poll() is None:
                 proc.terminate()
         self._procs = []
+
+    def merge_telemetry(self, run_dir=None):
+        """Chief-side aggregation: merge every host's
+        ``worker_<rank>.jsonl`` under the shared run dir into one
+        ``manifest.jsonl``; returns the manifest path (None off-chief, or
+        when no run dir is known / no worker files exist)."""
+        from autodist_tpu import telemetry
+
+        if not self.is_chief:
+            return None
+        run_dir = run_dir or telemetry.configured_run_dir()
+        if not run_dir:
+            return None
+        return telemetry.merge_worker_manifests(run_dir)
 
 
 class Coordinator:

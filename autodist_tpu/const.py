@@ -71,6 +71,11 @@ class ENV(Enum):
     # worker_env contract; absent => the documented derived-from-strategy-
     # id fallback (async_service._run_authkey)
     AUTODIST_ASYNC_PS_AUTHKEY = (lambda v: v or "",)
+    # runtime telemetry (autodist_tpu/telemetry, docs/observability.md):
+    # "1" turns per-step instrumentation on; the chief forwards both to
+    # launched workers so every host writes into the same run directory
+    AUTODIST_TELEMETRY = (lambda v: v == "True" or v == "1",)
+    AUTODIST_TELEMETRY_DIR = (lambda v: v or "",)
     SYS_DATA_PATH = (lambda v: v or "",)
     SYS_RESOURCE_PATH = (lambda v: v or "",)
 

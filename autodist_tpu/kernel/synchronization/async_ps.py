@@ -260,6 +260,8 @@ class AsyncPSSession:
 
     def push(self, grads, seen_version):
         """Apply one gradient (async); returns the new server version."""
+        from autodist_tpu import telemetry
+
         grads = jax.device_get(grads)
         with self._lock:
             updates, self._opt_state = jax.device_get(
@@ -271,9 +273,18 @@ class AsyncPSSession:
             self._params = jax.device_get(
                 optax.apply_updates(self._params, updates))
             self._version += 1
-            if seen_version < self._version - 1:
+            ver = self._version
+            stale = seen_version < ver - 1
+            if stale:
                 self._stale_pushes += 1
-            return self._version
+        # first-class async-PS metrics (previously only the end-of-run log
+        # line): per-push version lag + totals, recorded outside the state
+        # lock — the registry has its own
+        telemetry.counter("async_ps.pushes")
+        if stale:
+            telemetry.counter("async_ps.stale_pushes")
+        telemetry.histogram("async_ps.push_version_lag", ver - 1 - seen_version)
+        return ver
 
     @property
     def params(self):
@@ -370,6 +381,11 @@ class AsyncPSSession:
             raise TimeoutError(f"{len(alive)} async workers still running "
                                f"after {timeout}s (stop flag set; they quiesce "
                                f"at the next step boundary)")
+        from autodist_tpu import telemetry
+
+        telemetry.gauge("async_ps.version", self.version)
+        telemetry.gauge("async_ps.max_lead", self.barrier.max_lead_seen)
+        telemetry.gauge("async_ps.stale_pushes_total", self.stale_pushes)
         logging.info("AsyncPS run done: version=%d, max_lead=%d, stale_pushes=%d",
                      self.version, self.barrier.max_lead_seen, self.stale_pushes)
         return self.params

@@ -76,15 +76,26 @@ class AsyncPSService:
     def push(self, grads, seen_version):
         import optax
 
+        from autodist_tpu import telemetry
+
         with self._lock:
             updates, self._opt_state = jax.device_get(
                 self._apply(grads, self._opt_state, self._params))
             self._params = jax.tree.map(
                 np.asarray, optax.apply_updates(self._params, updates))
             self._version += 1
-            if seen_version < self._version - 1:
+            ver = self._version
+            stale = seen_version < ver - 1
+            if stale:
                 self._stale_pushes += 1
-            return self._version
+        # same first-class metrics as the thread-local runtime (async_ps):
+        # the chief-side registry sees every worker's pushes, so the
+        # merged manifest carries cluster-wide staleness evidence
+        telemetry.counter("async_ps.pushes")
+        if stale:
+            telemetry.counter("async_ps.stale_pushes")
+        telemetry.histogram("async_ps.push_version_lag", ver - 1 - seen_version)
+        return ver
 
     def may_start(self, worker):
         """Non-blocking barrier probe: True when ``worker`` is within the
@@ -95,11 +106,17 @@ class AsyncPSService:
         self.barrier.advance(worker)
 
     def stats(self):
+        from autodist_tpu import telemetry
+
         with self._lock:
-            return {"version": self._version,
-                    "stale_pushes": self._stale_pushes,
-                    "max_lead_seen": self.barrier.max_lead_seen,
-                    "steps": self.barrier.steps}
+            stats = {"version": self._version,
+                     "stale_pushes": self._stale_pushes,
+                     "max_lead_seen": self.barrier.max_lead_seen,
+                     "steps": self.barrier.steps}
+        telemetry.gauge("async_ps.version", stats["version"])
+        telemetry.gauge("async_ps.max_lead", stats["max_lead_seen"])
+        telemetry.gauge("async_ps.stale_pushes_total", stats["stale_pushes"])
+        return stats
 
 
 def serve_async_ps(service, address, authkey=b"autodist-async-ps"):

@@ -21,7 +21,7 @@ from autodist_tpu.utils import logging
 
 class DistributedSession:
     def __init__(self, transformer, rng=None, donate=True, batch_mask=False,
-                 verify=False, hbm_bytes_per_device=None):
+                 verify=False, hbm_bytes_per_device=None, telemetry=None):
         self._t = transformer
         self._mesh = transformer.mesh
         self._axis = transformer.axis
@@ -57,6 +57,22 @@ class DistributedSession:
         self._verify_budget = hbm_bytes_per_device
         self._donate = donate
         self._verified = False
+        # runtime telemetry (autodist_tpu/telemetry, docs/observability.md):
+        # OFF by default — ``run`` then takes the uninstrumented hot path
+        # (no device sync, no file I/O; pinned by test_telemetry).  Opt in
+        # per process (AUTODIST_TELEMETRY=1 / telemetry.enable()) or per
+        # session (telemetry=True or a prebuilt SessionTelemetry).
+        if telemetry is None:
+            from autodist_tpu import telemetry as _telemetry
+
+            telemetry = _telemetry.enabled()
+        if telemetry is True:
+            from autodist_tpu.telemetry.session import SessionTelemetry
+
+            self._telemetry = SessionTelemetry(
+                transformer, mem_fn=self.memory_stats)
+        else:
+            self._telemetry = telemetry or None
 
     # -- feeds (reference remapper._remap_feed analog) ---------------------
 
@@ -248,39 +264,122 @@ class DistributedSession:
             report.raise_for_errors()
         return report
 
-    def run(self, batch, trace_dir=None):
-        """One training step on a global batch; returns metrics dict."""
-        gbatch = self._shard_batch(batch)
+    def _pre_step(self, gbatch):
+        """First-step hooks shared by both run paths: opt-in verification
+        + the 4-stage program-evolution dump (no-op unless
+        AUTODIST_DUMP_HLO) — the analog of the reference's per-pass
+        TensorBoard graph logging."""
         if self._verify and not self._verified:
-            # first step: abstractly re-trace and verify against this
-            # batch's shapes before anything executes
+            # abstractly re-trace and verify against this batch's shapes
+            # before anything executes
             self._verified = True
             self._verify_gbatch(gbatch)
         if not self._dumped_artifacts:
-            # 4-stage program-evolution dump (no-op unless
-            # AUTODIST_DUMP_HLO): plan -> StableHLO -> optimized HLO ->
-            # executable stats, the analog of the reference's per-pass
-            # TensorBoard graph logging
             self._dumped_artifacts = True
             from autodist_tpu.utils.visualization_util import (
                 dump_step_artifacts)
 
             dump_step_artifacts(self._t, self._step, self.state, gbatch)
+
+    def _trace_step_dir(self, trace_dir, step):
+        """Per-step profile dir: repeated traced runs must not overwrite
+        each other's capture (``<trace_dir>/step_<n>/``)."""
+        path = os.path.join(trace_dir, f"step_{step}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def run(self, batch, trace_dir=None):
+        """One training step on a global batch; returns the metrics dict.
+
+        With ``trace_dir`` the step runs under ``jax.profiler.trace`` in
+        ``<trace_dir>/step_<n>/`` (namespaced so repeated traced runs
+        keep every capture) and the metrics carry the capture path under
+        ``"trace_dir"``.
+        """
+        if self._telemetry is None:
+            return self._run_plain(batch, trace_dir)
+        return self._run_instrumented(batch, trace_dir)
+
+    def _run_plain(self, batch, trace_dir):
+        """The uninstrumented hot path — exactly one async dispatch, no
+        telemetry code, no host sync (unless tracing)."""
+        gbatch = self._shard_batch(batch)
+        self._pre_step(gbatch)
         if trace_dir:
-            os.makedirs(trace_dir, exist_ok=True)
-            with jax.profiler.trace(trace_dir):
+            path = self._trace_step_dir(trace_dir, self.step)
+            with jax.profiler.trace(path):
+                self.state, metrics = self._step(self.state, gbatch)
+                jax.block_until_ready(metrics)
+            metrics = dict(metrics)
+            metrics["trace_dir"] = path
+            return metrics
+        self.state, metrics = self._step(self.state, gbatch)
+        return metrics
+
+    def _run_instrumented(self, batch, trace_dir):
+        """Telemetry path: host spans around batch staging, per-step wall
+        time closed at a real sync point, watchdog auto-capture."""
+        tel = self._telemetry
+        capture_dir = None
+        with tel.span("shard_batch"):
+            gbatch = self._shard_batch(batch)
+        with tel.span("pre_step"):
+            self._pre_step(gbatch)
+        path = None
+        if trace_dir:
+            path = self._trace_step_dir(trace_dir, self.step)
+        else:
+            capture_dir = tel.arm_capture_dir()
+            if capture_dir:
+                os.makedirs(capture_dir, exist_ok=True)
+                path = capture_dir
+        tel.step_started()
+        if path:
+            with jax.profiler.trace(path):
                 self.state, metrics = self._step(self.state, gbatch)
                 jax.block_until_ready(metrics)
         else:
             self.state, metrics = self._step(self.state, gbatch)
+        tel.step_finished(metrics, gbatch, trace_dir=path,
+                          watchdog_capture=capture_dir is not None)
+        if path:
+            metrics = dict(metrics)
+            metrics["trace_dir"] = path
         return metrics
+
+    @staticmethod
+    def _metrics_log_str(metrics):
+        """Loggable rendering of a step's metrics: the loss when present,
+        otherwise every scalar entry — a model without a ``"loss"`` key
+        must not crash the training loop's progress log."""
+        if isinstance(metrics, dict) and "loss" in metrics:
+            return f"loss={float(metrics['loss'])}"
+        scalars = []
+        if isinstance(metrics, dict):
+            for k, v in metrics.items():
+                try:
+                    if np.ndim(v) == 0:
+                        scalars.append(f"{k}={float(v)}")
+                except (TypeError, ValueError):
+                    continue
+        return " ".join(scalars) if scalars else f"metrics={metrics!r}"
+
+    def finalize_telemetry(self):
+        """Flush the telemetry summary / manifest for this session (no-op
+        when telemetry is off).  ``run_steps`` and ``fit`` call it on
+        exit; call it yourself after a hand-rolled ``run()`` loop."""
+        if self._telemetry is not None:
+            return self._telemetry.finalize()
+        return None
 
     def run_steps(self, batches, log_every=0):
         metrics = None
         for i, b in enumerate(batches):
             metrics = self.run(b)
             if log_every and (i + 1) % log_every == 0:
-                logging.info("step %d: loss=%s", i + 1, float(metrics["loss"]))
+                logging.info("step %d: %s", i + 1,
+                             self._metrics_log_str(metrics))
+        self.finalize_telemetry()
         return metrics
 
     def fit(self, batch_fn, steps, *, checkpoint_path=None, save_every=0,
@@ -321,12 +420,14 @@ class DistributedSession:
             metrics = self.run(batch_fn(step))
             done = self.step
             if log_every and done % log_every == 0:
-                logging.info("step %d: loss=%s", done, float(metrics["loss"]))
+                logging.info("step %d: %s", done,
+                             self._metrics_log_str(metrics))
             if saver and save_every and done % save_every == 0:
                 saver.save(checkpoint_path)
                 last_saved = done
         if saver and self.step != last_saved and metrics is not None:
             saver.save(checkpoint_path)
+        self.finalize_telemetry()
         return metrics
 
     def memory_stats(self):

@@ -124,6 +124,72 @@ def calibrate(pairs):
             "overhead_s": float(max(c, 0.0))}
 
 
+def _jaxpr_of(j):
+    return j.jaxpr if hasattr(j, "jaxpr") and not hasattr(j, "eqns") else j
+
+
+def jaxpr_flops(jaxpr):
+    """Conservative FLOP count of a (closed) jaxpr: matmul + convolution
+    math, control flow folded in structurally (``scan`` multiplies by its
+    trip count, ``cond`` takes the max branch, ``while`` counts its body
+    once — trip counts are data-dependent).  Elementwise ops are ignored:
+    this is the MODEL-FLOPs numerator an MFU wants (the convention
+    bench.py's model-FLOPs figures follow), not XLA's emitted-op count.
+
+    Counted on the jaxpr the engine traces, the ``shard_map`` body
+    carries per-device shapes — so the returned count is per-device work
+    per step (forward + backward both appear in a grad-traced program).
+    """
+    import numpy as np
+
+    j = _jaxpr_of(jaxpr)
+    total = 0.0
+    for eqn in j.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            (lc, _rc), (lb, _rb) = eqn.params["dimension_numbers"]
+            lhs = eqn.invars[0].aval.shape
+            out = eqn.outvars[0].aval.shape
+            contract = 1
+            for d in lc:
+                contract *= lhs[d]
+            total += 2.0 * float(np.prod(out)) * contract if out \
+                else 2.0 * contract
+        elif name == "conv_general_dilated":
+            rhs = eqn.invars[1].aval.shape
+            out = eqn.outvars[0].aval.shape
+            dn = eqn.params["dimension_numbers"]
+            rhs_spec = getattr(dn, "rhs_spec", None)
+            if rhs_spec is not None:
+                in_ch = rhs[rhs_spec[1]]
+                spatial = [rhs[d] for d in rhs_spec[2:]]
+            else:  # fallback: assume OIHW-style (out, in, *spatial)
+                in_ch, spatial = rhs[1], rhs[2:]
+            total += 2.0 * float(np.prod(out)) * in_ch * float(np.prod(spatial))
+        elif name == "scan":
+            total += float(eqn.params.get("length", 1)) * \
+                jaxpr_flops(eqn.params["jaxpr"])
+        elif name == "while":
+            total += jaxpr_flops(eqn.params["body_jaxpr"])
+        elif name == "cond":
+            branches = eqn.params.get("branches", ())
+            total += max((jaxpr_flops(b) for b in branches), default=0.0)
+        else:
+            from autodist_tpu.analysis.jaxpr_utils import subjaxprs
+
+            for sub in subjaxprs(eqn):
+                total += jaxpr_flops(sub)
+    return total
+
+
+def traced_step_flops(transformer, batch_shapes):
+    """Per-device FLOPs of one train step, counted on the abstract trace
+    (:meth:`GraphTransformer.trace_step` — no devices touched, nothing
+    compiled).  The telemetry layer's achieved-MFU numerator."""
+    traced = transformer.trace_step(batch_shapes, donate=False)
+    return jaxpr_flops(traced.jaxpr)
+
+
 def _ring_time(bytes_, n, bw_bytes_per_s):
     """Full allreduce (reduce-scatter + all-gather) ring cost."""
     if n <= 1:
@@ -521,3 +587,76 @@ class RuntimeRecord:
                    resource_yaml=d["resource"],
                    step_time_s=d["step_time_s"],
                    backend=d.get("backend", ""))
+
+
+def _synthetic_record_loss(params, batch):
+    """Quadratic loss over every trainable leaf — differentiable for every
+    variable (the full gradient-sync program traces) and tolerant of
+    engine-provided leaves like ShardedTable."""
+    import jax
+    import jax.numpy as jnp
+
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(params):
+        total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    x = jax.tree.leaves(batch)[0]
+    return total * jnp.mean(jnp.ones_like(x, jnp.float32))
+
+
+def rebuild_record_case(record, loss_fn=None):
+    """Reconstruct ``(strategy, model_item, mesh_R)`` from a
+    :class:`RuntimeRecord` — the variables come back at their recorded
+    shapes/dtypes under a synthetic quadratic loss (the record carries no
+    user code), which is exactly enough for :func:`estimate`, the static
+    verifier, and :func:`hbm_footprint`.  Shared by
+    ``tools/verify_strategy.py`` and :func:`calibrate_from_records`."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.proto import modelitem_pb2, strategy_pb2
+    from autodist_tpu.strategy.base import Strategy
+
+    mdef = modelitem_pb2.ModelItemDef()
+    mdef.ParseFromString(record.model_def)
+    params = {v.name: jnp.zeros(tuple(v.shape), np.dtype(v.dtype))
+              for v in mdef.variables}
+    sparse = [v.name for v in mdef.variables if v.sparse_gradient]
+    item = ModelItem(loss_fn or _synthetic_record_loss, params,
+                     optax.adam(1e-3), sparse_vars=sparse or None)
+    pb = strategy_pb2.Strategy()
+    pb.ParseFromString(record.strategy_pb)
+    R = 1
+    for s in pb.graph_config.mesh.axis_sizes:
+        R *= int(s)
+    return Strategy(pb), item, max(1, R)
+
+
+def calibrate_from_records(records, resource_spec=None, **estimate_kw):
+    """The measured-feedback loop closed from telemetry manifests: rebuild
+    each :class:`RuntimeRecord`'s (strategy, model) case, price it with
+    :func:`estimate`, and :func:`calibrate` against the measured step
+    times.  ``records`` may be RuntimeRecord objects or paths to their
+    JSON dumps.  Returns ``(calibration, pairs)``.
+
+    Mixed-backend record sets raise: a CPU pipeline artifact averaged
+    into TPU measurements would silently skew every coefficient (the
+    same hygiene RuntimeRecord's ``backend`` label exists for).
+    """
+    recs = [RuntimeRecord.load(r) if isinstance(r, str) else r
+            for r in records]
+    backends = {r.backend for r in recs if r.backend}
+    if len(backends) > 1:
+        raise ValueError(
+            f"refusing to calibrate across mixed backends {sorted(backends)}; "
+            f"filter records to one backend first")
+    pairs = []
+    for rec in recs:
+        strategy, item, R = rebuild_record_case(rec)
+        from autodist_tpu.resource_spec import ResourceSpec
+
+        spec = resource_spec or ResourceSpec.from_num_chips(R)
+        pairs.append((estimate(strategy, item, spec, **estimate_kw),
+                      rec.step_time_s))
+    return calibrate(pairs), pairs

@@ -69,6 +69,7 @@ class AutoStrategy(StrategyBuilder):
         self._calibration = calibration
         self.last_ranking = None
         self.last_rejected = None
+        self.last_prediction_error = None
 
     def _screen(self, cands, model_item, resource_spec):
         """Verifier feasibility gate: (feasible builders, rejected list)."""
@@ -115,3 +116,38 @@ class AutoStrategy(StrategyBuilder):
                      name, cost * 1e3,
                      [(n, round(c * 1e3, 3)) for n, c in self.last_ranking])
         return strategy
+
+    def note_measured(self, measured_step_s, name=None):
+        """Close the predicted-vs-measured loop: compare a real step time
+        (e.g. the telemetry manifest's ``step_time_p50_s``, or a
+        RuntimeRecord's ``step_time_s``) against this builder's ranked
+        prediction for the chosen — or ``name``d — candidate.
+
+        Logs and returns the signed relative error
+        ``(predicted - measured) / measured`` and records it in
+        ``last_prediction_error`` + the telemetry gauge
+        ``auto_strategy.prediction_error``; large errors are the signal
+        to refit (``cost_model.calibrate_from_records``) and pass the
+        result back in as ``calibration=``.
+        """
+        if not self.last_ranking:
+            raise RuntimeError("note_measured before build(): no ranking yet")
+        ranked = dict((n, c) for n, c in self.last_ranking)
+        if name is None:
+            name = self.last_ranking[0][0]
+        if name not in ranked:
+            raise KeyError(f"{name!r} not in ranking {sorted(ranked)}")
+        predicted = ranked[name]
+        err = (predicted - measured_step_s) / max(measured_step_s, 1e-12)
+        self.last_prediction_error = {
+            "strategy": name, "predicted_s": predicted,
+            "measured_s": float(measured_step_s), "rel_error": err}
+        from autodist_tpu import telemetry
+
+        telemetry.gauge("auto_strategy.prediction_error", err, strategy=name)
+        logging.info(
+            "AutoStrategy %s: predicted %.4fms vs measured %.4fms/step "
+            "(rel error %+.1f%%)%s", name, predicted * 1e3,
+            measured_step_s * 1e3, err * 100,
+            " — consider calibrate_from_records()" if abs(err) > 0.5 else "")
+        return err

@@ -1,0 +1,134 @@
+"""Runtime telemetry: per-step metrics, span tracing, worker aggregation.
+
+The observability layer of the stack (``docs/observability.md``):
+
+- :mod:`~autodist_tpu.telemetry.metrics` — zero-dep counters / gauges /
+  histograms in a bounded ring, JSONL export per host;
+- :mod:`~autodist_tpu.telemetry.spans` — ``telemetry.span("name")``
+  host spans, Chrome-trace/Perfetto compatible, joinable with
+  ``jax.profiler`` device traces via ``tools/trace_summary.py``;
+- :mod:`~autodist_tpu.telemetry.session` — per-step session
+  instrumentation (wall time, throughput, achieved MFU, memory
+  snapshots, compile split) for :class:`DistributedSession`;
+- :mod:`~autodist_tpu.telemetry.watchdog` — slow-step auto-capture;
+- :mod:`~autodist_tpu.telemetry.aggregate` — chief-side merge of
+  per-worker manifests;
+- :mod:`~autodist_tpu.telemetry.schema` — the JSONL schema + validator
+  (``make telemetry-check``).
+
+**Off by default.**  Enable per process with ``AUTODIST_TELEMETRY=1``
+(workers launched by the chief inherit it through the worker-env
+contract) or per session with ``telemetry.enable(run_dir=...)``.  When
+disabled, the facade functions below are constant-time no-ops and
+``DistributedSession.run`` takes its uninstrumented hot path — no
+device sync, no file I/O (pinned by
+``tests/test_telemetry.py::test_disabled_zero_overhead``).
+"""
+import contextlib
+import os
+import time
+
+from autodist_tpu.telemetry.aggregate import (load_manifest,
+                                              merge_worker_manifests)
+from autodist_tpu.telemetry.metrics import (JsonlWriter, MetricsRegistry,
+                                            percentiles)
+from autodist_tpu.telemetry.schema import validate_manifest
+from autodist_tpu.telemetry.spans import SpanRecorder, dump_chrome_trace
+from autodist_tpu.telemetry.watchdog import SlowStepWatchdog
+
+__all__ = [
+    "enabled", "enable", "disable", "get_registry", "reset_registry",
+    "counter", "gauge", "histogram", "span", "default_run_dir",
+    "MetricsRegistry", "JsonlWriter", "SpanRecorder", "SlowStepWatchdog",
+    "SessionTelemetry", "dump_chrome_trace", "percentiles",
+    "validate_manifest", "merge_worker_manifests", "load_manifest",
+]
+
+_STATE = {
+    "enabled": os.environ.get("AUTODIST_TELEMETRY", "") in ("1", "True"),
+    "run_dir": os.environ.get("AUTODIST_TELEMETRY_DIR", "") or None,
+    "registry": None,
+}
+
+
+def enabled():
+    return _STATE["enabled"]
+
+
+def enable(run_dir=None):
+    """Turn telemetry on for this process (sessions built afterwards are
+    instrumented; facade counters/gauges/spans start recording)."""
+    _STATE["enabled"] = True
+    if run_dir:
+        _STATE["run_dir"] = os.path.abspath(run_dir)
+
+
+def disable():
+    _STATE["enabled"] = False
+
+
+def configured_run_dir():
+    return _STATE["run_dir"]
+
+
+def default_run_dir(run_id):
+    """Run directory for a run id: the configured dir (env/enable()) or
+    ``DEFAULT_TRACE_DIR/telemetry/<run_id>``."""
+    if _STATE["run_dir"]:
+        return _STATE["run_dir"]
+    from autodist_tpu.const import DEFAULT_TRACE_DIR
+
+    return os.path.join(DEFAULT_TRACE_DIR, "telemetry", str(run_id))
+
+
+def get_registry():
+    """The process-global registry (created on first use)."""
+    reg = _STATE["registry"]
+    if reg is None:
+        reg = _STATE["registry"] = MetricsRegistry()
+    return reg
+
+
+def reset_registry():
+    """Fresh process-global registry (test isolation)."""
+    _STATE["registry"] = MetricsRegistry()
+    return _STATE["registry"]
+
+
+# -- cheap facade: constant-time no-ops when disabled -----------------------
+
+def counter(name, value=1.0, **labels):
+    if _STATE["enabled"]:
+        get_registry().counter(name, value, **labels)
+
+
+def gauge(name, value, **labels):
+    if _STATE["enabled"]:
+        get_registry().gauge(name, value, **labels)
+
+
+def histogram(name, value, **labels):
+    if _STATE["enabled"]:
+        get_registry().histogram(name, value, **labels)
+
+
+def span(name, **args):
+    """``with telemetry.span("shard_batch"):`` — a recorded host span when
+    enabled, a null context otherwise."""
+    if not _STATE["enabled"]:
+        return contextlib.nullcontext()
+    return SpanRecorder(get_registry()).span(name, **args)
+
+
+def new_run_id():
+    return time.strftime("%Y%m%d%H%M%S") + f"-{os.getpid()}"
+
+
+def __getattr__(name):
+    # SessionTelemetry pulls in jax-adjacent imports; load lazily so the
+    # facade stays import-light for processes that never instrument
+    if name == "SessionTelemetry":
+        from autodist_tpu.telemetry.session import SessionTelemetry
+
+        return SessionTelemetry
+    raise AttributeError(name)

@@ -1,0 +1,72 @@
+"""Cross-worker aggregation: merge per-host JSONL into one run manifest.
+
+Every host writes its own ``worker_<rank>.jsonl`` under the run
+directory (the same shared-filesystem assumption the strategy handoff
+already makes — ``AutoDist.launch`` docs); the chief merges them into
+``manifest.jsonl``, time-ordered, each line still carrying its ``w``
+rank.  ``tools/telemetry_report.py`` and the schema validator consume
+either a single worker file or the merged manifest.
+"""
+import glob
+import json
+import os
+
+MANIFEST_NAME = "manifest.jsonl"
+WORKER_GLOB = "worker_*.jsonl"
+
+
+def worker_manifest_paths(run_dir):
+    return sorted(glob.glob(os.path.join(run_dir, WORKER_GLOB)))
+
+
+def _parse_lines(path):
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                # a torn final line from a crashed writer must not poison
+                # the merge; the validator reports it separately
+                continue
+    return records
+
+
+def merge_worker_manifests(run_dir, out_path=None):
+    """Merge every ``worker_*.jsonl`` under ``run_dir`` into one
+    time-ordered ``manifest.jsonl``; returns the manifest path (or None
+    when there is nothing to merge)."""
+    paths = worker_manifest_paths(run_dir)
+    if not paths:
+        return None
+    records = []
+    for p in paths:
+        records.extend(_parse_lines(p))
+    # stable sort: equal timestamps keep per-worker file order
+    records.sort(key=lambda r: r.get("t", 0.0))
+    out_path = out_path or os.path.join(run_dir, MANIFEST_NAME)
+    with open(out_path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return out_path
+
+
+def load_manifest(path):
+    """Load manifest records from a file or a run directory.
+
+    A directory prefers its merged ``manifest.jsonl``; if absent, the
+    worker files are merged in memory (read-only — nothing is written).
+    """
+    if os.path.isdir(path):
+        merged = os.path.join(path, MANIFEST_NAME)
+        if os.path.exists(merged):
+            return _parse_lines(merged)
+        records = []
+        for p in worker_manifest_paths(path):
+            records.extend(_parse_lines(p))
+        records.sort(key=lambda r: r.get("t", 0.0))
+        return records
+    return _parse_lines(path)

@@ -1,0 +1,206 @@
+"""Zero-dependency metrics registry: counters / gauges / histograms.
+
+The runtime counterpart of the static layers (``simulator/cost_model``
+predicts, ``analysis/`` verifies — this module *observes*).  Design
+constraints, in order:
+
+1. **Hot-path cheap when disabled** — the facade in
+   :mod:`autodist_tpu.telemetry` short-circuits on a module bool before
+   any call reaches a registry, and the session keeps telemetry entirely
+   out of ``DistributedSession.run`` when off (guarded by
+   ``tests/test_telemetry.py::test_disabled_zero_overhead``).
+2. **Bounded** — raw events live in a ring buffer (``deque(maxlen=...)``)
+   and histogram reservoirs are capped, so a million-step run cannot grow
+   host memory without bound.
+3. **Zero-dep, append-only JSONL** — one JSON object per line, schema in
+   :mod:`autodist_tpu.telemetry.schema`; a crash mid-run leaves a valid
+   prefix on disk (each line is flushed), which is what the chief's
+   cross-worker merge and ``tools/telemetry_report.py`` consume.
+"""
+import json
+import os
+import threading
+import time
+from collections import deque
+
+# raw-event ring capacity (per registry) and per-histogram reservoir cap
+DEFAULT_RING_CAPACITY = 4096
+DEFAULT_HIST_CAPACITY = 1024
+
+
+def _label_key(labels):
+    """Stable hashable identity for a label dict."""
+    return tuple(sorted(labels.items()))
+
+
+def percentiles(values, qs=(0.5, 0.9, 0.99)):
+    """Nearest-rank percentiles of ``values`` (no numpy needed, but exact
+    enough for step-time reporting); returns {q: value}."""
+    if not values:
+        return {q: None for q in qs}
+    xs = sorted(values)
+    out = {}
+    for q in qs:
+        idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+        out[q] = xs[idx]
+    return out
+
+
+class MetricsRegistry:
+    """Thread-safe counters/gauges/histograms + a bounded raw-event ring.
+
+    Aggregated state answers "what is the value now"
+    (:meth:`aggregates`); the ring answers "what happened, in order"
+    (:meth:`events` / :meth:`export_jsonl`).  Both are bounded.
+    """
+
+    def __init__(self, capacity=DEFAULT_RING_CAPACITY,
+                 hist_capacity=DEFAULT_HIST_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=int(capacity))
+        self._hist_cap = int(hist_capacity)
+        self._counters = {}
+        self._gauges = {}
+        self._hists = {}
+        self.dropped = 0  # events evicted from the ring (bounded-buffer loss)
+
+    # -- write side --------------------------------------------------------
+
+    def _emit(self, rec):
+        if len(self._ring) == self._ring.maxlen:
+            self.dropped += 1
+        self._ring.append(rec)
+
+    def counter(self, name, value=1.0, **labels):
+        with self._lock:
+            key = (name, _label_key(labels))
+            self._counters[key] = self._counters.get(key, 0.0) + value
+            self._emit({"kind": "counter", "name": name, "value": value,
+                        "total": self._counters[key], "t": time.time(),
+                        **({"labels": labels} if labels else {})})
+
+    def gauge(self, name, value, **labels):
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = value
+            self._emit({"kind": "gauge", "name": name, "value": value,
+                        "t": time.time(),
+                        **({"labels": labels} if labels else {})})
+
+    def histogram(self, name, value, **labels):
+        with self._lock:
+            key = (name, _label_key(labels))
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = deque(maxlen=self._hist_cap)
+            h.append(float(value))
+            self._emit({"kind": "hist", "name": name, "value": float(value),
+                        "t": time.time(),
+                        **({"labels": labels} if labels else {})})
+
+    def event(self, kind, **fields):
+        """Structured raw event (step records, span records, snapshots)."""
+        with self._lock:
+            self._emit({"kind": kind, "t": fields.pop("t", time.time()),
+                        **fields})
+
+    # -- read side ---------------------------------------------------------
+
+    def events(self, kind=None):
+        with self._lock:
+            evs = list(self._ring)
+        return [e for e in evs if kind is None or e["kind"] == kind]
+
+    def counter_value(self, name, **labels):
+        with self._lock:
+            return self._counters.get((name, _label_key(labels)), 0.0)
+
+    def gauge_value(self, name, default=None, **labels):
+        with self._lock:
+            return self._gauges.get((name, _label_key(labels)), default)
+
+    def aggregates(self):
+        """Aggregated snapshot: counter totals, gauge values, histogram
+        summaries (count / min / max / p50 / p90 / p99)."""
+        with self._lock:
+            counters = {self._fmt_key(k): v for k, v in self._counters.items()}
+            gauges = {self._fmt_key(k): v for k, v in self._gauges.items()}
+            hists = {}
+            for k, vals in self._hists.items():
+                vals = list(vals)
+                ps = percentiles(vals)
+                hists[self._fmt_key(k)] = {
+                    "count": len(vals),
+                    "min": min(vals) if vals else None,
+                    "max": max(vals) if vals else None,
+                    "p50": ps[0.5], "p90": ps[0.9], "p99": ps[0.99],
+                }
+        return {"counters": counters, "gauges": gauges, "histograms": hists,
+                "dropped_events": self.dropped}
+
+    @staticmethod
+    def _fmt_key(key):
+        name, labels = key
+        if not labels:
+            return name
+        return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+    def export_jsonl(self, path, meta=None):
+        """Write the full ring (+ optional leading meta record) as JSONL."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            if meta is not None:
+                f.write(json.dumps({"kind": "meta", **meta}) + "\n")
+            for e in self.events():
+                f.write(json.dumps(e, default=_json_default) + "\n")
+        return path
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            self.dropped = 0
+
+
+def _json_default(o):
+    """Tolerate numpy scalars / arrays sneaking into a record."""
+    if hasattr(o, "item"):
+        try:
+            return o.item()
+        except Exception:
+            pass
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    return str(o)
+
+
+class JsonlWriter:
+    """Append-only, line-flushed JSONL file — per-step records persist as
+    they happen, so a crashed run still leaves a readable manifest prefix.
+
+    Every record is annotated with this writer's ``worker`` rank and
+    ``pid`` (if not already present) so the chief's cross-worker merge
+    can attribute lines after concatenation.
+    """
+
+    def __init__(self, path, worker=0):
+        self.path = os.path.abspath(path)
+        self.worker = int(worker)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._f = open(self.path, "a")
+        self._lock = threading.Lock()
+
+    def write(self, rec):
+        rec = dict(rec)
+        rec.setdefault("w", self.worker)
+        rec.setdefault("pid", os.getpid())
+        line = json.dumps(rec, default=_json_default)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()

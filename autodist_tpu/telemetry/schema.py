@@ -1,0 +1,106 @@
+"""The documented JSONL manifest schema + validator.
+
+One JSON object per line.  Every record carries:
+
+- ``kind``  — record type (below)
+- ``t``     — unix wall-clock seconds (float)
+- ``w``     — worker rank (added by the per-host writer)
+- ``pid``   — producing process id
+
+Kinds and their required fields (``docs/observability.md`` is the prose
+version; ``make telemetry-check`` asserts a live run validates):
+
+- ``meta``      — run header: ``run_id``, ``backend``, ``num_devices``
+- ``step``      — per-step record: ``step``, ``wall_s``; optional
+                  ``wall_cancelled_s``, ``throughput_eps``, ``mfu``,
+                  ``examples``, ``compile_s``, ``trace_dir``
+- ``snapshot``  — memory snapshot: ``step``, ``devices`` (per-device
+                  stats dict or null entries on backends without
+                  ``memory_stats``); optional ``peak_bytes``
+- ``span``      — host span: ``name``, ``ts``, ``dur``
+- ``counter`` / ``gauge`` / ``hist`` — ``name``, ``value``
+- ``watchdog``  — slow-step capture: ``step``, ``trace_dir``
+- ``summary``   — run trailer: ``steps``, ``step_time_p50_s``;
+                  optional ``mfu_p50``, ``compile_s``,
+                  ``runtime_record``, ``aggregates``
+"""
+import json
+
+REQUIRED_COMMON = ("kind",)
+
+REQUIRED_BY_KIND = {
+    "meta": ("run_id", "backend", "num_devices"),
+    "step": ("step", "wall_s"),
+    "snapshot": ("step", "devices"),
+    "span": ("name", "ts", "dur"),
+    "counter": ("name", "value"),
+    "gauge": ("name", "value"),
+    "hist": ("name", "value"),
+    "watchdog": ("step", "trace_dir"),
+    "summary": ("steps", "step_time_p50_s"),
+}
+
+NUMERIC_FIELDS = {
+    "step": ("step", "wall_s", "wall_cancelled_s", "throughput_eps", "mfu",
+             "examples", "compile_s"),
+    "summary": ("steps", "step_time_p50_s", "mfu_p50", "compile_s"),
+    "span": ("ts", "dur"),
+}
+
+
+def validate_record(rec):
+    """Validate one parsed manifest record; returns a list of problems."""
+    errs = []
+    if not isinstance(rec, dict):
+        return [f"record is not an object: {type(rec).__name__}"]
+    kind = rec.get("kind")
+    if kind is None:
+        return ["missing 'kind'"]
+    required = REQUIRED_BY_KIND.get(kind)
+    if required is None:
+        # unknown kinds are tolerated (forward compatibility) but must at
+        # least be tagged records
+        return errs
+    for field in required:
+        if field not in rec:
+            errs.append(f"{kind}: missing required field '{field}'")
+    for field in NUMERIC_FIELDS.get(kind, ()):
+        v = rec.get(field)
+        if v is not None and field in rec and not isinstance(v, (int, float)):
+            errs.append(f"{kind}.{field}: expected number, got {type(v).__name__}")
+    return errs
+
+
+def validate_lines(lines):
+    """Validate an iterable of JSONL lines; returns (records, errors)."""
+    records, errors = [], []
+    for i, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            errors.append(f"line {i}: invalid JSON ({e})")
+            continue
+        for msg in validate_record(rec):
+            errors.append(f"line {i}: {msg}")
+        records.append(rec)
+    return records, errors
+
+
+def validate_manifest(path, require_steps=False):
+    """Validate a manifest file; returns (records, errors).
+
+    ``require_steps`` additionally demands at least one ``meta``, one
+    ``step`` and one ``snapshot`` record (the shape ``make
+    telemetry-check`` asserts for a live run).
+    """
+    with open(path) as f:
+        records, errors = validate_lines(f)
+    if require_steps:
+        kinds = {r.get("kind") for r in records}
+        for needed in ("meta", "step", "snapshot"):
+            if needed not in kinds:
+                errors.append(f"manifest has no '{needed}' record")
+    return records, errors

@@ -1,0 +1,317 @@
+"""Per-session runtime instrumentation (the DistributedSession hook).
+
+What one instrumented step records (a ``step`` JSONL line):
+
+- ``wall_s`` — dispatch-to-fetch wall time, made *honest* with the
+  discipline of :mod:`autodist_tpu.utils.timing`: the step is closed by
+  fetching one device scalar (bytes prove completion, even where
+  ``block_until_ready`` is a no-op on tunneled backends), and the
+  constant fetch round-trip — measured once by re-fetching the same
+  already-materialized scalar — is subtracted out as
+  ``wall_cancelled_s`` (the RTT-cancelled per-step figure, clamped at 0).
+- ``throughput_eps`` — global examples/second from the batch's leading
+  dimension.
+- ``mfu`` — achieved model-FLOPs utilization against
+  :data:`~autodist_tpu.utils.timing.PEAK_BF16_FLOPS`: the numerator is a
+  per-device FLOP count of the *traced* step
+  (:func:`autodist_tpu.simulator.cost_model.traced_step_flops` — the
+  shard_map body jaxpr carries per-device shapes, so the count is
+  per-chip work including the backward pass), computed once per session.
+- first step carries compile+execute; the compile-vs-execute split is
+  estimated at finalize as ``first_wall - median(steady walls)``.
+
+Plus periodic ``snapshot`` records (``memory_stats`` per device, peak
+summarized), host ``span`` records, the slow-step watchdog's
+``watchdog`` capture events, and a ``summary`` trailer with step-time
+percentiles and the registry aggregates.  At finalize the measured
+steady-state median is exported as an AutoSync-style
+:class:`~autodist_tpu.simulator.cost_model.RuntimeRecord` so
+``cost_model.calibrate()`` can refit from this run
+(``docs/observability.md``).
+"""
+import os
+import time
+
+from autodist_tpu.utils import logging
+
+
+class SessionTelemetry:
+    def __init__(self, transformer, *, run_dir=None, run_id=None,
+                 registry=None, mem_every=5, watchdog=None, mem_fn=None,
+                 worker=None):
+        from autodist_tpu import telemetry
+        from autodist_tpu.const import ENV
+        from autodist_tpu.telemetry.metrics import JsonlWriter
+        from autodist_tpu.telemetry.spans import SpanRecorder
+        from autodist_tpu.telemetry.watchdog import SlowStepWatchdog
+
+        self._t = transformer
+        self.run_id = run_id or getattr(
+            getattr(transformer, "strategy", None), "id", None) or \
+            time.strftime("%Y%m%d%H%M%S") + f"-{os.getpid()}"
+        self.run_dir = run_dir or telemetry.default_run_dir(self.run_id)
+        self.worker = int(ENV.AUTODIST_PROCESS_ID.val if worker is None
+                          else worker)
+        self.registry = registry or telemetry.get_registry()
+        self.spans = SpanRecorder(self.registry)
+        self._writer = JsonlWriter(
+            os.path.join(self.run_dir, f"worker_{self.worker}.jsonl"),
+            worker=self.worker)
+        self._mem_every = max(1, int(mem_every))
+        self._mem_fn = mem_fn
+        if watchdog is None:
+            wd_env = os.environ.get("AUTODIST_TELEMETRY_WATCHDOG", "1")
+            watchdog = None if wd_env in ("0", "False") else SlowStepWatchdog(
+                multiple=float(os.environ.get(
+                    "AUTODIST_TELEMETRY_WATCHDOG_MULT", "3.0")))
+        self.watchdog = watchdog or None
+        self._n = 0                    # instrumented steps completed
+        self._t0 = None
+        self._rtt_s = None
+        self._first_wall = None
+        self._walls = []               # steady-state RTT-cancelled walls
+        self._mfus = []
+        self._flops_per_device = None  # lazy; None = not yet / failed
+        self._flops_failed = False
+        self.finalized = False
+        self._write_meta()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _write_meta(self):
+        import jax
+
+        devices = list(self._t.mesh.devices.flat)
+        meta = {
+            "kind": "meta", "t": time.time(), "run_id": self.run_id,
+            "backend": jax.default_backend(),
+            "num_devices": len(devices),
+            "device_kind": getattr(devices[0], "device_kind", "?"),
+            "sync_schedule": getattr(self._t, "sync_schedule", None),
+            "run_dir": self.run_dir,
+        }
+        est = self._predicted_estimate()
+        if est is not None:
+            meta["cost_estimate"] = est
+        self._writer.write(meta)
+
+    def _predicted_estimate(self):
+        """Analytic cost-model prediction for this session's strategy on a
+        same-size single-node spec — recorded so the report can show
+        predicted-vs-measured and the overlap credit next to real walls."""
+        try:
+            from autodist_tpu.resource_spec import ResourceSpec
+            from autodist_tpu.simulator.cost_model import estimate
+
+            R = len(list(self._t.mesh.devices.flat))
+            est = estimate(self._t.strategy, self._t.model_item,
+                           ResourceSpec.from_num_chips(R))
+            return est.to_json()
+        except Exception:
+            return None
+
+    def span(self, name, **args):
+        return self.spans.span(name, **args)
+
+    # -- per-step hooks (called by DistributedSession.run) -----------------
+
+    def step_started(self):
+        self._t0 = time.perf_counter()
+
+    def arm_capture_dir(self):
+        """Watchdog-armed one-step profiler dir for the upcoming step, or
+        None.  Consumes the armed flag."""
+        if self.watchdog is None or not self.watchdog.should_capture():
+            return None
+        return os.path.join(self.run_dir, "watchdog", f"step_{self._n}")
+
+    def _sync_metrics(self, metrics):
+        """Close the step at a REAL synchronization point: fetch one device
+        scalar (prefer the loss).  Returns the RTT estimate measured by
+        re-fetching the already-materialized scalar (once, first step)."""
+        from autodist_tpu.utils.timing import fetch_scalar
+
+        leaf = None
+        if isinstance(metrics, dict) and "loss" in metrics:
+            leaf = metrics["loss"]
+        else:
+            import jax
+
+            for x in jax.tree.leaves(metrics):
+                leaf = x
+                break
+        if leaf is None:
+            return
+        try:
+            fetch_scalar(leaf)
+            if self._rtt_s is None:
+                t0 = time.perf_counter()
+                fetch_scalar(leaf)
+                self._rtt_s = time.perf_counter() - t0
+        except Exception:
+            pass
+
+    def _ensure_flops(self, gbatch):
+        if self._flops_per_device is not None or self._flops_failed:
+            return self._flops_per_device
+        try:
+            import jax
+
+            from autodist_tpu.simulator.cost_model import traced_step_flops
+
+            batch_shapes = jax.tree.map(
+                lambda x: (tuple(x.shape), str(x.dtype)), gbatch)
+            self._flops_per_device = traced_step_flops(self._t, batch_shapes)
+        except Exception as e:
+            self._flops_failed = True
+            logging.debug("telemetry: traced FLOP count unavailable (%s)", e)
+        return self._flops_per_device
+
+    @staticmethod
+    def _batch_examples(gbatch):
+        import jax
+
+        for x in jax.tree.leaves(gbatch):
+            if getattr(x, "ndim", 0) >= 1:
+                return int(x.shape[0])
+        return None
+
+    def step_finished(self, metrics, gbatch=None, trace_dir=None,
+                      watchdog_capture=False):
+        """Record one completed step; returns the step record dict."""
+        from autodist_tpu.utils.timing import peak_flops
+
+        self._sync_metrics(metrics)
+        wall = time.perf_counter() - self._t0 if self._t0 is not None else 0.0
+        self._t0 = None
+        step = self._n
+        self._n += 1
+        rtt = self._rtt_s or 0.0
+        cancelled = max(0.0, wall - rtt)
+        eff = cancelled if cancelled > 0 else wall
+        rec = {"kind": "step", "t": time.time(), "step": step,
+               "wall_s": wall, "wall_cancelled_s": cancelled}
+        examples = self._batch_examples(gbatch) if gbatch is not None else None
+        if examples:
+            rec["examples"] = examples
+            if eff > 0:
+                rec["throughput_eps"] = examples / eff
+        flops = self._ensure_flops(gbatch) if gbatch is not None else None
+        if flops and eff > 0:
+            peak, assumed = peak_flops()
+            mfu = flops / (eff * peak)
+            rec["mfu"] = mfu
+            rec["flops_per_device"] = flops
+            rec["peak_flops"] = peak
+            rec["peak_assumed"] = assumed
+            self._mfus.append(mfu)
+        if trace_dir:
+            rec["trace_dir"] = trace_dir
+        if step == 0:
+            self._first_wall = cancelled
+        else:
+            self._walls.append(cancelled)
+        self._writer.write(rec)
+        self.registry.histogram("session.step_wall_s", wall)
+        if self.watchdog is not None and not watchdog_capture:
+            if self.watchdog.observe(step, wall):
+                s, w, med = self.watchdog.last_trigger
+                logging.warning(
+                    "telemetry watchdog: step %d took %.3fs (> %.1fx rolling "
+                    "median %.3fs); arming one-step profiler capture.",
+                    s, w, self.watchdog.multiple, med)
+        if watchdog_capture and trace_dir:
+            self._writer.write({"kind": "watchdog", "t": time.time(),
+                                "step": step, "trace_dir": trace_dir})
+            self.registry.counter("session.watchdog_captures")
+        if step == 0 or (step + 1) % self._mem_every == 0:
+            self._memory_snapshot(step)
+        return rec
+
+    def _memory_snapshot(self, step):
+        if self._mem_fn is None:
+            return
+        try:
+            stats = self._mem_fn()
+        except Exception:
+            return
+        peak = None
+        for s in (stats or {}).values():
+            if isinstance(s, dict):
+                p = s.get("peak_bytes_in_use", s.get("bytes_in_use"))
+                if p is not None:
+                    peak = max(peak or 0, int(p))
+        rec = {"kind": "snapshot", "t": time.time(), "step": step,
+               "devices": stats}
+        if peak is not None:
+            rec["peak_bytes"] = peak
+            self.registry.gauge("session.hbm_peak_bytes", peak)
+        self._writer.write(rec)
+
+    # -- run trailer -------------------------------------------------------
+
+    def finalize(self):
+        """Write the summary trailer, dump host spans + the measured
+        RuntimeRecord, and (on the chief) merge worker manifests.
+        Idempotent — safe to call after every run_steps/fit."""
+        from autodist_tpu.telemetry.aggregate import merge_worker_manifests
+        from autodist_tpu.telemetry.metrics import percentiles
+        from autodist_tpu.telemetry.spans import dump_chrome_trace
+
+        if self._n == 0:
+            return None
+        walls = self._walls or (
+            [self._first_wall] if self._first_wall is not None else [])
+        ps = percentiles(walls)
+        summary = {"kind": "summary", "t": time.time(), "steps": self._n,
+                   "step_time_p50_s": ps[0.5], "step_time_p90_s": ps[0.9],
+                   "step_time_p99_s": ps[0.99]}
+        if self._rtt_s is not None:
+            summary["rtt_s"] = self._rtt_s
+        if self._walls and self._first_wall is not None:
+            summary["compile_s"] = max(0.0, self._first_wall - ps[0.5])
+        if self._mfus:
+            summary["mfu_p50"] = percentiles(self._mfus)[0.5]
+        rec_path = self._dump_runtime_record(ps[0.5])
+        if rec_path:
+            summary["runtime_record"] = rec_path
+        span_records = self.spans.events()
+        if span_records:
+            summary["host_spans"] = dump_chrome_trace(
+                span_records,
+                os.path.join(self.run_dir,
+                             f"host_spans_worker_{self.worker}.trace.json"))
+        summary["aggregates"] = self.registry.aggregates()
+        self._writer.write(summary)
+        manifest = None
+        if self.worker == 0:
+            manifest = merge_worker_manifests(self.run_dir)
+        self.finalized = True
+        logging.info("telemetry: run %s — %d steps, p50 %.4fs (manifest: %s)",
+                     self.run_id, self._n, ps[0.5] or 0.0,
+                     manifest or self._writer.path)
+        return manifest or self._writer.path
+
+    def _dump_runtime_record(self, step_time_s):
+        """Measured-feedback loop: export this run as an AutoSync-style
+        RuntimeRecord that ``cost_model.calibrate_from_records`` refits
+        from (CPU-backend records stay pipeline artifacts, never hardware
+        claims — the backend label travels with the record)."""
+        if not step_time_s or step_time_s <= 0:
+            return None
+        try:
+            import jax
+
+            from autodist_tpu.simulator.cost_model import RuntimeRecord
+
+            rec = RuntimeRecord(
+                model_def=self._t.model_item.serialize(),
+                strategy_pb=self._t.strategy.proto.SerializeToString(),
+                resource_yaml="",
+                step_time_s=float(step_time_s),
+                backend=jax.default_backend())
+            return rec.dump(os.path.join(
+                self.run_dir, f"runtime_record_worker_{self.worker}.json"))
+        except Exception as e:
+            logging.debug("telemetry: RuntimeRecord export failed (%s)", e)
+            return None

@@ -1,0 +1,71 @@
+"""Host-side span tracing, Chrome-trace / Perfetto compatible.
+
+``jax.profiler.trace`` captures *device* lanes; this module is the
+*host* complement: ``with spans.span("shard_batch"):`` records a
+complete-event (``ph: "X"``) with microsecond wall-clock timestamps, so
+a dumped span file loads in Perfetto / ``chrome://tracing`` next to a
+device trace from the same run, and ``tools/trace_summary.py
+--host-spans`` can join the two timelines (device time under each host
+span).
+
+Timestamps are ``time.time_ns() // 1000`` — wall-clock microseconds,
+the same timebase the profiler's chrome export uses — so host and
+device lanes line up without a clock-translation step.  Durations are
+measured with ``perf_counter`` (monotonic) to stay immune to wall-clock
+steps mid-span.
+"""
+import contextlib
+import os
+import threading
+import time
+
+
+class SpanRecorder:
+    """Collects chrome-trace complete events into a registry ring."""
+
+    def __init__(self, registry):
+        self._registry = registry
+
+    @contextlib.contextmanager
+    def span(self, name, cat="host", **args):
+        ts_us = time.time_ns() // 1000
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur_us = (time.perf_counter() - t0) * 1e6
+            self._registry.event(
+                "span", name=name, cat=cat, ts=ts_us, dur=dur_us,
+                pid=os.getpid(), tid=threading.get_ident(),
+                **({"args": args} if args else {}))
+
+    def events(self):
+        return self._registry.events("span")
+
+
+def to_chrome_events(span_records, process_name="autodist_tpu host"):
+    """Registry span records -> chrome-trace event list (with the
+    ``process_name`` metadata events viewers use to label lanes)."""
+    pids = sorted({r.get("pid", 0) for r in span_records})
+    events = [{"ph": "M", "name": "process_name", "pid": pid,
+               "args": {"name": f"{process_name} (pid {pid})"}}
+              for pid in pids]
+    for r in span_records:
+        events.append({
+            "ph": "X", "name": r.get("name", "?"), "cat": r.get("cat", "host"),
+            "ts": r.get("ts", 0), "dur": r.get("dur", 0.0),
+            "pid": r.get("pid", 0), "tid": r.get("tid", 0),
+            "args": r.get("args", {}),
+        })
+    return events
+
+
+def dump_chrome_trace(span_records, path, process_name="autodist_tpu host"):
+    """Write span records as a chrome-trace JSON file; returns the path."""
+    import json
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": to_chrome_events(span_records, process_name),
+                   "displayTimeUnit": "ms"}, f)
+    return path

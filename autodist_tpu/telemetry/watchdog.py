@@ -1,0 +1,77 @@
+"""Slow-step watchdog: auto-capture a profiler window on outlier steps.
+
+A production run cannot afford an always-on ``jax.profiler.trace`` (the
+capture itself costs time and disk), but the step you most want a trace
+of is exactly the anomalous one.  The compromise: watch the rolling
+median of recent step times and, when a step exceeds
+``multiple x median``, arm a one-step capture — the *next* step runs
+under ``jax.profiler.trace`` (the slow step itself has already passed;
+persistent slowness is what the capture documents, and a one-off spike
+is recorded as a ``watchdog`` manifest event either way).
+
+Knobs (constructor args; env overrides via the session:
+``AUTODIST_TELEMETRY_WATCHDOG=0`` disables,
+``AUTODIST_TELEMETRY_WATCHDOG_MULT`` sets the multiple):
+
+- ``multiple``   — trigger threshold over the rolling median (default 3.0)
+- ``window``     — rolling window length in steps (default 32)
+- ``min_steps``  — observations before the watchdog may trigger (default
+                   5; the first steps include compile and warmup noise)
+- ``cooldown``   — steps after a capture before re-arming (default 20)
+- ``max_captures`` — lifetime capture budget (default 4; disk-bounded)
+"""
+from collections import deque
+
+
+class SlowStepWatchdog:
+    def __init__(self, multiple=3.0, window=32, min_steps=5, cooldown=20,
+                 max_captures=4):
+        self.multiple = float(multiple)
+        self.min_steps = int(min_steps)
+        self.cooldown = int(cooldown)
+        self.max_captures = int(max_captures)
+        self._times = deque(maxlen=int(window))
+        self._armed = False
+        self._cooldown_left = 0
+        self.captures = 0
+        self.triggers = 0          # slow steps observed (armed or not)
+        self.last_trigger = None   # (step, wall_s, median_s)
+
+    def rolling_median(self):
+        if not self._times:
+            return None
+        xs = sorted(self._times)
+        n = len(xs)
+        return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+    def observe(self, step, wall_s):
+        """Record one step's wall time; returns True when this step was a
+        slow-step outlier (and arms a capture if the budget allows)."""
+        med = self.rolling_median()
+        slow = (med is not None
+                and len(self._times) >= self.min_steps
+                and wall_s > self.multiple * med)
+        # an outlier must not drag the median up for its successors'
+        # comparisons? It must: persistent slowness (every step slow)
+        # should RAISE the median until the new steady state stops
+        # triggering — only one capture per regime shift, by design.
+        self._times.append(wall_s)
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            return False
+        if slow:
+            self.triggers += 1
+            self.last_trigger = (int(step), float(wall_s), float(med))
+            if self.captures < self.max_captures:
+                self._armed = True
+        return slow
+
+    def should_capture(self):
+        """Consume the armed flag: True exactly once per trigger — the
+        caller wraps the NEXT step in a profiler window."""
+        if not self._armed:
+            return False
+        self._armed = False
+        self.captures += 1
+        self._cooldown_left = self.cooldown
+        return True

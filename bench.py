@@ -311,6 +311,15 @@ def _bench():
     name = _model_name()
     spec = MODELS[name]
     _stage("init")
+    # BENCH_TELEMETRY=<dir>: run the measured session with the runtime
+    # telemetry layer on (per-step JSONL manifest + RuntimeRecord under
+    # <dir>; docs/observability.md).  Enabled BEFORE the session is built
+    # so DistributedSession picks the instrumented path.
+    bench_telemetry_dir = os.environ.get("BENCH_TELEMETRY", "")
+    if bench_telemetry_dir:
+        from autodist_tpu import telemetry
+
+        telemetry.enable(run_dir=bench_telemetry_dir)
     n_chips = jax.device_count()
     batch_per_chip = int(os.environ.get("BENCH_BATCH",
                                         str(spec["default_batch"])))
@@ -389,6 +398,10 @@ def _bench():
     }
     rec.update({k2: v for k2, v in extras.items()
                 if k2 != "tokens_per_example"})
+    if bench_telemetry_dir:
+        manifest = sess.finalize_telemetry()
+        if manifest:
+            rec["telemetry_manifest"] = manifest
     if mfu > 1.0:
         # physically impossible => the sync point itself is broken; never
         # report a >peak number as a win

@@ -1,0 +1,116 @@
+"""tools/trace_summary.py on synthetic chrome traces.
+
+Pins the top-ops aggregation (device-track filtering, totals, counts)
+and the host-span join (device time inside host span windows) on a small
+hand-built trace — no profiler run needed, so the numbers are exact.
+"""
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tools.trace_summary import (device_intervals, find_trace_file,  # noqa: E402
+                                 join_host_spans, load_events,
+                                 load_span_events, summarize)
+from tools import trace_summary  # noqa: E402
+
+# two lanes: pid 1 is a device track (name matches the device pattern),
+# pid 2 is host-side python and must be excluded by device_only
+SYNTHETIC_EVENTS = [
+    {"ph": "M", "name": "process_name", "pid": 1,
+     "args": {"name": "/device:TPU:0"}},
+    {"ph": "M", "name": "process_name", "pid": 2,
+     "args": {"name": "python host"}},
+    {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1", "ts": 1000, "dur": 100},
+    {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1", "ts": 2000, "dur": 50},
+    {"ph": "X", "pid": 1, "tid": 2, "name": "copy.2", "ts": 1500, "dur": 30},
+    {"ph": "X", "pid": 2, "tid": 9, "name": "host_thing", "ts": 0, "dur": 9999},
+    # non-complete events must be ignored by the aggregation
+    {"ph": "B", "pid": 1, "tid": 1, "name": "begin.only", "ts": 100},
+]
+
+HOST_SPANS = [
+    # covers the first fusion.1 (1000-1100) fully, nothing else
+    {"ph": "X", "pid": 7, "tid": 1, "name": "step", "ts": 950, "dur": 200},
+    # covers half of the second fusion.1 (2000-2050 -> 2025 cut)
+    {"ph": "X", "pid": 7, "tid": 1, "name": "step", "ts": 1975, "dur": 50},
+    # empty window: no device activity at all
+    {"ph": "X", "pid": 7, "tid": 1, "name": "idle", "ts": 3000, "dur": 100},
+]
+
+
+def _write_trace(tmp_path, gz=True):
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    run_dir.mkdir(parents=True)
+    payload = json.dumps({"traceEvents": SYNTHETIC_EVENTS})
+    if gz:
+        path = run_dir / "host.trace.json.gz"
+        with gzip.open(path, "wt") as f:
+            f.write(payload)
+    else:
+        path = run_dir / "host.trace.json"
+        path.write_text(payload)
+    return str(path)
+
+
+def test_find_and_load_gz(tmp_path):
+    path = _write_trace(tmp_path, gz=True)
+    assert find_trace_file(str(tmp_path)) == path
+    events = load_events(path)
+    assert len(events) == len(SYNTHETIC_EVENTS)
+
+
+def test_top_ops_aggregation_device_only(tmp_path):
+    events = load_events(_write_trace(tmp_path, gz=False))
+    agg, total, pnames = summarize(events, device_only=True)
+    # host_thing (pid 2) and the "B" event are excluded; totals are exact
+    assert set(agg) == {"fusion.1", "copy.2"}
+    assert agg["fusion.1"] == [150.0, 2]
+    assert agg["copy.2"] == [30.0, 1]
+    assert total == 180.0
+    assert pnames[1] == "/device:TPU:0"
+
+
+def test_all_tracks_includes_host():
+    agg, total, _ = summarize(SYNTHETIC_EVENTS, device_only=False)
+    assert "host_thing" in agg
+    assert total == 180.0 + 9999.0
+
+
+def test_device_intervals_filters_host():
+    ivs = device_intervals(SYNTHETIC_EVENTS)
+    assert (0.0, 9999.0) not in ivs
+    assert (1000.0, 1100.0) in ivs and (1500.0, 1530.0) in ivs
+
+
+def test_host_span_join_pins_overlap():
+    joined = join_host_spans(SYNTHETIC_EVENTS, HOST_SPANS)
+    assert set(joined) == {"step", "idle"}
+    step = joined["step"]
+    # window 1: fusion.1 fully inside -> 100us; window 2: 2000-2025 -> 25us
+    assert step["host_us"] == 250.0
+    assert step["count"] == 2
+    assert step["device_us"] == 125.0
+    assert abs(step["device_share"] - 0.5) < 1e-9
+    idle = joined["idle"]
+    assert idle["device_us"] == 0.0 and idle["device_share"] == 0.0
+
+
+def test_main_with_host_spans(tmp_path, capsys):
+    # spans live OUTSIDE the profile dir — find_trace_file globs every
+    # *.trace.json under its argument and must not pick the span dump
+    profile_dir = tmp_path / "profile"
+    profile_dir.mkdir()
+    _write_trace(profile_dir, gz=True)
+    spans_path = tmp_path / "host_spans.trace.json"
+    spans_path.write_text(json.dumps({"traceEvents": HOST_SPANS}))
+    assert load_span_events(str(spans_path)) == HOST_SPANS
+    rc = trace_summary.main([str(profile_dir), "--host-spans",
+                             str(spans_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fusion.1" in out
+    assert "host spans" in out
+    assert "step" in out and "idle" in out

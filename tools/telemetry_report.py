@@ -1,0 +1,194 @@
+"""Render a telemetry run manifest into a human-readable summary.
+
+Usage:  python tools/telemetry_report.py <run_dir | manifest.jsonl> [--json]
+
+Reads the JSONL manifest a telemetry-enabled run writes (per-worker
+files are merged in memory when the chief's ``manifest.jsonl`` is
+absent; schema in ``autodist_tpu/telemetry/schema.py``) and reports:
+
+- step-time percentiles (RTT-cancelled walls) + compile split,
+- throughput and achieved-MFU percentiles (with the assumed-peak caveat
+  when the device kind is unknown),
+- HBM peak and headroom against the device generation's budget (when
+  the backend reports ``memory_stats`` and the kind is recognized),
+- predicted comm/compute overlap from the recorded cost estimate next
+  to the measured walls (predicted-vs-measured error),
+- async-PS staleness counters and watchdog captures when present.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from autodist_tpu.telemetry import load_manifest, percentiles  # noqa: E402
+
+
+def _fmt_s(x):
+    if x is None:
+        return "-"
+    if x >= 1.0:
+        return f"{x:.3f}s"
+    return f"{x * 1e3:.3f}ms"
+
+
+def _fmt_bytes(x):
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if x >= div:
+            return f"{x / div:.2f}{unit}"
+    return f"{x}B"
+
+
+def _hbm_budget(device_kind):
+    try:
+        from autodist_tpu.aot import HBM_BY_DEVICE_KIND
+
+        for key, budget in HBM_BY_DEVICE_KIND.items():
+            if device_kind and device_kind.startswith(key):
+                return budget
+    except Exception:
+        pass
+    return None
+
+
+def summarize_manifest(records):
+    """Manifest records -> summary dict (the --json payload)."""
+    meta = next((r for r in records if r.get("kind") == "meta"), {})
+    steps = [r for r in records if r.get("kind") == "step"]
+    snaps = [r for r in records if r.get("kind") == "snapshot"]
+    summaries = [r for r in records if r.get("kind") == "summary"]
+    watchdogs = [r for r in records if r.get("kind") == "watchdog"]
+
+    walls = [r.get("wall_cancelled_s", r.get("wall_s")) for r in steps[1:]] \
+        or [r.get("wall_cancelled_s", r.get("wall_s")) for r in steps]
+    walls = [w for w in walls if w is not None]
+    ps = percentiles(walls)
+    out = {
+        "run_id": meta.get("run_id"),
+        "backend": meta.get("backend"),
+        "device_kind": meta.get("device_kind"),
+        "num_devices": meta.get("num_devices"),
+        "workers": sorted({r.get("w", 0) for r in records}),
+        "steps": len(steps),
+        "step_time_p50_s": ps[0.5], "step_time_p90_s": ps[0.9],
+        "step_time_p99_s": ps[0.99],
+        "watchdog_captures": len(watchdogs),
+    }
+    thr = [r["throughput_eps"] for r in steps if "throughput_eps" in r]
+    if thr:
+        out["throughput_eps_p50"] = percentiles(thr)[0.5]
+    mfus = [r["mfu"] for r in steps if "mfu" in r]
+    if mfus:
+        out["mfu_p50"] = percentiles(mfus)[0.5]
+        out["peak_assumed"] = any(r.get("peak_assumed") for r in steps)
+    for s in summaries:
+        if "compile_s" in s:
+            out["compile_s"] = s["compile_s"]
+        if "runtime_record" in s:
+            out.setdefault("runtime_records", []).append(s["runtime_record"])
+    peaks = [r["peak_bytes"] for r in snaps if r.get("peak_bytes") is not None]
+    if peaks:
+        out["hbm_peak_bytes"] = max(peaks)
+        budget = _hbm_budget(meta.get("device_kind", ""))
+        if budget:
+            out["hbm_budget_bytes"] = budget
+            out["hbm_headroom_bytes"] = budget - max(peaks)
+    est = meta.get("cost_estimate")
+    if est:
+        out["predicted"] = {
+            "total_s": est.get("total_s"),
+            "serialized_s": est.get("serialized_s"),
+            "overlapped_s": est.get("overlapped_s"),
+            "schedule": est.get("schedule"),
+        }
+        ser, ovl = est.get("serialized_s"), est.get("overlapped_s")
+        if ser and ovl is not None and ser > 0:
+            # the overlap credit the schedule is predicted to earn: 0 =
+            # fully serialized, higher = more comm hidden behind compute
+            out["predicted_overlap_credit"] = 1.0 - ovl / ser
+        if ps[0.5] and est.get("total_s"):
+            out["predicted_vs_measured_rel_error"] = (
+                (est["total_s"] - ps[0.5]) / ps[0.5])
+    # async-PS staleness counters, surfaced from any summary's aggregates
+    for s in summaries:
+        counters = (s.get("aggregates") or {}).get("counters", {})
+        for key in ("async_ps.pushes", "async_ps.stale_pushes"):
+            if key in counters:
+                out.setdefault("async_ps", {})[key.split(".", 1)[1]] = \
+                    counters[key]
+    return out
+
+
+def render(summary):
+    lines = []
+    add = lines.append
+    add(f"run {summary.get('run_id')} — backend={summary.get('backend')} "
+        f"({summary.get('device_kind')}), "
+        f"{summary.get('num_devices')} device(s), "
+        f"workers={summary.get('workers')}")
+    add(f"steps: {summary['steps']}   "
+        f"p50 {_fmt_s(summary['step_time_p50_s'])}   "
+        f"p90 {_fmt_s(summary['step_time_p90_s'])}   "
+        f"p99 {_fmt_s(summary['step_time_p99_s'])}")
+    if "compile_s" in summary:
+        add(f"compile (first-step estimate): {_fmt_s(summary['compile_s'])}")
+    if "throughput_eps_p50" in summary:
+        add(f"throughput p50: {summary['throughput_eps_p50']:.1f} examples/s")
+    if "mfu_p50" in summary:
+        caveat = " (peak ASSUMED — unknown device kind)" \
+            if summary.get("peak_assumed") else ""
+        add(f"achieved MFU p50: {summary['mfu_p50']:.4%}{caveat}")
+    if "hbm_peak_bytes" in summary:
+        line = f"HBM peak: {_fmt_bytes(summary['hbm_peak_bytes'])}"
+        if "hbm_headroom_bytes" in summary:
+            line += (f" of {_fmt_bytes(summary['hbm_budget_bytes'])} "
+                     f"(headroom {_fmt_bytes(summary['hbm_headroom_bytes'])})")
+        add(line)
+    pred = summary.get("predicted")
+    if pred:
+        add(f"cost model: predicted {_fmt_s(pred.get('total_s'))} "
+            f"({pred.get('schedule')} schedule)")
+        if "predicted_overlap_credit" in summary:
+            add(f"  comm/compute overlap credit: "
+                f"{summary['predicted_overlap_credit']:.1%} "
+                f"(serialized {_fmt_s(pred.get('serialized_s'))} -> "
+                f"overlapped {_fmt_s(pred.get('overlapped_s'))})")
+        if "predicted_vs_measured_rel_error" in summary:
+            add(f"  predicted vs measured: "
+                f"{summary['predicted_vs_measured_rel_error']:+.1%} "
+                f"(refit with cost_model.calibrate_from_records on "
+                f"the run's RuntimeRecords if large)")
+    if summary.get("async_ps"):
+        a = summary["async_ps"]
+        add(f"async PS: {a.get('pushes', 0):.0f} pushes, "
+            f"{a.get('stale_pushes', 0):.0f} stale")
+    if summary.get("watchdog_captures"):
+        add(f"watchdog captures: {summary['watchdog_captures']}")
+    if summary.get("runtime_records"):
+        add("runtime records: " + ", ".join(summary["runtime_records"]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="telemetry run dir or manifest.jsonl")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of text")
+    args = ap.parse_args(argv)
+    records = load_manifest(args.path)
+    if not records:
+        print(f"no telemetry records under {args.path}", file=sys.stderr)
+        return 1
+    summary = summarize_manifest(records)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

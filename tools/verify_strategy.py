@@ -57,32 +57,14 @@ def _synthetic_loss(params, batch):
 
 
 def _record_case(path, hbm_bytes):
-    """RuntimeRecord JSON -> verify_strategy kwargs."""
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from autodist_tpu.model_item import ModelItem
-    from autodist_tpu.proto import modelitem_pb2
-    from autodist_tpu.simulator.cost_model import RuntimeRecord
-    from autodist_tpu.strategy.base import Strategy
-    from autodist_tpu.proto import strategy_pb2
+    """RuntimeRecord JSON -> verify_strategy kwargs (case reconstruction
+    shared with the telemetry calibration loop:
+    ``cost_model.rebuild_record_case``)."""
+    from autodist_tpu.simulator.cost_model import (RuntimeRecord,
+                                                   rebuild_record_case)
 
     rec = RuntimeRecord.load(path)
-    mdef = modelitem_pb2.ModelItemDef()
-    mdef.ParseFromString(rec.model_def)
-    params = {v.name: jnp.zeros(tuple(v.shape), np.dtype(v.dtype))
-              for v in mdef.variables}
-    sparse = [v.name for v in mdef.variables if v.sparse_gradient]
-    item = ModelItem(_synthetic_loss, params, optax.adam(1e-3),
-                     sparse_vars=sparse or None)
-    pb = strategy_pb2.Strategy()
-    pb.ParseFromString(rec.strategy_pb)
-    strategy = Strategy(pb)
-    R = 1
-    for s in pb.graph_config.mesh.axis_sizes:
-        R *= int(s)
-    R = max(1, R)
+    strategy, item, R = rebuild_record_case(rec, loss_fn=_synthetic_loss)
     return dict(strategy=strategy, model_item=item,
                 batch_shapes={"x": ((2 * R, 4), "float32")},
                 hbm_bytes_per_device=hbm_bytes)
