@@ -42,6 +42,14 @@ Finding codes (stable; tests and tools match on them):
   H002 ERROR   traced liveness peak exceeds the HBM budget
   H003 WARNING traced liveness peak above 90% of the HBM budget
   H004 INFO    footprint summary (cost-model cross-check)
+  Y001 ERROR   DCN-hop compressor is a block codec (PowerSGD): the
+               cross-slice hop only admits elementwise codecs + int8
+  Y002 ERROR   TWO_LEVEL hierarchy but the mesh declares no
+               replica_dcn x replica_ici sub-axes
+  Y003 ERROR   declared sub-axis sizes do not multiply to the device count
+  Y004 WARNING PowerSGD main codec under TWO_LEVEL (engine realizes FLAT)
+  Y005 WARNING dcn_compressor set on a non-TWO_LEVEL node (ignored)
+  Y006 INFO    hierarchy summary (factorization + DCN-hop codec)
   T001 ERROR   tracing the strategy's train step failed
   T002 INFO    trace skipped (trace passes did not run)
 """
@@ -390,6 +398,95 @@ def lint_param_specs(param_specs, axis_names, axis_sizes, var_infos):
 
 
 # ---------------------------------------------------------------------------
+# sync-hierarchy pass (two-level topology-aware gradient sync)
+# ---------------------------------------------------------------------------
+
+
+def hierarchy_pass(ctx):
+    """Validate the two-level sync decomposition before anything compiles:
+    the sub-axis factorization must cover the device count, TWO_LEVEL
+    collectives must have declared ``replica_dcn x replica_ici`` axes to
+    reference, and the DCN-hop codec must be shard-decomposable (the
+    elementwise family + int8; a PowerSGD low-rank exchange cannot ride a
+    shard hop — ERROR, per docs/performance.md "Hierarchical sync")."""
+    from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+    from autodist_tpu.kernel.synchronization.all_reduce import DCN_SAFE_CODECS
+    from autodist_tpu.proto import synchronizers_pb2
+
+    _C = synchronizers_pb2.AllReduceSynchronizer
+    findings = []
+    proto = ctx.strategy.proto
+    axis_sizes = dict(ctx.axis_sizes)
+    factored = (AXIS_REPLICA_DCN in axis_sizes
+                and AXIS_REPLICA_ICI in axis_sizes)
+
+    if factored:
+        n_devices = len(proto.graph_config.replicas)
+        if not n_devices and ctx.resource_spec is not None:
+            n_devices = ctx.resource_spec.num_accelerators
+        prod = 1
+        for s in axis_sizes.values():
+            prod *= int(s)
+        if n_devices and prod != n_devices:
+            findings.append(_f(
+                Severity.ERROR, "Y003", "hierarchy",
+                f"sub-axis factorization {axis_sizes} multiplies to {prod} "
+                f"but the strategy spans {n_devices} device(s); the "
+                f"two-level schedule would address devices that do not "
+                f"exist (or leave some idle)", "mesh"))
+
+    two_level_nodes = dcn_codecs = 0
+    for node in proto.node_config:
+        for src in (node, *node.part_config):
+            if src.WhichOneof("synchronizer") != "AllReduceSynchronizer":
+                continue
+            ar = src.AllReduceSynchronizer
+            if ar.dcn_compressor and \
+                    ar.dcn_compressor not in DCN_SAFE_CODECS:
+                findings.append(_f(
+                    Severity.ERROR, "Y001", "hierarchy",
+                    f"dcn_compressor {ar.dcn_compressor} is a block codec: "
+                    f"the cross-slice hop reduces a 1/R_ici shard, which "
+                    f"only elementwise codecs (none/bf16/bf16-EF) and the "
+                    f"int8 all_to_all recipe decompose into — PowerSGD's "
+                    f"factor exchange does not", node.var_name))
+            if ar.hierarchy != _C.TWO_LEVEL:
+                if ar.dcn_compressor and ar.hierarchy == _C.FLAT:
+                    findings.append(_f(
+                        Severity.WARNING, "Y005", "hierarchy",
+                        "dcn_compressor is set but hierarchy=FLAT pins the "
+                        "one-collective schedule; the DCN-hop codec is "
+                        "ignored", node.var_name))
+                continue
+            two_level_nodes += 1
+            if ar.dcn_compressor:
+                dcn_codecs += 1
+            if not factored:
+                findings.append(_f(
+                    Severity.ERROR, "Y002", "hierarchy",
+                    f"hierarchy=TWO_LEVEL but the mesh "
+                    f"({dict(axis_sizes) or list(ctx.axis_names)}) declares "
+                    f"no '{AXIS_REPLICA_DCN}' x '{AXIS_REPLICA_ICI}' "
+                    f"sub-axes for the schedule's collectives to "
+                    f"reference — factor the mesh (YAML `mesh:` request "
+                    f"or build_mesh(hierarchy=True))", node.var_name))
+            if ar.compressor == _C.PowerSGDCompressor:
+                findings.append(_f(
+                    Severity.WARNING, "Y004", "hierarchy",
+                    "PowerSGD under TWO_LEVEL: the low-rank factor "
+                    "exchange does not decompose into ICI/DCN hops; the "
+                    "engine realizes this bucket FLAT", node.var_name))
+    if two_level_nodes and factored:
+        findings.append(_f(
+            Severity.INFO, "Y006", "hierarchy",
+            f"two-level sync: {two_level_nodes} node(s) over "
+            f"replica_dcn={axis_sizes[AXIS_REPLICA_DCN]} x "
+            f"replica_ici={axis_sizes[AXIS_REPLICA_ICI]} "
+            f"({dcn_codecs} with an explicit DCN-hop codec)", "mesh"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # donation-safety pass
 # ---------------------------------------------------------------------------
 
@@ -537,11 +634,12 @@ def hbm_traced_pass(ctx):
 
 PASS_REGISTRY = {
     "sharding": sharding_pass,
+    "hierarchy": hierarchy_pass,
     "hbm-static": hbm_static_pass,
     "collectives": collectives_pass,
     "donation": donation_pass,
     "hbm-traced": hbm_traced_pass,
 }
 
-STATIC_PASSES = ("sharding", "hbm-static")
+STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
 TRACE_PASSES = ("collectives", "donation", "hbm-traced")

@@ -80,6 +80,24 @@ class AutoDist:
             self._mesh = build_mesh(self._resource_spec)
         return self._mesh
 
+    def _mesh_for(self, strategy):
+        """The session mesh for a compiled strategy.  Normally the spec's
+        mesh (``build_mesh``); when the strategy's ``graph_config.mesh``
+        declares the ``replica_dcn x replica_ici`` factorization (a
+        two-level builder wrote its host-boundary split there) and the
+        YAML carries no explicit ``mesh:`` request, the factored mesh is
+        built so the TWO_LEVEL schedule can realize."""
+        from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+        from autodist_tpu.parallel.mesh import build_mesh
+
+        gm = strategy.proto.graph_config.mesh
+        names = tuple(gm.axis_names)
+        if (self._resource_spec.mesh_request is None
+                and AXIS_REPLICA_DCN in names and AXIS_REPLICA_ICI in names):
+            axes = dict(zip(names, (int(s) for s in gm.axis_sizes)))
+            return build_mesh(self._resource_spec, axes=axes)
+        return self.mesh
+
     # -- strategy lifecycle (reference autodist.py:100-118) ----------------
 
     def _build_or_load_strategy(self, model_item) -> Strategy:
@@ -238,7 +256,7 @@ class AutoDist:
                 param_specs=transformer_kwargs.get("param_specs"),
                 passes=STATIC_PASSES)
             report.raise_for_errors()
-        transformer = GraphTransformer(strategy, item, self.mesh,
+        transformer = GraphTransformer(strategy, item, self._mesh_for(strategy),
                                        **transformer_kwargs)
         return DistributedSession(transformer, rng=rng, donate=donate,
                                   batch_mask=batch_mask, verify=verify)

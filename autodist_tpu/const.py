@@ -37,6 +37,16 @@ AXIS_PIPELINE = "pipe"
 AXIS_SEQUENCE = "seq"
 AXIS_EXPERT = "expert"
 
+# Two-level data parallelism: the replica axis factored into a cross-host
+# (DCN) major sub-axis and an intra-host (ICI) minor sub-axis.  With
+# process-major device order, replica_dcn strides across hosts and
+# replica_ici stays inside one — the layout the hierarchical sync schedule
+# (AllReduceSynchronizer.Hierarchy.TWO_LEVEL) exploits to keep the bulk
+# reduce-scatter/all-gather phases on ICI and ship only a 1/R_ici shard
+# over DCN (docs/performance.md "Hierarchical sync").
+AXIS_REPLICA_DCN = "replica_dcn"
+AXIS_REPLICA_ICI = "replica_ici"
+
 # Reserved batch key carrying the per-example validity mask that the session
 # injects when a global batch does not divide evenly across replicas
 # (reference ``remapper.py:109-118`` np.array_split uneven feed; here:

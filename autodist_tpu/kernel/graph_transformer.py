@@ -80,7 +80,10 @@ class GraphTransformer:
                 first = tuple(a for a in axes if a != AXIS_SEQUENCE)
                 batch_spec = P(first if len(first) > 1 else first[0], AXIS_SEQUENCE)
             else:
-                batch_spec = P(axes[0])
+                # pure data parallelism shards dim 0 over EVERY data axis
+                # (a factored replica_dcn x replica_ici mesh still gives
+                # each device a distinct batch shard)
+                batch_spec = P(axes if len(axes) > 1 else axes[0])
         self.batch_spec = batch_spec
         # sequence parallelism is active only when the batch's sequence dim
         # (dim >= 1) is actually sharded over the seq axis — a mesh merely
@@ -103,6 +106,48 @@ class GraphTransformer:
         for name in self.names:
             if name not in self.plans:
                 raise ValueError(f"No plan for variable {name}")
+        # -- sync hierarchy (AllReduceSynchronizer.Hierarchy) --------------
+        # A mesh factored into replica_dcn x replica_ici data sub-axes
+        # enables the two-level schedule: ICI reduce-scatter -> DCN shard
+        # ring -> ICI all-gather.  The cross-slice hop spans every data
+        # axis except the ICI sub-axis (so e.g. a seq axis still reduces).
+        from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+
+        self.hier_spec = None
+        if AXIS_REPLICA_DCN in axes and AXIS_REPLICA_ICI in axes:
+            self.hier_spec = ar_sync.HierAxes(
+                ici=AXIS_REPLICA_ICI,
+                dcn=tuple(a for a in axes if a != AXIS_REPLICA_ICI))
+        _AR = ar_sync._AR
+        for name in self.names:
+            plan = self.plans[name]
+            if (plan.sync != SyncKind.ALL_REDUCE
+                    or plan.placement != Placement.REPLICATED or plan.sparse):
+                continue
+            h = plan.hierarchy
+            if h == _AR.TWO_LEVEL and self.hier_spec is None:
+                raise ValueError(
+                    f"{name!r}: hierarchy=TWO_LEVEL needs a mesh factored "
+                    f"into '{AXIS_REPLICA_DCN}' x '{AXIS_REPLICA_ICI}' data "
+                    f"sub-axes (YAML `mesh:` request or "
+                    f"build_mesh(hierarchy=True)); mesh axes are "
+                    f"{mesh.axis_names}")
+            if h == _AR.AUTO_HIERARCHY:
+                h = (_AR.TWO_LEVEL if self.hier_spec is not None
+                     and mesh.shape[AXIS_REPLICA_DCN] > 1 else _AR.FLAT)
+            if h == _AR.TWO_LEVEL:
+                if plan.dcn_compressor not in (0, *ar_sync.DCN_SAFE_CODECS):
+                    raise ValueError(
+                        f"{name!r}: dcn_compressor {plan.dcn_compressor} is "
+                        f"not DCN-hop safe; the cross-slice hop accepts "
+                        f"only elementwise codecs (none/bf16/bf16-EF) and "
+                        f"int8 — block codecs like PowerSGD do not "
+                        f"decompose into a shard hop")
+                if plan.compressor == _AR.PowerSGDCompressor:
+                    # PowerSGD's factor exchange never decomposes; realize
+                    # flat (the analysis hierarchy pass warns about this)
+                    h = _AR.FLAT
+            plan.hierarchy = h
         shapes = {v.name: v.shape for v in model_item.var_infos}
         dtypes = {v.name: v.dtype for v in model_item.var_infos}
         self.buckets = ar_sync.plan_buckets(self.plans, shapes, dtypes)
@@ -172,12 +217,61 @@ class GraphTransformer:
                 key = (str(np.dtype(plan.dtype)), plan.ps_axes or ())
                 self.ps_groups.setdefault(key, []).append(name)
         logging.info(
-            "Transform plan: %d vars, %d AR buckets (%s schedule), "
-            "placements=%s",
+            "Transform plan: %d vars, %d AR buckets (%s schedule, %s "
+            "hierarchy), placements=%s",
             len(self.names), len(self.buckets), self.sync_schedule,
+            self.sync_hierarchy,
             {p.value: sum(1 for q in self.plans.values() if q.placement is p)
              for p in Placement},
         )
+
+    @property
+    def sync_hierarchy(self):
+        """``"two_level"`` when any AR bucket uses the hierarchical
+        schedule, else ``"flat"``."""
+        return ("two_level" if any(
+            b.hierarchy == ar_sync._AR.TWO_LEVEL for b in self.buckets)
+            else "flat")
+
+    def hierarchy_summary(self):
+        """Static per-hop wire accounting of the chosen hierarchy — what
+        telemetry records so reports can show predicted-vs-measured
+        per-hop comm time (docs/performance.md "Hierarchical sync").
+
+        ``ici_hop_bytes`` counts BOTH intra-slice phases (reduce-scatter +
+        all-gather of the full bucket volume); ``dcn_hop_bytes`` is the
+        ring volume of the cross-slice hop: the 1/R_ici shard, scaled by
+        the DCN codec's wire factor.  FLAT buckets bill their whole codec
+        volume to ``flat_bytes`` (one collective at min(ICI, DCN) speed).
+        """
+        import numpy as _np
+
+        from autodist_tpu.kernel.synchronization.compressor import (
+            get_compressor, wire_byte_factor)
+
+        _AR = ar_sync._AR
+        R_ici = (self.mesh.shape[self.hier_spec.ici]
+                 if self.hier_spec is not None else 1)
+        out = {"mode": self.sync_hierarchy,
+               "replica_dcn": (self.num_replicas // R_ici
+                               if self.hier_spec is not None else 1),
+               "replica_ici": R_ici,
+               "ici_hop_bytes": 0.0, "dcn_hop_bytes": 0.0,
+               "flat_bytes": 0.0, "dcn_compressors": []}
+        for b in self.buckets:
+            nbytes = b.total * _np.dtype(b.dtype).itemsize
+            if b.hierarchy == _AR.TWO_LEVEL:
+                d = ar_sync.dcn_codec(b)
+                out["ici_hop_bytes"] += 2.0 * nbytes
+                out["dcn_hop_bytes"] += \
+                    nbytes * wire_byte_factor(d, b.total) / max(1, R_ici)
+                name = get_compressor(d).name if d else "none"
+                if name not in out["dcn_compressors"]:
+                    out["dcn_compressors"].append(name)
+            else:
+                out["flat_bytes"] += \
+                    nbytes * wire_byte_factor(b.compressor, b.total)
+        return out
 
     def plan_summary(self):
         """Human-readable transform plan — dump stage 0 of the 4-stage
@@ -190,7 +284,8 @@ class GraphTransformer:
                  f"AR buckets: {len(self.buckets)}  "
                  f"fused PS groups: {len(self.ps_groups)}  "
                  f"custom groups: {len(self.custom_groups)}  "
-                 f"sync_schedule: {self.sync_schedule}", ""]
+                 f"sync_schedule: {self.sync_schedule}  "
+                 f"sync_hierarchy: {self.sync_hierarchy}", ""]
         for name in self.names:
             p = self.plans[name]
             extra = ""
@@ -610,7 +705,8 @@ class GraphTransformer:
                     g_leaves_ = self.treedef.flatten_up_to(g)
                     g_names = dict(zip(self.names, g_leaves_))
                     synced_i, comp_next = ar_sync.sync_overlapped(
-                        g_names, scan_buckets, comp_cur, axis)
+                        g_names, scan_buckets, comp_cur, axis,
+                        hier=self.hier_spec)
                     acc_synced = {n: acc_synced[n] + synced_i[n] / A
                                   for n in acc_synced}
                     # bucketed vars accumulate ONLY their synced mean (the
@@ -663,14 +759,23 @@ class GraphTransformer:
             g_leaves = self.treedef.flatten_up_to(grads)
             g_by_name = dict(zip(self.names, g_leaves))
             if synced is None:
-                sync_fn = (ar_sync.sync_overlapped
-                           if self.sync_schedule == "overlap"
-                           else ar_sync.sync_bucketed)
-                synced, comp_new_local = sync_fn(
-                    g_by_name, self.buckets, comp_local, axis)
+                if self.sync_schedule == "overlap":
+                    synced, comp_new_local = ar_sync.sync_overlapped(
+                        g_by_name, self.buckets, comp_local, axis,
+                        hier=self.hier_spec)
+                elif self.hier_spec is not None:
+                    # barrier schedule on a factored mesh: the two-level
+                    # entry (FLAT buckets inside it still reduce flat)
+                    synced, comp_new_local = ar_sync.sync_hierarchical(
+                        g_by_name, self.buckets, comp_local, axis,
+                        hier=self.hier_spec)
+                else:
+                    synced, comp_new_local = ar_sync.sync_bucketed(
+                        g_by_name, self.buckets, comp_local, axis)
             elif post_buckets:
                 synced_post, comp_post = ar_sync.sync_overlapped(
-                    g_by_name, post_buckets, comp_local, axis)
+                    g_by_name, post_buckets, comp_local, axis,
+                    hier=self.hier_spec)
                 synced = {**synced, **synced_post}
                 comp_new_local = {**comp_post, **comp_scan_new}
             else:
@@ -1072,4 +1177,6 @@ class GraphTransformer:
 def get_stateful(bucket):
     from autodist_tpu.kernel.synchronization.compressor import get_compressor
 
-    return get_compressor(bucket.compressor).stateful
+    # TWO_LEVEL buckets carry their DCN-hop codec's state (the only wire
+    # transform they apply); flat buckets their own compressor's
+    return get_compressor(ar_sync.wire_codec(bucket)).stateful

@@ -72,6 +72,14 @@ class VarPlan:
     # backward), 1 = OVERLAP (per-bucket reverse-topological collectives
     # under XLA's latency-hiding scheduler)
     schedule: int = 0
+    # AllReduceSynchronizer.Hierarchy: 0 = AUTO (TWO_LEVEL on a
+    # replica_dcn x replica_ici factored mesh, FLAT otherwise — resolved
+    # by the transformer), 1 = FLAT, 2 = TWO_LEVEL (ICI reduce-scatter ->
+    # DCN shard ring -> ICI all-gather)
+    hierarchy: int = 0
+    # Compressor enum for the TWO_LEVEL cross-slice (DCN) hop;
+    # 0 = follow `compressor`
+    dcn_compressor: int = 0
     # PS fields
     ps_sync: bool = True
     staleness: int = 0
@@ -182,6 +190,8 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
             plan.compressor = ar.compressor
             plan.spec = ar.spec
             plan.schedule = ar.schedule
+            plan.hierarchy = ar.hierarchy
+            plan.dcn_compressor = ar.dcn_compressor
         else:
             logging.debug("Variable %s node has no synchronizer; AllReduce default", v.name)
 

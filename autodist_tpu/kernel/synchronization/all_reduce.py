@@ -24,11 +24,28 @@ Two issue schedules (``AllReduceSynchronizer.Schedule``):
   per-chunk reduce equals the fused reduce element-for-element; block
   codecs (int8, PowerSGD) keep their whole-bucket collective and are
   merely reordered.
+
+Orthogonal to the issue schedule, each bucket carries a sync HIERARCHY
+(``AllReduceSynchronizer.Hierarchy``):
+
+- FLAT — one collective over the full data-parallel axis set (above).
+- TWO_LEVEL (:func:`sync_hierarchical` / ``hier=`` on either schedule) —
+  on a ``replica_dcn x replica_ici`` factored mesh the reduce decomposes
+  into intra-slice reduce-scatter over ICI -> cross-slice ring allreduce
+  of the 1/R_ici shard over DCN -> intra-slice all-gather, so the slow
+  DCN hop carries ``1/R_ici`` of the gradient volume instead of all of
+  it (the TACCL-style hierarchy-aware schedule, arXiv 2111.04867).  The
+  bucket's codec — or the explicit ``dcn_compressor`` override — applies
+  to the SHARD on the cross-slice hop only; both ICI phases ride the
+  native dtype at full precision (the EQuARX recipe of quantizing only
+  the slow wire, arXiv 2506.17615).  With no DCN compression the result
+  equals the flat reduce up to float re-association.
 """
 import dataclasses
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from autodist_tpu.const import DEFAULT_BUCKET_BYTES
@@ -42,17 +59,62 @@ _AR = synchronizers_pb2.AllReduceSynchronizer
 # flat f32 residual and slices at the same offsets)
 _ELEMENTWISE_CODECS = frozenset(
     (_AR.NoneCompressor, _AR.BF16Compressor, _AR.BF16CompressorEF))
+# codecs that may ride the cross-slice (DCN) hop of a TWO_LEVEL bucket:
+# the elementwise family plus the int8 all_to_all/dequant-sum recipe
+# (whose two phases both stay on the DCN sub-ring).  PowerSGD's low-rank
+# factor exchange does not decompose into a shard hop — the analysis
+# pass rejects it as a DCN-hop compressor (ERROR) and the engine refuses.
+DCN_SAFE_CODECS = frozenset(
+    (_AR.NoneCompressor, _AR.BF16Compressor, _AR.BF16CompressorEF,
+     _AR.Int8Compressor, _AR.Int8CompressorEF))
+
+
+@dataclasses.dataclass(frozen=True)
+class HierAxes:
+    """Axis split of a two-level sync on a factored mesh: ``ici`` is the
+    intra-slice sub-axis the scatter/gather phases ride; ``dcn`` is the
+    cross-slice hop — the remaining data axes (``replica_dcn`` plus any
+    extra data axes such as ``seq``), over which only the shard moves."""
+
+    ici: str
+    dcn: tuple
+
+    @property
+    def all_axes(self):
+        return self.dcn + (self.ici,)
+
+
+def dcn_codec(bucket) -> int:
+    """Effective codec on a TWO_LEVEL bucket's cross-slice hop: the
+    explicit ``dcn_compressor`` override when set, else the bucket's own
+    compressor (so ``AllReduce(compressor="BF16Compressor",
+    hierarchy="two_level")`` bf16-casts only the DCN shard)."""
+    return bucket.dcn_compressor or bucket.compressor
+
+
+def wire_codec(bucket) -> int:
+    """The codec whose state the bucket carries: under TWO_LEVEL the only
+    wire transform is the DCN-hop codec (ICI phases are codec-free); flat
+    buckets use their own compressor.  PowerSGD never decomposes — a
+    PowerSGD bucket is realized flat regardless of the hierarchy knob
+    (the transformer normalizes it; see ``GraphTransformer``)."""
+    if (bucket.hierarchy == _AR.TWO_LEVEL
+            and bucket.compressor != _AR.PowerSGDCompressor):
+        return dcn_codec(bucket)
+    return bucket.compressor
 
 
 def elementwise(bucket) -> bool:
-    """True when the bucket's codec acts element-for-element on the flat
-    buffer — the codecs the overlap schedule may chunk, and the only ones
-    whose per-microbatch partial reduce (the in-scan overlap path of
-    ``graph_transformer``) is equivalent to the accumulated barrier reduce
-    up to rounding.  Block codecs (int8 blocks, PowerSGD factors) applied
-    to PARTIAL gradients compute a genuinely different approximation, so
-    they must sync once on the accumulated gradient."""
-    return bucket.compressor in _ELEMENTWISE_CODECS
+    """True when every wire transform of the bucket acts element-for-
+    element on the flat buffer — the buckets the overlap schedule may
+    chunk, and the only ones whose per-microbatch partial reduce (the
+    in-scan overlap path of ``graph_transformer``) is equivalent to the
+    accumulated barrier reduce up to rounding.  Block codecs (int8
+    blocks, PowerSGD factors) applied to PARTIAL gradients — or to
+    per-chunk re-blockings — compute a genuinely different approximation,
+    so those buckets sync whole, once, on the accumulated gradient."""
+    return wire_codec(bucket) in _ELEMENTWISE_CODECS \
+        and bucket.compressor in _ELEMENTWISE_CODECS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +125,12 @@ class Bucket:
     shapes: tuple
     compressor: int
     dtype: str
+    # AllReduceSynchronizer.Hierarchy, pre-resolved by the transformer
+    # (AUTO never reaches a Bucket); TWO_LEVEL buckets reduce via
+    # :func:`sync_hierarchical`'s ICI/DCN decomposition
+    hierarchy: int = 0
+    # Compressor enum for the cross-slice hop; 0 = follow `compressor`
+    dcn_compressor: int = 0
 
     @property
     def total(self):
@@ -70,7 +138,8 @@ class Bucket:
 
 
 def plan_buckets(plans, var_shapes, var_dtypes) -> List[Bucket]:
-    """Group AR-replicated dense vars by (group, dtype, compressor).
+    """Group AR-replicated dense vars by (group, dtype, compressor,
+    hierarchy, dcn_compressor).
 
     `plans`: name -> VarPlan; only vars with dense AllReduce-on-replicated
     placement participate (sparse vars sync in the lookup backward; sharded /
@@ -84,26 +153,36 @@ def plan_buckets(plans, var_shapes, var_dtypes) -> List[Bucket]:
             continue
         if plan.sparse:
             continue
-        key = (plan.group, str(var_dtypes[name]), plan.compressor)
+        key = (plan.group, str(var_dtypes[name]), plan.compressor,
+               plan.hierarchy, plan.dcn_compressor)
         groups.setdefault(key, []).append(name)
     buckets = []
-    for (group, dtype, comp), names in sorted(groups.items(), key=lambda kv: (kv[0][0], kv[0][1], kv[0][2])):
+    for (group, dtype, comp, hier, dcn), names in sorted(
+            groups.items(), key=lambda kv: kv[0]):
+        # the key string keeps its pre-hierarchy format for FLAT buckets so
+        # compressor-state checkpoints stay addressable
+        suffix = f"_h{hier}_d{dcn}" if hier == _AR.TWO_LEVEL else ""
         buckets.append(Bucket(
-            key=f"g{group}_{dtype}_c{comp}",
+            key=f"g{group}_{dtype}_c{comp}{suffix}",
             var_names=tuple(names),
             sizes=tuple(int(np.prod(var_shapes[n])) if var_shapes[n] else 1 for n in names),
             shapes=tuple(var_shapes[n] for n in names),
             compressor=comp,
             dtype=dtype,
+            hierarchy=hier,
+            dcn_compressor=dcn,
         ))
     return buckets
 
 
 def init_compressor_states(buckets):
-    """Residual state per stateful bucket (flat f32), else empty tuple."""
+    """Residual state per stateful bucket (flat f32), else empty tuple.
+    TWO_LEVEL buckets carry the state of their DCN-hop codec (the only
+    wire transform they apply) at full bucket size; each device reads and
+    writes only its own ICI-shard slice of it."""
     states = {}
     for b in buckets:
-        comp = get_compressor(b.compressor)
+        comp = get_compressor(wire_codec(b))
         states[b.key] = comp.init_state(b.total) if comp.stateful else ()
     return states
 
@@ -124,16 +203,88 @@ def _unpack_bucket(b, reduced, grads_by_name, synced):
         off += sz
 
 
-def sync_bucketed(grads_by_name, buckets, comp_states, axis_name):
-    """AllReduce all buckets; returns (synced grads dict, new comp states)."""
+def _two_level_reduce(buf, state, bucket, hier: HierAxes):
+    """Two-level mean of one flat buffer on a factored mesh:
+
+    1. intra-slice **reduce-scatter** over the ICI sub-axis (native dtype,
+       full precision) — every device ends up owning the slice-local SUM
+       of its 1/R_ici shard;
+    2. cross-slice **allreduce of the shard** over the DCN hop, through
+       the bucket's DCN codec (:func:`dcn_codec`) — the only wire
+       transform of the schedule, applied where bandwidth is scarce;
+    3. intra-slice **all-gather** over ICI rebuilds the full mean.
+
+    The codec returns the DCN-hop *mean* of the ICI partial sums, so a
+    final ``/ R_ici`` yields the full-axis mean.  Error-feedback codecs
+    keep their flat f32 residual at bucket size; each device slices the
+    region of the shard it quantizes (offset = ici index x shard) and
+    writes only that region back.
+    """
+    comp = get_compressor(dcn_codec(bucket))
+    n = buf.shape[0]
+    R_ici = jax.lax.axis_size(hier.ici)
+    shard = -(-n // R_ici)
+    padded = jnp.zeros((shard * R_ici,), buf.dtype).at[:n].set(buf)
+    local = jax.lax.psum_scatter(padded, hier.ici, scatter_dimension=0,
+                                 tiled=True)                  # (shard,)
+    if comp.stateful:
+        my = jax.lax.axis_index(hier.ici)
+        st_pad = jnp.zeros((shard * R_ici,), jnp.float32)
+        st_pad = st_pad.at[:state.shape[0]].set(state)
+        st = jax.lax.dynamic_slice_in_dim(st_pad, my * shard, shard)
+    else:
+        st = state
+    dcn_axes = hier.dcn if len(hier.dcn) > 1 else hier.dcn[0]
+    reduced, new_st = comp.all_reduce(local, st, dcn_axes)
+    reduced = reduced / R_ici                                  # full mean
+    full = jax.lax.all_gather(reduced, hier.ici, axis=0, tiled=True)
+    if comp.stateful:
+        new_state = jax.lax.dynamic_update_slice(st_pad, new_st,
+                                                 (my * shard,))
+        new_state = new_state[:state.shape[0]]
+    else:
+        new_state = state
+    return full[:n], new_state
+
+
+def _bucket_reduce(buf, state, bucket, axis_name, hier: Optional[HierAxes]):
+    """Reduce one flat buffer by the bucket's hierarchy: two-level on a
+    factored mesh, else the flat codec collective."""
+    if bucket.hierarchy == _AR.TWO_LEVEL:
+        if hier is None:
+            raise ValueError(
+                f"bucket {bucket.key}: TWO_LEVEL hierarchy but no "
+                f"replica_dcn x replica_ici axes were supplied")
+        return _two_level_reduce(buf, state, bucket, hier)
+    return get_compressor(bucket.compressor).all_reduce(buf, state, axis_name)
+
+
+def sync_bucketed(grads_by_name, buckets, comp_states, axis_name, hier=None):
+    """AllReduce all buckets; returns (synced grads dict, new comp states).
+    ``hier`` (a :class:`HierAxes`) realizes TWO_LEVEL buckets via the
+    hierarchical decomposition; FLAT buckets ignore it."""
     synced = {}
     new_states = dict(comp_states)
     for b in buckets:
-        comp = get_compressor(b.compressor)
         buf = _bucket_buf(grads_by_name, b)
-        reduced, new_states[b.key] = comp.all_reduce(buf, comp_states[b.key], axis_name)
+        reduced, new_states[b.key] = _bucket_reduce(
+            buf, comp_states[b.key], b, axis_name, hier)
         _unpack_bucket(b, reduced, grads_by_name, synced)
     return synced, new_states
+
+
+def sync_hierarchical(grads_by_name, buckets, comp_states, axis_name, hier):
+    """Two-level topology-aware barrier sync: every TWO_LEVEL bucket runs
+    intra-slice reduce-scatter (ICI) -> cross-slice shard allreduce (DCN,
+    through the DCN-hop codec) -> intra-slice all-gather; FLAT buckets
+    (e.g. PowerSGD fallbacks) keep their one-collective reduce.  The
+    barrier-schedule entry of the hierarchy — the overlap schedule routes
+    through :func:`sync_overlapped` with the same ``hier``."""
+    if hier is None:
+        raise ValueError("sync_hierarchical requires HierAxes (a mesh "
+                         "factored into replica_dcn x replica_ici)")
+    return sync_bucketed(grads_by_name, buckets, comp_states, axis_name,
+                         hier=hier)
 
 
 def _chunk_sizes(total_elems, dtype, max_bytes):
@@ -147,7 +298,7 @@ def _chunk_sizes(total_elems, dtype, max_bytes):
 
 
 def sync_overlapped(grads_by_name, buckets, comp_states, axis_name,
-                    max_chunk_bytes=DEFAULT_BUCKET_BYTES):
+                    max_chunk_bytes=DEFAULT_BUCKET_BYTES, hier=None):
     """Per-bucket pipelined sync (``schedule="overlap"``).
 
     Buckets are issued in REVERSE layer-topological order — backprop
@@ -159,14 +310,20 @@ def sync_overlapped(grads_by_name, buckets, comp_states, axis_name,
     the remaining backward compute (pipelined communication) instead of
     draining everything at one bucketed barrier.  Numerically equal to
     :func:`sync_bucketed` for every codec (see module docstring).
+
+    ``hier`` composes the TWO_LEVEL hierarchy with this issue order: each
+    per-bucket (or per-chunk) collective becomes the three-phase
+    ICI/DCN/ICI decomposition, still emitted reverse-topologically so the
+    scheduler can pipeline the hops of bucket i behind bucket i+1's
+    backward compute.
     """
     synced = {}
     new_states = dict(comp_states)
     for b in reversed(buckets):
-        comp = get_compressor(b.compressor)
+        comp = get_compressor(wire_codec(b))
         buf = _bucket_buf(grads_by_name, b)
         nbytes = b.total * np.dtype(b.dtype).itemsize
-        if b.compressor in _ELEMENTWISE_CODECS and nbytes > max_chunk_bytes:
+        if elementwise(b) and nbytes > max_chunk_bytes:
             sizes = _chunk_sizes(b.total, b.dtype, max_chunk_bytes)
             pieces, state_pieces, off = [], [], 0
             for sz in sizes:
@@ -174,7 +331,8 @@ def sync_overlapped(grads_by_name, buckets, comp_states, axis_name,
                 # bucket: slice it at the same offsets as the wire chunks
                 st = (comp_states[b.key][off:off + sz] if comp.stateful
                       else comp_states[b.key])
-                red, nst = comp.all_reduce(buf[off:off + sz], st, axis_name)
+                red, nst = _bucket_reduce(buf[off:off + sz], st, b,
+                                          axis_name, hier)
                 pieces.append(red)
                 state_pieces.append(nst)
                 off += sz
@@ -185,8 +343,8 @@ def sync_overlapped(grads_by_name, buckets, comp_states, axis_name,
             # block codecs (int8 blocks, PowerSGD factor matrices) reduce
             # whole-bucket so their state/blocking stays bit-identical to
             # the barrier schedule; they still reorder for latency hiding
-            reduced, new_states[b.key] = comp.all_reduce(
-                buf, comp_states[b.key], axis_name)
+            reduced, new_states[b.key] = _bucket_reduce(
+                buf, comp_states[b.key], b, axis_name, hier)
         _unpack_bucket(b, reduced, grads_by_name, synced)
     return synced, new_states
 

@@ -247,3 +247,23 @@ def get_compressor(enum_value) -> Compressor:
         return _REGISTRY[enum_value]()
     except KeyError:
         raise ValueError(f"Unknown compressor enum {enum_value}")
+
+
+def wire_byte_factor(enum_value, size=1):
+    """Wire bytes per uncompressed byte for a codec — the single source
+    the cost model and the telemetry hierarchy summary price compression
+    with.  ``size`` (flat element count) only matters for PowerSGD, whose
+    factor-matrix volume depends on the bucket geometry."""
+    _ = synchronizers_pb2.AllReduceSynchronizer
+    if enum_value == _.PowerSGDCompressor:
+        size = max(1, int(size))
+        rows, cols = PowerSGDCompressor._dims(size)
+        r = PowerSGDCompressor._rank(size)
+        return min(1.0, r * (rows + cols) / size)
+    return {
+        _.NoneCompressor: 1.0,
+        _.BF16Compressor: 0.5,
+        _.BF16CompressorEF: 0.5,
+        _.Int8Compressor: 0.25,
+        _.Int8CompressorEF: 0.25,
+    }.get(enum_value, 1.0)

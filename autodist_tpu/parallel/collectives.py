@@ -20,26 +20,42 @@ from autodist_tpu.const import DEFAULT_BUCKET_BYTES
 from autodist_tpu.utils import compat  # noqa: F401  (jax.lax.axis_size shim)
 
 
+def _norm_axes(axis_name):
+    """Normalize an axis argument: lists become tuples, a one-element
+    tuple collapses to its bare name.  All the reduce-family helpers below
+    accept a single axis name OR a tuple of names (the collective then
+    spans the product of those mesh axes, like ``axis_index``/``axis_size``
+    already do) — the shape the two-level hierarchical sync needs."""
+    if isinstance(axis_name, (tuple, list)):
+        axis_name = tuple(axis_name)
+        return axis_name[0] if len(axis_name) == 1 else axis_name
+    return axis_name
+
+
 def all_reduce_mean(x, axis_name):
-    """AllReduce-mean over the axis (reference merge_op=Add, final_op=Div,
-    ``compressor.py:84-96``)."""
-    return jax.lax.pmean(x, axis_name)
+    """AllReduce-mean over the axis or axes-tuple (reference merge_op=Add,
+    final_op=Div, ``compressor.py:84-96``)."""
+    return jax.lax.pmean(x, _norm_axes(axis_name))
 
 
 def all_reduce_sum(x, axis_name):
-    return jax.lax.psum(x, axis_name)
+    return jax.lax.psum(x, _norm_axes(axis_name))
 
 
 def reduce_scatter(x, axis_name, *, scatter_dimension=0, tiled=True, mean=False):
-    """Reduce-scatter over the axis; the grad half of weight-update sharding."""
+    """Reduce-scatter over the axis (or axes-tuple, major-to-minor shard
+    order); the grad half of weight-update sharding."""
+    axis_name = _norm_axes(axis_name)
     out = jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled)
     if mean:
-        out = out / jax.lax.axis_size(axis_name)
+        out = out / axis_size(axis_name)
     return out
 
 
 def all_gather(x, axis_name, *, axis=0, tiled=True):
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    """All-gather over the axis or axes-tuple (inverse of reduce_scatter's
+    shard order)."""
+    return jax.lax.all_gather(x, _norm_axes(axis_name), axis=axis, tiled=tiled)
 
 
 def all_to_all(x, axis_name, split_axis, concat_axis):

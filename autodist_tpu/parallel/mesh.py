@@ -14,7 +14,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from autodist_tpu.const import AXIS_REPLICA
+from autodist_tpu.const import AXIS_REPLICA, AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
 
 
 def _factorize(n, sizes):
@@ -33,7 +33,24 @@ def _factorize(n, sizes):
     return sizes
 
 
-def build_mesh(resource_spec=None, axes=None, devices=None):
+def hierarchical_axes(resource_spec, n_devices):
+    """Factor ``n_devices`` into ``{replica_dcn, replica_ici}`` keyed off
+    the spec's host boundaries: replica_dcn = accelerator-carrying nodes,
+    replica_ici = chips per node.  Falls back to the flat 1-D ``replica``
+    axis when the spec is single-node (nothing to factor) or the chips do
+    not split evenly across hosts (heterogeneous nodes)."""
+    n_hosts = 0
+    if resource_spec is not None:
+        accel_hosts = {d.address for _, d in resource_spec.accelerator_devices}
+        # CPU-emulation specs (no accelerators at all) factor by node too
+        n_hosts = len(accel_hosts) or len(resource_spec.node_addresses)
+    if n_hosts > 1 and n_devices % n_hosts == 0:
+        return {AXIS_REPLICA_DCN: n_hosts,
+                AXIS_REPLICA_ICI: n_devices // n_hosts}
+    return {AXIS_REPLICA: n_devices}
+
+
+def build_mesh(resource_spec=None, axes=None, devices=None, hierarchy=False):
     """Build a ``jax.sharding.Mesh``.
 
     Args:
@@ -43,10 +60,18 @@ def build_mesh(resource_spec=None, axes=None, devices=None):
       axes: optional OrderedDict-like {axis_name: size}; size -1 = infer.
         Defaults to ``{"replica": <all devices>}``.
       devices: optional explicit list of jax devices.
+      hierarchy: when True (and no explicit ``axes``/``mesh:`` request),
+        factor the replica axis into ``replica_dcn x replica_ici``
+        sub-axes keyed off the spec's host boundaries
+        (:func:`hierarchical_axes`) — the mesh shape the TWO_LEVEL sync
+        schedule requires.  A YAML ``mesh:`` request naming the sub-axes
+        explicitly overrides the automatic factorization.
 
     The device order follows ``jax.devices()`` (process-major), so the
     ``replica`` axis rides ICI within a host and DCN across hosts — the
-    layout that keeps the hot collectives on ICI.
+    layout that keeps the hot collectives on ICI, and the reason the
+    ``replica_dcn`` (major) x ``replica_ici`` (minor) factorization lands
+    each ICI sub-ring inside one host.
     """
     if devices is None:
         devices = jax.devices()
@@ -56,6 +81,8 @@ def build_mesh(resource_spec=None, axes=None, devices=None):
         n_spec = resource_spec.num_accelerators
         if n_spec and n_spec < len(devices):
             devices = devices[:n_spec]
+    if axes is None and hierarchy:
+        axes = hierarchical_axes(resource_spec, len(devices))
     if axes is None:
         axes = {AXIS_REPLICA: len(devices)}
     names = tuple(axes.keys())

@@ -23,6 +23,13 @@ Model (per step, seconds):
                ring-AR and reduce-scatter+all-gather are IDENTICAL (that
                equivalence is how the engine realizes PS), so this term
                is what genuinely separates the dense strategies.
+  two-level  ~ AR vars under ``Hierarchy.TWO_LEVEL`` (or AUTO on a
+               replica_dcn x replica_ici factored mesh) price per hop:
+               reduce-scatter + all-gather of the full volume INSIDE the
+               slice at ICI bandwidth, plus a ring allreduce of only the
+               1/R_ici shard (scaled by the DCN-hop codec's wire factor)
+               across slices at DCN bandwidth — replacing the flat
+               min(ici, dcn) ring that ships the whole gradient over DCN.
   overlap    ~ strategies with ``schedule="overlap"`` price comm and
                compute as max(comm, compute) + exposed-tail instead of
                the serialized hi + 0.7*lo: the per-bucket collectives
@@ -204,6 +211,26 @@ def _gather_time(bytes_, n, bw_bytes_per_s):
     return (n - 1) / n * bytes_ / bw_bytes_per_s
 
 
+def _hier_factors(strategy, resource_spec, R):
+    """``(R_dcn, R_ici)`` of the two-level factorization, from a mesh that
+    actually DECLARES the sub-axes: the strategy's ``graph_config.mesh``
+    (the two-level builders write the host-boundary factorization there)
+    or the spec's ``mesh:`` request.  ``(1, R)`` otherwise — the engine
+    realizes FLAT on an unfactored mesh, so the model must price it flat
+    too (an AUTO strategy on a plain multi-node spec stays a flat ring)."""
+    from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+
+    for sizes in (
+            dict(zip(strategy.proto.graph_config.mesh.axis_names,
+                     strategy.proto.graph_config.mesh.axis_sizes))
+            if strategy is not None else {},
+            resource_spec.mesh_request or {} if resource_spec is not None
+            else {}):
+        if AXIS_REPLICA_DCN in sizes and AXIS_REPLICA_ICI in sizes:
+            return int(sizes[AXIS_REPLICA_DCN]), int(sizes[AXIS_REPLICA_ICI])
+    return 1, R
+
+
 def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
              batch_per_chip=32, peak_flops=DEFAULT_PEAK_FLOPS,
              mxu_eff=DEFAULT_MXU_EFF, ici_gbps=DEFAULT_ICI_GBPS,
@@ -238,6 +265,15 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
     mesh_req = resource_spec.mesh_request or {}
     subset_ps_bytes = 0
     subset_R = subset_other = 1
+
+    # two-level hierarchy (AllReduceSynchronizer.Hierarchy.TWO_LEVEL, or
+    # AUTO on a factored mesh): the AR family's bulk reduce-scatter +
+    # all-gather phases stay on ICI and only the 1/R_ici shard (optionally
+    # wire-compressed) rides the DCN ring — priced per hop below instead
+    # of the flat min(ici, dcn) ring
+    R_dcn, R_ici = _hier_factors(strategy, resource_spec, R)
+    mesh_factored = R_dcn > 1
+    hier_ici_bytes = hier_dcn_bytes = 0.0
 
     ar_bytes = ps_bytes = gather_bytes = sparse_bytes = 0
     update_bytes = 0.0
@@ -302,28 +338,28 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
             if plan.schedule == _C.OVERLAP:
                 ar_overlap = True
             ar_bucket_keys.add((plan.group, str(plan.dtype),
-                                plan.compressor))
-            if plan.compressor == _C.PowerSGDCompressor:
-                # PowerSGD: wire = r*(rows+cols) floats
-                from autodist_tpu.kernel.synchronization.compressor import (
-                    PowerSGDCompressor,
-                )
+                                plan.compressor, plan.hierarchy,
+                                plan.dcn_compressor))
+            # wire factors keyed on the proto enum (not raw ints) so a
+            # reordering in synchronizers.proto cannot skew rankings;
+            # PowerSGD's factor depends on the bucket geometry
+            from autodist_tpu.kernel.synchronization.compressor import (
+                wire_byte_factor,
+            )
 
-                size = max(1, v.size)
-                rows, cols = PowerSGDCompressor._dims(size)
-                r = PowerSGDCompressor._rank(size)
-                comp_factor = min(1.0, r * (rows + cols) / size)
+            comp_factor = wire_byte_factor(plan.compressor, max(1, v.size))
+            # mirror the engine's hierarchy resolution: explicit TWO_LEVEL
+            # or AUTO, on a factored mesh; PowerSGD never decomposes
+            two_level = (mesh_factored
+                         and plan.hierarchy != _C.FLAT
+                         and plan.compressor != _C.PowerSGDCompressor)
+            if two_level:
+                dcn_factor = wire_byte_factor(
+                    plan.dcn_compressor or plan.compressor, max(1, v.size))
+                hier_ici_bytes += 2.0 * nbytes    # scatter + gather phases
+                hier_dcn_bytes += nbytes * dcn_factor / R_ici
             else:
-                # keyed on the proto enum (not raw ints) so a reordering in
-                # synchronizers.proto cannot silently skew rankings
-                comp_factor = {
-                    _C.NoneCompressor: 1.0,
-                    _C.BF16Compressor: 0.5,
-                    _C.BF16CompressorEF: 0.5,
-                    _C.Int8Compressor: 0.25,
-                    _C.Int8CompressorEF: 0.25,
-                }.get(plan.compressor, 1.0)
-            ar_bytes += nbytes * comp_factor
+                ar_bytes += nbytes * comp_factor
 
     comm_s = (_ring_time(ar_bytes, R, bw)
               + _gather_time(ps_bytes, R, bw)      # reduce-scatter of grads
@@ -338,18 +374,32 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         subset_s = (2.0 * _gather_time(subset_ps_bytes, subset_R, ici_bw)
                     + _ring_time(subset_ps_bytes / subset_R, subset_other, bw))
         comm_s += subset_s
+    # two-level AR: both bulk phases priced at ICI bandwidth inside the
+    # slice + the shard-sized ring at DCN bandwidth across slices —
+    # replacing the flat min(bw) ring those vars would otherwise pay
+    hier_ici_s = hier_dcn_s = 0.0
+    if hier_ici_bytes:
+        ici_bw = ici_gbps * 1e9 / 8
+        dcn_bw = dcn_gbps * 1e9 / 8
+        hier_ici_s = _gather_time(hier_ici_bytes, R_ici, ici_bw)
+        hier_dcn_s = _ring_time(hier_dcn_bytes, R_dcn, dcn_bw)
+        comm_s += hier_ici_s + hier_dcn_s
     update_s = opt_bytes_factor * update_bytes / (hbm_gbps * 1e9)
     # overlap schedule (arXiv 2004.13336-style pipelining under the
     # latency-hiding scheduler): the per-bucket collectives hide behind
     # remaining backward FLOPs — total becomes max(comm, compute) — except
     # the topologically LAST bucket, whose reduce has no backward left to
-    # hide behind; one bucket's share of the AR ring time stays exposed
-    ar_ring_s = _ring_time(ar_bytes, R, bw)
+    # hide behind; one bucket's share of the AR time stays exposed
+    ar_ring_s = _ring_time(ar_bytes, R, bw) + hier_ici_s + hier_dcn_s
     exposed_s = ar_ring_s / max(1, len(ar_bucket_keys))
     return CostEstimate(compute_s + update_s, comm_s, {
         "ar_bytes": ar_bytes, "ps_bytes": ps_bytes,
         "gather_bytes": gather_bytes, "sparse_bytes": sparse_bytes,
         "subset_ps_bytes": subset_ps_bytes, "subset_ps_s": subset_s,
+        "hier_ici_bytes": hier_ici_bytes, "hier_dcn_bytes": hier_dcn_bytes,
+        "hier_ici_s": hier_ici_s, "hier_dcn_s": hier_dcn_s,
+        "hier_replica_dcn": R_dcn if hier_ici_bytes else 1,
+        "hier_replica_ici": R_ici if hier_ici_bytes else R,
         "update_bytes": update_bytes, "update_s": update_s,
         "ar_buckets": len(ar_bucket_keys), "overlap_exposed_s": exposed_s,
         "num_replicas": R},

@@ -11,7 +11,8 @@ handled by the sparse all-gather synchronizer, matching in capability.
 """
 from autodist_tpu.proto import synchronizers_pb2
 from autodist_tpu.strategy.base import (Strategy, StrategyBuilder,
-                                        resolve_compressor, resolve_schedule)
+                                        resolve_compressor,
+                                        resolve_hierarchy, resolve_schedule)
 
 _SPECS = {
     "AUTO": synchronizers_pb2.AllReduceSynchronizer.AUTO,
@@ -25,12 +26,27 @@ _SPECS = {
 
 class AllReduce(StrategyBuilder):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO",
-                 compressor="NoneCompressor", schedule="barrier"):
+                 compressor="NoneCompressor", schedule="barrier",
+                 hierarchy="auto", dcn_compressor=None):
         """``schedule="overlap"`` emits per-bucket collectives in reverse
         layer-topological order and compiles with XLA's latency-hiding
         scheduler so each bucket's reduce hoists behind remaining backward
         compute; ``"barrier"`` (default) syncs all buckets after the full
-        backward pass (docs/performance.md "Overlap scheduler")."""
+        backward pass (docs/performance.md "Overlap scheduler").
+
+        ``hierarchy="two_level"`` requests the topology-aware schedule:
+        intra-slice reduce-scatter over ICI, cross-slice ring allreduce of
+        the 1/R_ici shard over DCN, intra-slice all-gather — so the slow
+        DCN wire carries a shard instead of the full gradient volume.  It
+        also asks the build to factor the mesh into ``replica_dcn x
+        replica_ici`` sub-axes from the spec's host boundaries when the
+        YAML carries no explicit ``mesh:`` request.  ``"auto"`` (default)
+        follows the mesh: two-level on a factored mesh, flat otherwise.
+        ``dcn_compressor`` optionally names the codec for the cross-slice
+        hop only (elementwise family or int8; ICI phases always stay full
+        precision) — default: the strategy's own ``compressor``
+        (docs/performance.md "Hierarchical sync").
+        """
         if chunk_size < 1:
             raise ValueError("The chunk_size must be greater than zero")
         self.chunk_size = chunk_size
@@ -38,6 +54,11 @@ class AllReduce(StrategyBuilder):
         self.compressor = compressor
         resolve_schedule(schedule)  # fail at construction, not build
         self.schedule = schedule
+        resolve_hierarchy(hierarchy)
+        self.hierarchy = hierarchy
+        if dcn_compressor is not None:
+            resolve_compressor(dcn_compressor)
+        self.dcn_compressor = dcn_compressor
 
     def _fill_node(self, n, v, group):
         n.var_name = v.name
@@ -48,6 +69,24 @@ class AllReduce(StrategyBuilder):
         ar.compressor = resolve_compressor(self.compressor)
         ar.group = group
         ar.schedule = resolve_schedule(self.schedule)
+        ar.hierarchy = resolve_hierarchy(self.hierarchy)
+        if self.dcn_compressor is not None:
+            ar.dcn_compressor = resolve_compressor(self.dcn_compressor)
+
+    def make_graph_config(self, strategy, resource_spec):
+        """Replicas + mesh, factored into ``replica_dcn x replica_ici``
+        sub-axes (host boundaries) when this builder requests the
+        two-level hierarchy and the YAML has no explicit ``mesh:``."""
+        StrategyBuilder.make_graph_config(strategy, resource_spec)
+        _AR = synchronizers_pb2.AllReduceSynchronizer
+        if (resolve_hierarchy(self.hierarchy) == _AR.TWO_LEVEL
+                and not resource_spec.mesh_request):
+            from autodist_tpu.parallel.mesh import hierarchical_axes
+
+            axes = hierarchical_axes(resource_spec,
+                                     len(strategy.graph_config.replicas))
+            strategy.graph_config.mesh.axis_names[:] = list(axes.keys())
+            strategy.graph_config.mesh.axis_sizes[:] = list(axes.values())
 
     def build(self, model_item, resource_spec):
         s = Strategy()

@@ -11,13 +11,13 @@ from autodist_tpu.strategy.base import Strategy, StrategyBuilder
 from autodist_tpu.utils import logging
 
 
-def default_candidates():
+def default_candidates(resource_spec=None):
     from autodist_tpu.strategy import (
         PS, AllReduce, Parallax, PartitionedAR, PartitionedPS,
         PSLoadBalancing, UnevenPartitionedPS,
     )
 
-    return [
+    cands = [
         AllReduce(),
         AllReduce(compressor="BF16Compressor"),
         AllReduce(schedule="overlap"),
@@ -30,6 +30,19 @@ def default_candidates():
         Parallax(schedule="overlap"),
         Parallax(compressor="BF16Compressor"),
     ]
+    if resource_spec is not None and not resource_spec.is_single_node:
+        # multi-node: the DCN hop bottlenecks every flat collective, so
+        # enumerate the two-level hierarchy (ICI reduce-scatter -> DCN
+        # shard ring -> ICI all-gather), with and without DCN-hop wire
+        # compression, under both issue schedules
+        cands += [
+            AllReduce(hierarchy="two_level"),
+            AllReduce(hierarchy="two_level",
+                      dcn_compressor="BF16Compressor"),
+            AllReduce(hierarchy="two_level", schedule="overlap"),
+            Parallax(hierarchy="two_level"),
+        ]
+    return cands
 
 
 class AutoStrategy(StrategyBuilder):
@@ -95,7 +108,7 @@ class AutoStrategy(StrategyBuilder):
     def build(self, model_item, resource_spec) -> Strategy:
         from autodist_tpu.simulator.cost_model import rank_strategies
 
-        cands = self._candidates or default_candidates()
+        cands = self._candidates or default_candidates(resource_spec)
         if self._verify:
             cands, self.last_rejected = self._screen(
                 cands, model_item, resource_spec)

@@ -177,6 +177,34 @@ def resolve_schedule(name_or_value):
             f"{_enum_choices(_SCHEDULE_ALIASES)}") from None
 
 
+_HIERARCHY_ALIASES = {
+    "auto": synchronizers_pb2.AllReduceSynchronizer.AUTO_HIERARCHY,
+    "flat": synchronizers_pb2.AllReduceSynchronizer.FLAT,
+    "two_level": synchronizers_pb2.AllReduceSynchronizer.TWO_LEVEL,
+    # spelling aliases
+    "hierarchical": synchronizers_pb2.AllReduceSynchronizer.TWO_LEVEL,
+    "2level": synchronizers_pb2.AllReduceSynchronizer.TWO_LEVEL,
+}
+
+
+def resolve_hierarchy(name_or_value):
+    """Map a user-facing ``hierarchy="auto"|"flat"|"two_level"`` knob (or
+    the raw proto enum) to ``AllReduceSynchronizer.Hierarchy``; unknown
+    inputs raise with the full accepted name/value table."""
+    if isinstance(name_or_value, int):
+        if name_or_value in set(_HIERARCHY_ALIASES.values()):
+            return name_or_value
+        raise ValueError(
+            f"Unknown hierarchy enum value {name_or_value}; accepted "
+            f"names/values: {_enum_choices(_HIERARCHY_ALIASES)}")
+    try:
+        return _HIERARCHY_ALIASES[str(name_or_value).lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown hierarchy {name_or_value!r}; accepted names/values: "
+            f"{_enum_choices(_HIERARCHY_ALIASES)}") from None
+
+
 class StrategyCompiler:
     """Resolve + prune a strategy against the concrete cluster.
 

@@ -13,9 +13,11 @@ from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing, byte_size_load
 class Parallax(AllReduce):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor",
                  local_proxy_variable=False, sync=True, staleness=0,
-                 ps_axes=None, schedule="barrier"):
+                 ps_axes=None, schedule="barrier", hierarchy="auto",
+                 dcn_compressor=None):
         super().__init__(chunk_size, all_reduce_spec, compressor,
-                         schedule=schedule)
+                         schedule=schedule, hierarchy=hierarchy,
+                         dcn_compressor=dcn_compressor)
         self._local_replication = local_proxy_variable
         self._sync = sync
         self._staleness = staleness

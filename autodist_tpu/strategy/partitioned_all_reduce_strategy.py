@@ -11,9 +11,11 @@ from autodist_tpu.strategy.partitioned_ps_strategy import get_num_shards
 
 class PartitionedAR(AllReduce):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor",
-                 max_shards=None, schedule="barrier"):
+                 max_shards=None, schedule="barrier", hierarchy="auto",
+                 dcn_compressor=None):
         super().__init__(chunk_size, all_reduce_spec, compressor,
-                         schedule=schedule)
+                         schedule=schedule, hierarchy=hierarchy,
+                         dcn_compressor=dcn_compressor)
         self._max_shards = max_shards
 
     def _shards_for(self, v, num_devices):

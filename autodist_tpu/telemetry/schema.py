@@ -10,7 +10,12 @@ One JSON object per line.  Every record carries:
 Kinds and their required fields (``docs/observability.md`` is the prose
 version; ``make telemetry-check`` asserts a live run validates):
 
-- ``meta``      — run header: ``run_id``, ``backend``, ``num_devices``
+- ``meta``      — run header: ``run_id``, ``backend``, ``num_devices``;
+                  optional ``sync_schedule``, ``hierarchy`` (chosen sync
+                  hierarchy + per-hop wire bytes: ``mode``,
+                  ``replica_dcn``/``replica_ici``, ``ici_hop_bytes``,
+                  ``dcn_hop_bytes``, ``dcn_compressors``),
+                  ``cost_estimate``
 - ``step``      — per-step record: ``step``, ``wall_s``; optional
                   ``wall_cancelled_s``, ``throughput_eps``, ``mfu``,
                   ``examples``, ``compile_s``, ``trace_dir``

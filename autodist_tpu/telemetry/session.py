@@ -90,6 +90,19 @@ class SessionTelemetry:
             "sync_schedule": getattr(self._t, "sync_schedule", None),
             "run_dir": self.run_dir,
         }
+        # chosen sync hierarchy + static per-hop wire volumes, so reports
+        # can put predicted per-hop comm time next to measured walls
+        try:
+            hier = self._t.hierarchy_summary()
+        except Exception:
+            hier = None
+        if hier is not None:
+            meta["hierarchy"] = hier
+            if hier["mode"] == "two_level":
+                self.registry.gauge("sync.ici_hop_bytes",
+                                    hier["ici_hop_bytes"])
+                self.registry.gauge("sync.dcn_hop_bytes",
+                                    hier["dcn_hop_bytes"])
         est = self._predicted_estimate()
         if est is not None:
             meta["cost_estimate"] = est

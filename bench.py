@@ -216,6 +216,37 @@ def _bench_schedule():
             else "barrier")
 
 
+def _bench_hierarchy_spec(n_chips):
+    """``BENCH_HIERARCHY=flat|two_level`` gradient-sync hierarchy lever
+    (docs/performance.md "Hierarchical sync").  ``two_level`` factors the
+    mesh into ``replica_dcn x replica_ici`` — by host boundaries on a
+    multi-process run, else ``BENCH_DCN_SLICES`` (default 2) synthetic
+    slices so the schedule is exercisable single-host — and selects the
+    ICI reduce-scatter -> DCN shard ring -> ICI all-gather schedule.
+    Returns ``(resource_spec, hierarchy_name)``; falls back to flat (with
+    the reason recorded in the result's ``sync_hierarchy``) when the chip
+    count does not factor."""
+    import jax
+
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    mode = os.environ.get("BENCH_HIERARCHY", "flat")
+    if mode != "two_level":
+        return ResourceSpec.from_num_chips(n_chips), "flat"
+    n_slices = jax.process_count()
+    if n_slices <= 1:
+        n_slices = int(os.environ.get("BENCH_DCN_SLICES", "2"))
+    if n_slices <= 1 or n_chips % n_slices or n_chips // n_slices < 1:
+        return ResourceSpec.from_num_chips(n_chips), \
+            f"flat (cannot factor {n_chips} chips into {n_slices} slices)"
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": list(range(n_chips)),
+                   "chief": True}],
+        "mesh": {"replica_dcn": n_slices,
+                 "replica_ici": n_chips // n_slices}})
+    return spec, "two_level"
+
+
 def _build_resnet(n_chips, batch_per_chip):
     """Returns (sess, gbatch, train_flops_per_example, extras)."""
     import jax.numpy as jnp
@@ -223,7 +254,6 @@ def _build_resnet(n_chips, batch_per_chip):
 
     from autodist_tpu.autodist import AutoDist
     from autodist_tpu.models import ResNet50, train_lib
-    from autodist_tpu.resource_spec import ResourceSpec
     from autodist_tpu.strategy import AllReduce
 
     B = batch_per_chip * n_chips
@@ -234,9 +264,10 @@ def _build_resnet(n_chips, batch_per_chip):
     stem = os.environ.get("BENCH_STEM", "conv")
     bn_f32 = os.environ.get("BENCH_BN_STATS", "f32") != "bf16"
     schedule = _bench_schedule()
+    spec, hierarchy = _bench_hierarchy_spec(n_chips)
     model = ResNet50(num_classes=1000, stem=stem, bn_f32_stats=bn_f32)
     loss_fn, params, state = train_lib.classifier_capture(model, (224, 224, 3))
-    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
+    ad = AutoDist(resource_spec=spec,
                   strategy_builder=AllReduce(schedule=schedule))
     sess = ad.distribute(loss_fn, params, train_lib.sgd_momentum(0.1),
                          mutable_state=state)
@@ -250,7 +281,7 @@ def _build_resnet(n_chips, batch_per_chip):
     gbatch["image"] = jnp.asarray(gbatch["image"], jnp.bfloat16)
     return sess, gbatch, MODELS["resnet50"]["train_flops_per_example"], {
         "stem": stem, "bn_stats": "f32" if bn_f32 else "bf16",
-        "sync_schedule": schedule}
+        "sync_schedule": schedule, "sync_hierarchy": hierarchy}
 
 
 def _build_gpt(n_chips, batch_per_chip):
@@ -264,18 +295,18 @@ def _build_gpt(n_chips, batch_per_chip):
 
     from autodist_tpu.autodist import AutoDist
     from autodist_tpu.models import GPT_SMALL, train_lib
-    from autodist_tpu.resource_spec import ResourceSpec
     from autodist_tpu.strategy import AllReduce
 
     S = int(os.environ.get("BENCH_SEQ_LEN", "1024"))
     streaming = os.environ.get("BENCH_STREAMING_LOSS", "1") != "0"
     remat = os.environ.get("BENCH_REMAT", "1") != "0"
     schedule = _bench_schedule()
+    spec, hierarchy = _bench_hierarchy_spec(n_chips)
     cfg = dataclasses.replace(GPT_SMALL, max_position=max(
         S, GPT_SMALL.max_position), remat=remat)
     loss_fn, params, sparse = train_lib.gpt_capture(
         cfg, S, streaming_loss=streaming)
-    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
+    ad = AutoDist(resource_spec=spec,
                   strategy_builder=AllReduce(schedule=schedule))
     sess = ad.distribute(loss_fn, params, optax.adamw(1e-4),
                          sparse_vars=sparse, has_rng=True)
@@ -297,7 +328,8 @@ def _build_gpt(n_chips, batch_per_chip):
                        + 2.0 * cfg.num_layers * S * S * cfg.hidden_size)
     return sess, gbatch, 3.0 * fwd_per_example / S, {
         "seq_len": S, "streaming_loss": streaming, "remat": remat,
-        "sync_schedule": schedule, "tokens_per_example": S}
+        "sync_schedule": schedule, "sync_hierarchy": hierarchy,
+        "tokens_per_example": S}
 
 
 def _bench():

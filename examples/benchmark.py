@@ -228,19 +228,26 @@ def _real_pipeline(args, cap, B, sess):
 
 
 def _make_builder(args, strategy_name):
-    """``Name`` or ``Name:overlap`` / ``Name:barrier`` (the AllReduce-family
-    sync schedule); ``--ar_chunk_size`` sets the family's bucket-group
-    granularity so the overlap term has buckets to pipeline."""
+    """``Name`` or ``Name:variant[:variant]`` — AllReduce-family variants:
+    ``overlap``/``barrier`` (sync schedule) and ``two_level``/``flat``
+    (sync hierarchy), e.g. ``AllReduce:two_level`` or
+    ``AllReduce:overlap:two_level``; ``--ar_chunk_size`` sets the
+    family's bucket-group granularity so the overlap term has buckets to
+    pipeline."""
     from autodist_tpu import strategy as S
 
-    name, _, variant = strategy_name.partition(":")
+    name, _, variants = strategy_name.partition(":")
     builder_cls = getattr(S, name)
     kwargs = {}
-    if variant:
-        if variant not in ("overlap", "barrier"):
+    for variant in (v for v in variants.split(":") if v):
+        if variant in ("overlap", "barrier"):
+            kwargs["schedule"] = variant
+        elif variant in ("two_level", "flat"):
+            kwargs["hierarchy"] = variant
+        else:
             raise SystemExit(f"unknown strategy variant {variant!r} in "
-                             f"{strategy_name!r} (overlap | barrier)")
-        kwargs["schedule"] = variant
+                             f"{strategy_name!r} (overlap | barrier | "
+                             f"two_level | flat)")
     if args.ar_chunk_size and issubclass(builder_cls, S.AllReduce):
         kwargs["chunk_size"] = args.ar_chunk_size
     return builder_cls(**kwargs)
@@ -249,12 +256,11 @@ def _make_builder(args, strategy_name):
 def run_one(args, strategy_name, cap, n_chips):
     """Build a session under one strategy; measure; return (eps, record)."""
     from autodist_tpu.autodist import AutoDist
-    from autodist_tpu.resource_spec import ResourceSpec
     from autodist_tpu.simulator.cost_model import measure_and_record
 
     B = args.batch_per_chip * n_chips
     builder = _make_builder(args, strategy_name)
-    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n_chips),
+    ad = AutoDist(resource_spec=_spec(n_chips, mesh=_parse_mesh(args.mesh)),
                   strategy_builder=builder)
     sess = ad.distribute(cap["loss_fn"], cap["params"], cap["optimizer"],
                          sparse_vars=cap["sparse_vars"], has_rng=cap["has_rng"],
@@ -323,7 +329,8 @@ def sweep(args):
                     streaming_loss=args.streaming_loss, remat=args.remat)
         eps, record, sess = run_one(args, name, cap, n_chips)
         measured[name] = record.step_time_s
-        est = estimate(sess._t.strategy, sess._t.model_item, _spec(n_chips),
+        est = estimate(sess._t.strategy, sess._t.model_item,
+                       _spec(n_chips, mesh=_parse_mesh(args.mesh)),
                        flops_per_example=_fwd_flops_per_example(
                            args.model, cap["params"], args.seq_len,
                            cap.get("cfg")) or 0.0,
@@ -357,9 +364,27 @@ def sweep(args):
     return summary
 
 
-def _spec(n_chips):
+def _parse_mesh(mesh_arg):
+    """``"replica_dcn=2,replica_ici=4"`` -> {axis: size} or None."""
+    if not mesh_arg:
+        return None
+    axes = {}
+    for part in mesh_arg.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise SystemExit(f"--mesh entry {part!r} is not name=size")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def _spec(n_chips, mesh=None):
     from autodist_tpu.resource_spec import ResourceSpec
 
+    if mesh:
+        return ResourceSpec(resource_info={
+            "nodes": [{"address": "localhost",
+                       "chips": list(range(n_chips)), "chief": True}],
+            "mesh": mesh})
     return ResourceSpec.from_num_chips(n_chips)
 
 
@@ -372,12 +397,18 @@ def main():
     ap.add_argument("--strategies", default="",
                     help="comma list -> per-strategy sweep + cost-model "
                          "validation (e.g. 'AllReduce,PS,PartitionedPS,"
-                         "Parallax'); an AllReduce-family entry takes an "
-                         "optional ':overlap'/':barrier' sync-schedule "
-                         "suffix")
+                         "Parallax'); an AllReduce-family entry takes "
+                         "optional ':overlap'/':barrier' (sync schedule) "
+                         "and ':two_level'/':flat' (sync hierarchy) "
+                         "suffixes")
     ap.add_argument("--ar_chunk_size", type=int, default=0,
                     help="bucket-group granularity (vars per group) for "
                          "AllReduce-family builders; 0 = builder default")
+    ap.add_argument("--mesh", default="",
+                    help="explicit mesh request, e.g. "
+                         "'replica_dcn=2,replica_ici=4' — factor the "
+                         "replica axis so ':two_level' strategies realize "
+                         "the hierarchical sync schedule")
     ap.add_argument("--records_dir", default="",
                     help="dump AutoSync-style RuntimeRecords + summary here")
     ap.add_argument("--data", choices=("synthetic", "real"),

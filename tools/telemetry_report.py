@@ -96,6 +96,9 @@ def summarize_manifest(records):
         if budget:
             out["hbm_budget_bytes"] = budget
             out["hbm_headroom_bytes"] = budget - max(peaks)
+    hier = meta.get("hierarchy")
+    if hier:
+        out["hierarchy"] = hier
     est = meta.get("cost_estimate")
     if est:
         out["predicted"] = {
@@ -104,6 +107,11 @@ def summarize_manifest(records):
             "overlapped_s": est.get("overlapped_s"),
             "schedule": est.get("schedule"),
         }
+        # per-hop predicted comm time of the two-level schedule, next to
+        # the recorded per-hop wire volumes (meta["hierarchy"])
+        if est.get("hier_ici_s") or est.get("hier_dcn_s"):
+            out["predicted"]["ici_hop_s"] = est.get("hier_ici_s")
+            out["predicted"]["dcn_hop_s"] = est.get("hier_dcn_s")
         ser, ovl = est.get("serialized_s"), est.get("overlapped_s")
         if ser and ovl is not None and ser > 0:
             # the overlap credit the schedule is predicted to earn: 0 =
@@ -147,10 +155,23 @@ def render(summary):
             line += (f" of {_fmt_bytes(summary['hbm_budget_bytes'])} "
                      f"(headroom {_fmt_bytes(summary['hbm_headroom_bytes'])})")
         add(line)
+    hier = summary.get("hierarchy")
+    if hier and hier.get("mode") == "two_level":
+        add(f"sync hierarchy: two_level "
+            f"(replica_dcn={hier.get('replica_dcn')} x "
+            f"replica_ici={hier.get('replica_ici')}) — "
+            f"ICI hops {_fmt_bytes(int(hier.get('ici_hop_bytes', 0)))}, "
+            f"DCN hop {_fmt_bytes(int(hier.get('dcn_hop_bytes', 0)))}"
+            + (f" [{'/'.join(hier['dcn_compressors'])} on DCN]"
+               if hier.get("dcn_compressors") else ""))
     pred = summary.get("predicted")
     if pred:
         add(f"cost model: predicted {_fmt_s(pred.get('total_s'))} "
             f"({pred.get('schedule')} schedule)")
+        if pred.get("ici_hop_s") is not None or pred.get("dcn_hop_s") is not None:
+            add(f"  per-hop comm: ICI {_fmt_s(pred.get('ici_hop_s'))} + "
+                f"DCN {_fmt_s(pred.get('dcn_hop_s'))} (measured wall "
+                f"p50 {_fmt_s(summary.get('step_time_p50_s'))})")
         if "predicted_overlap_credit" in summary:
             add(f"  comm/compute overlap credit: "
                 f"{summary['predicted_overlap_credit']:.1%} "
