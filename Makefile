@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify telemetry-check check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -64,6 +64,15 @@ verify:
 	$(PY) tools/verify_strategy.py records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --selftest
 
+# HLO communication audit (docs/analysis.md "HLO audit"): lower every
+# recorded strategy's step and diff the REALIZED collective schedule
+# against the strategy's plan (X-codes) — an implicit-reshard all_to_all
+# or a dropped sync collective fails the gate; the seeded reshard case
+# (--selftest) must be caught as X001
+audit:
+	$(PY) tools/verify_strategy.py --hlo records/cpu_mesh/*.json
+	$(PY) tools/verify_strategy.py --hlo --selftest
+
 # live telemetry gate (docs/observability.md): a 5-step CPU-mesh session
 # with telemetry on must emit a schema-valid JSONL manifest with per-step
 # walls / throughput / MFU / memory snapshots, render through
@@ -71,10 +80,10 @@ verify:
 telemetry-check:
 	$(PY) tools/telemetry_check.py
 
-# the pre-merge gate: lint + strategy verification + live telemetry
-# (tests/test_analysis.py + test_telemetry.py run the same chains, so
-# tier-1 exercises it)
-check: lint verify telemetry-check
+# the pre-merge gate: lint + strategy verification + HLO audit + live
+# telemetry (tests/test_analysis.py + test_telemetry.py run the same
+# chains, so tier-1 exercises it)
+check: lint verify audit telemetry-check
 
 clean:
 	$(MAKE) -C native clean

@@ -10,6 +10,9 @@ pluggable passes producing a severity-ranked :class:`Report`:
   ppermute validity, wire-dtype overflow)
 - ``donation``     — donation-safety (use-after-donation, wasted donation)
 - ``hbm-traced``   — liveness-based activation peak vs the budget
+- ``hlo-audit``    — LOWERED tier: the realized collective schedule of
+  the step's StableHLO lowering diffed against the strategy's intended
+  plan (implicit reshards, missing syncs, per-hop byte drift — X-codes)
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on
@@ -18,7 +21,7 @@ See ``docs/analysis.md``.
 """
 from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
                                           StrategyVerificationError)
-from autodist_tpu.analysis.passes import (PASS_REGISTRY, STATIC_PASSES,  # noqa: F401
-                                          TRACE_PASSES)
+from autodist_tpu.analysis.passes import (LOWERED_PASSES, PASS_REGISTRY,  # noqa: F401
+                                          STATIC_PASSES, TRACE_PASSES)
 from autodist_tpu.analysis.verify import (AnalysisContext, verify_strategy,  # noqa: F401
                                           verify_transformer)

@@ -15,6 +15,9 @@ once, used by ``tools/verify_strategy.py --selftest`` and the test suite:
 """
 
 EXPECTED_ERROR_CODES = ("C001", "S011", "H001")
+# the implicit-reshard case (build_reshard_case) must be caught by the
+# LOWERED tier — the HLO communication audit — as exactly this code
+EXPECTED_AUDIT_ERROR_CODE = "X001"
 
 
 def build_rejected_case(num_chips=8):
@@ -53,4 +56,52 @@ def build_rejected_case(num_chips=8):
         batch_shapes={"x": ((num_chips * 2, 64), "float32")},
         param_specs={"b": P("model")},       # (b) no "model" axis exists
         hbm_bytes_per_device=64 * 1024,      # (c) 64 KiB "budget"
+    )
+
+
+def build_reshard_case(num_chips=8):
+    """The seeded IMPLICIT-RESHARD case for the HLO communication audit
+    (``tools/verify_strategy.py --hlo --selftest``).
+
+    The loss re-shards its activations mid-step — the megatron-style
+    batch-sharded -> feature-sharded transition a deliberately mismatched
+    ``PartitionSpec`` pair forces — realized as an ``all_to_all`` over
+    the replica axis (one forward, and its transpose again in the
+    backward).  The strategy planned a bucketed all-reduce and nothing
+    else, so the cost model never priced this wire traffic; every
+    jaxpr-tier pass is clean (no deadlock, no bad spec, fits HBM), and
+    ONLY the lowered-tier audit catches it: the unplanned all_to_all is
+    an ``X001`` ERROR (:data:`EXPECTED_AUDIT_ERROR_CODE`).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    d = 256
+    params = {"w": jnp.zeros((d, d))}
+
+    def loss_fn(p, batch):
+        h = batch["x"] @ p["w"]                       # (B_local, d) shards
+        # the bug: the user "re-shards" activations from batch-sharded to
+        # feature-sharded (mismatched PartitionSpecs across the boundary)
+        # — inside the SPMD step that IS an all_to_all over the replica
+        # axis, which no part of the strategy's sync plan accounts for
+        h = jax.lax.all_to_all(h, "replica", split_axis=1, concat_axis=0,
+                               tiled=True)            # (B, d/R) reshard
+        return jnp.mean(h * h) + sum(
+            jnp.sum(jnp.square(x)) for x in jax.tree.leaves(p))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 16, d), "float32")},
+        hbm_bytes_per_device=16 * 1024 ** 3,
     )

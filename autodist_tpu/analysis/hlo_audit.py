@@ -1,0 +1,583 @@
+"""HLO communication audit: realized collectives vs the strategy's plan.
+
+The jaxpr-tier passes (:mod:`autodist_tpu.analysis.passes`) see the
+collectives *we* emit, but are blind to what the program looks like after
+lowering — the tier where XLA's SPMD machinery, codec recipes, and scan
+outlining fix the *realized* collective schedule.  An implicit resharding
+``all_to_all`` (the classic silent TPU perf bug: a mismatched
+PartitionSpec forces GSPMD-style redistribution the cost model never
+priced) survives every jaxpr pass and only becomes visible here.  This
+module closes that gap, in the TACCL spirit of checking a realized
+collective algorithm against the communication sketch the strategy
+intended:
+
+1. :func:`extract_collectives` parses every collective op out of a
+   lowered StableHLO module (the shared lowering path —
+   ``GraphTransformer.trace_step(...).lower()`` — the same machinery
+   ``aot.py`` and ``utils/visualization_util.py`` use), including ops
+   nested in ``while`` bodies (the accum scan outlines its body into a
+   separate function called from the loop region, so a call graph with
+   loop multiplicities is recovered, not just lexical nesting);
+2. the intended plan is assembled from the strategy's realization
+   (:meth:`GraphTransformer.intended_collectives`: bucket plan, two-level
+   ICI/DCN hops, PS fetch/push, sharded-storage materialization) and
+   diffed against the realized schedule;
+3. mismatches are reported as the **X-code** family (ranked alongside
+   C/S/D/H/Y findings in one :class:`Report`):
+
+  X000 INFO    audit skipped (no lowered module available)
+  X001 ERROR   unintended (resharding) collective not in the plan, with
+               byte estimate and the culprit operand type / groups
+  X002 ERROR   expected sync collective missing from the lowered module
+  X003 WARNING realized bytes exceed the plan's prediction beyond
+               BYTES_TOL
+  X004 WARNING replica_groups inconsistent with the declared
+               ``replica_dcn x replica_ici`` factorization
+  X005 WARNING per-microbatch collective inside the scan where the plan
+               says once-per-step
+  X006 INFO    realized-vs-intended bytes summary (machine-readable
+               ``Finding.data`` payload consumed by
+               ``tools/telemetry_report.py --audit``)
+
+Wire-byte accounting convention (kept identical between the intended and
+realized sides so the diff is meaningful): ``all_reduce`` /
+``reduce_scatter`` / ``all_to_all`` / ``collective_permute`` bill their
+operand bytes; ``all_gather`` bills its result bytes.  Collectives at or
+under :data:`SMALL_BYTES` are control-plane traffic (loss/metric pmeans,
+batch-mask psums, grad-norm scalars) and are summarized, never flagged.
+Collectives whose replica groups span only non-data (model) mesh axes are
+the user's own tensor/expert parallelism and are summarized as
+``user_bytes`` rather than audited — the strategy never planned them and
+the cost model prices them via the traced FLOPs, not the sync plan.
+"""
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from autodist_tpu.analysis.report import Finding, Severity
+
+# realized bytes may exceed the plan by padding (shard/block alignment)
+# and codec sidecars; beyond this relative tolerance X003 fires, and the
+# acceptance contract for the two-level per-hop comparison uses the same
+# number (docs/analysis.md "HLO audit").
+BYTES_TOL = 0.25
+# collectives at or under this many wire bytes are control-plane traffic
+# (scalar loss/metric pmeans), never audited individually
+SMALL_BYTES = 4096
+
+COLLECTIVE_KINDS = ("all_reduce", "all_gather", "all_to_all",
+                    "reduce_scatter", "collective_permute",
+                    "collective_broadcast")
+
+_OP_RE = re.compile(
+    r'"?stablehlo\.(' + "|".join(COLLECTIVE_KINDS) + r')"?[\s(]')
+_FUNC_RE = re.compile(r"func\.func\s+(?:public\s+|private\s+)?@([\w.$-]+)")
+_CALL_RE = re.compile(r"(?:func\.)?call\s+@([\w.$-]+)")
+_GROUPS_RE = re.compile(
+    r"replica_groups\s*=\s*dense<(.*?)>\s*:\s*tensor<(\d+)x(\d+)xi64>",
+    re.DOTALL)
+_PAIRS_RE = re.compile(
+    r"source_target_pairs\s*=\s*dense<.*?>\s*:\s*tensor<(\d+)x2xi64>",
+    re.DOTALL)
+_TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+_TRIP_RE = re.compile(r"dense<(\d+)>\s*:\s*tensor<i32>")
+
+
+def _dtype_bits(name):
+    if name.startswith("f8") or name in ("i8", "ui8", "i1"):
+        return 8
+    if name in ("i4", "ui4"):
+        return 4
+    m = re.search(r"(\d+)$", name)
+    return int(m.group(1)) if m else 32
+
+
+def _tensor_bytes(ty: str) -> Tuple[float, str]:
+    """``"2x64xf32"`` -> (bytes, dtype); scalars (``"f32"``) -> itemsize."""
+    parts = ty.split("x")
+    dims, dt = [], parts[-1]
+    for p in parts[:-1]:
+        if not p.isdigit():     # dynamic ("?") or exotic type: bail to 0-d
+            return 0.0, ty
+        dims.append(int(p))
+    n = 1
+    for d in dims:
+        n *= d
+    return n * _dtype_bits(dt) / 8.0, dt
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    """One realized collective from the lowered module."""
+
+    kind: str
+    operand_bytes: float = 0.0
+    result_bytes: float = 0.0
+    dtype: str = ""
+    group_count: int = 1
+    group_size: int = 0       # devices per replica group (0 = unknown)
+    pairs: int = 0            # collective_permute source->target pairs
+    function: str = ""
+    in_loop: bool = False     # executes inside a while (scan) body
+    count: float = 1.0        # static multiplicity (call sites x trips)
+
+    @property
+    def wire_bytes(self):
+        """Per-execution wire accounting (module docstring convention)."""
+        if self.kind == "all_gather":
+            return self.result_bytes
+        return self.operand_bytes
+
+    @property
+    def total_bytes(self):
+        """Per-step accounting: wire bytes x static multiplicity."""
+        return self.wire_bytes * max(1.0, self.count)
+
+    def describe(self):
+        where = f" in @{self.function}" if self.function else ""
+        loop = " [in-loop]" if self.in_loop else ""
+        grp = (f" groups={self.group_count}x{self.group_size}"
+               if self.group_size else "")
+        return (f"{self.kind}({_fmt_bytes(self.wire_bytes)} {self.dtype})"
+                f"{grp}{where}{loop}")
+
+
+def _fmt_bytes(b):
+    for unit, div in (("GiB", 1024 ** 3), ("MiB", 1024 ** 2),
+                      ("KiB", 1024)):
+        if b >= div:
+            return f"{b / div:.2f} {unit}"
+    return f"{b:.0f} B"
+
+
+def _parse_op(kind, buf, trailer_line) -> Optional[CollectiveOp]:
+    """Build a :class:`CollectiveOp` from the op's full text ``buf`` and
+    the line carrying its trailing function type."""
+    op = CollectiveOp(kind=kind)
+    m = _GROUPS_RE.search(buf)
+    if m:
+        op.group_count, op.group_size = int(m.group(2)), int(m.group(3))
+    m = _PAIRS_RE.search(buf)
+    if m:
+        op.pairs = int(m.group(1))
+    idx = trailer_line.rfind(" : (")
+    if idx < 0:
+        return None
+    seg = trailer_line[idx + len(" : ("):]
+    arrow = seg.find(") -> ")
+    if arrow < 0:
+        return None
+    in_types = _TENSOR_RE.findall(seg[:arrow])
+    out_types = _TENSOR_RE.findall(seg[arrow:])
+    for t in in_types:
+        b, dt = _tensor_bytes(t)
+        op.operand_bytes += b
+        op.dtype = op.dtype or dt
+    for t in out_types:
+        b, _ = _tensor_bytes(t)
+        op.result_bytes += b
+    return op
+
+
+def extract_collectives(text: str) -> List[CollectiveOp]:
+    """Parse every collective out of a lowered StableHLO module.
+
+    Handles the generic-form ops JAX emits (attributes in ``<{...}>``,
+    reduction regions for ``all_reduce``/``reduce_scatter``), recovers
+    ``replica_groups`` / ``source_target_pairs``, per-op operand/result
+    bytes from the trailing function type, and loop placement: scan
+    bodies are OUTLINED into private functions called from
+    ``stablehlo.while`` regions, so a call graph is built and each op's
+    static multiplicity is the product of its call-site counts and the
+    enclosing loops' trip counts (trip counts read best-effort from the
+    canonical ``compare LT iterArg, <const>`` loop condition; unknown
+    trips count as 1 but still set ``in_loop``).
+    """
+    funcs: Dict[str, dict] = {}
+    order: List[str] = []
+    cur = None          # current function record
+    depth = 0
+    # stack of active while loops in the current function:
+    # {"base": depth-before-regions, "trip": int|None, "in_cond": bool}
+    whiles: List[dict] = []
+    pending: Optional[dict] = None   # an op whose region is still open
+
+    def loop_mult():
+        m = 1.0
+        for w in whiles:
+            m *= max(1, w["trip"] or 1)
+        return m
+
+    for line in text.splitlines():
+        opens, closes = line.count("{"), line.count("}")
+
+        fm = _FUNC_RE.search(line)
+        if fm and "func.func" in line:
+            cur = {"name": fm.group(1), "ops": [], "calls": []}
+            funcs[cur["name"]] = cur
+            order.append(cur["name"])
+            whiles = []
+            pending = None
+
+        if pending is not None:
+            pending["buf"].append(line)
+            pending["depth"] += opens - closes
+            if pending["depth"] <= 0 and " -> " in line:
+                op = _parse_op(pending["kind"], "\n".join(pending["buf"]),
+                               line)
+                if op is not None:
+                    pending["attach"](op)
+                pending = None
+            depth += opens - closes
+            continue
+
+        if "stablehlo.while" in line:
+            whiles.append({"base": depth, "trip": None, "in_cond": False,
+                           "opened": False})
+        elif whiles:
+            if re.search(r"\bcond\s*\{", line):
+                whiles[-1]["in_cond"] = True
+            elif re.search(r"\}?\s*do\s*\{", line):
+                whiles[-1]["in_cond"] = False
+            elif whiles[-1]["in_cond"]:
+                tm = _TRIP_RE.search(line)
+                if tm:
+                    t = int(tm.group(1))
+                    whiles[-1]["trip"] = max(whiles[-1]["trip"] or 0, t)
+
+        om = _OP_RE.search(line)
+        if om and cur is not None:
+            in_loop = bool(whiles)
+            mult = loop_mult()
+            fn = cur
+
+            def attach(op, fn=fn, in_loop=in_loop, mult=mult):
+                op.function = fn["name"]
+                op.in_loop = in_loop
+                op.count = mult
+                fn["ops"].append(op)
+
+            net = opens - closes
+            if net <= 0 and " -> " in line:
+                op = _parse_op(om.group(1), line, line)
+                if op is not None:
+                    attach(op)
+            else:
+                pending = {"kind": om.group(1), "buf": [line],
+                           "depth": net, "attach": attach}
+        elif cur is not None:
+            cm = _CALL_RE.search(line)
+            if cm:
+                cur["calls"].append((cm.group(1), loop_mult(), bool(whiles)))
+
+        depth += opens - closes
+        for w in whiles:
+            if depth > w["base"]:
+                w["opened"] = True
+        while whiles and whiles[-1]["opened"] and \
+                depth <= whiles[-1]["base"]:
+            whiles.pop()
+
+    if not funcs:
+        return []
+    entry = next((n for n in order if n == "main"), order[0])
+    mult = {n: 0.0 for n in funcs}
+    looped = {n: False for n in funcs}
+    mult[entry] = 1.0
+    for _ in range(len(funcs) + 2):     # call graph is a DAG; relax
+        changed = False
+        new_mult = {n: (1.0 if n == entry else 0.0) for n in funcs}
+        for name, f in funcs.items():
+            for callee, lm, in_while in f["calls"]:
+                if callee not in funcs:
+                    continue
+                new_mult[callee] += mult[name] * lm
+                flag = looped[name] or in_while
+                if flag and not looped[callee]:
+                    looped[callee] = True
+                    changed = True
+        if new_mult != mult:
+            mult = new_mult
+            changed = True
+        if not changed:
+            break
+    ops = []
+    for name, f in funcs.items():
+        m = mult.get(name, 0.0)
+        if m <= 0 and name != entry:
+            m = 1.0     # unreachable by our call parse: keep, count once
+        for op in f["ops"]:
+            op.count = op.count * max(1.0, m)
+            op.in_loop = op.in_loop or looped[name]
+            ops.append(op)
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# intended plan + matching
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Channel:
+    """One intended communication channel (from
+    :meth:`GraphTransformer.intended_collectives`), accumulating the
+    realized bytes the matcher assigns to it."""
+
+    label: str
+    kinds: tuple
+    bytes: float
+    phase: str = "flat"
+    group_sizes: tuple = ()     # () = any group layout acceptable
+    in_scan: bool = False       # the plan ISSUES this inside the scan
+    required: bool = True
+    realized: float = 0.0
+    matched_ops: int = 0
+    group_mismatch: Optional[CollectiveOp] = None
+
+    @property
+    def capacity(self):
+        return self.bytes * (1.0 + BYTES_TOL) + SMALL_BYTES
+
+    def admits(self, op: CollectiveOp) -> bool:
+        if op.kind not in self.kinds:
+            return False
+        return self.realized + op.total_bytes <= self.capacity
+
+    def take(self, op: CollectiveOp):
+        self.realized += op.total_bytes
+        self.matched_ops += 1
+        if self.group_sizes and op.group_size and \
+                op.group_size not in self.group_sizes:
+            self.group_mismatch = self.group_mismatch or op
+
+
+def channels_from_plan(plan_entries) -> List[Channel]:
+    """``GraphTransformer.intended_collectives()`` dicts -> matcher
+    channels.  Channels near the control-plane threshold are kept for the
+    summary but never REQUIRED: their realized ops may individually fall
+    at or under :data:`SMALL_BYTES` and land in control-plane traffic, so
+    demanding a match would misfire X002 (2x margin covers channels whose
+    volume splits across a couple of sub-threshold collectives)."""
+    chans = []
+    for e in plan_entries:
+        c = Channel(label=e["label"], kinds=tuple(e["kinds"]),
+                    bytes=float(e["bytes"]), phase=e.get("phase", "flat"),
+                    group_sizes=tuple(e.get("group_sizes", ())),
+                    in_scan=bool(e.get("in_scan", False)),
+                    required=bool(e.get("required", True)))
+        if c.bytes <= 2 * SMALL_BYTES:
+            c.required = False
+        chans.append(c)
+    return chans
+
+
+def _f(sev, code, msg, subject=""):
+    return Finding(Severity(sev), code, "hlo-audit", msg, subject)
+
+
+def audit_collectives(ops: List[CollectiveOp], channels: List[Channel], *,
+                      data_group_sizes=(), model_group_sizes=(),
+                      small_bytes=SMALL_BYTES, source="lowered module",
+                      predicted: Optional[dict] = None) -> List[Finding]:
+    """Diff the realized collective schedule against the intended plan.
+
+    ``data_group_sizes``: replica-group sizes a data-parallel sync
+    collective may legitimately use (R, R_ici, R_dcn, PS-subset products);
+    ``model_group_sizes``: sizes reachable using only non-data (model)
+    mesh axes — collectives matching ONLY those are the user's own tensor/
+    expert parallelism and are summarized, not flagged.
+    ``predicted`` (cost-model per-hop byte predictions, e.g.
+    ``{"ici_hop": ..., "dcn_hop": ...}``) rides into the X006 payload.
+    """
+    findings = []
+    control_bytes = user_bytes = 0.0
+    unmatched: List[CollectiveOp] = []
+    n_ops = len(ops)
+
+    for op in sorted(ops, key=lambda o: -o.total_bytes):
+        if op.wire_bytes <= small_bytes:
+            control_bytes += op.total_bytes
+            continue
+        cands = [c for c in channels if c.admits(op)]
+        if cands:
+            # best-fit assignment: prefer channels whose declared groups
+            # match the op's layout, that still NEED bytes, and whose
+            # remaining need is closest to the op's volume — a large
+            # channel's tolerance slack must not swallow a smaller
+            # channel's only collective (which would misreport X002)
+            def score(c):
+                grp_ok = (not c.group_sizes or not op.group_size
+                          or op.group_size in c.group_sizes)
+                need = c.bytes - c.realized
+                return (grp_ok, need > 0, -abs(need - op.total_bytes))
+
+            best = max(cands, key=score)
+            best.take(op)
+            if op.in_loop and not best.in_scan:
+                findings.append(_f(
+                    Severity.WARNING, "X005",
+                    f"{op.describe()} executes per scan iteration "
+                    f"(x{op.count:.0f}) but the plan issues "
+                    f"'{best.label}' once per step: the wire pays the "
+                    f"sync {op.count:.0f} times over",
+                    best.label))
+            continue
+        if (model_group_sizes and op.group_size
+                and op.group_size in model_group_sizes
+                and op.group_size not in data_group_sizes):
+            user_bytes += op.total_bytes   # user model-parallel collective
+            continue
+        unmatched.append(op)
+
+    for op in unmatched:
+        findings.append(_f(
+            Severity.ERROR, "X001",
+            f"unintended collective in the {source}: {op.describe()} "
+            f"matches no planned sync channel — an implicit reshard "
+            f"(mismatched shardings force redistribution the cost model "
+            f"never priced); ~{_fmt_bytes(op.total_bytes)}/step of "
+            f"unplanned wire traffic", op.kind))
+
+    for c in channels:
+        if c.required and c.matched_ops == 0:
+            findings.append(_f(
+                Severity.ERROR, "X002",
+                f"expected sync collective missing from the {source}: "
+                f"'{c.label}' ({'/'.join(c.kinds)}, "
+                f"~{_fmt_bytes(c.bytes)}) never appears — the lowered "
+                f"program does not synchronize what the strategy "
+                f"promised", c.label))
+        elif c.matched_ops and c.realized > c.bytes * (1.0 + BYTES_TOL):
+            findings.append(_f(
+                Severity.WARNING, "X003",
+                f"'{c.label}' realizes {_fmt_bytes(c.realized)} on the "
+                f"wire vs {_fmt_bytes(c.bytes)} intended "
+                f"(+{(c.realized / max(c.bytes, 1.0) - 1) * 100:.0f}%, "
+                f"tolerance {BYTES_TOL:.0%})", c.label))
+        if c.group_mismatch is not None:
+            op = c.group_mismatch
+            findings.append(_f(
+                Severity.WARNING, "X004",
+                f"'{c.label}' expects replica groups of "
+                f"{'/'.join(str(g) for g in c.group_sizes)} device(s) "
+                f"but the realized {op.kind} uses "
+                f"{op.group_count}x{op.group_size}: the collective does "
+                f"not follow the declared replica_dcn x replica_ici "
+                f"factorization", c.label))
+
+    intended = {}
+    realized = {}
+    for c in channels:
+        intended[c.phase] = intended.get(c.phase, 0.0) + c.bytes
+        realized[c.phase] = realized.get(c.phase, 0.0) + c.realized
+    unmatched_bytes = sum(op.total_bytes for op in unmatched)
+    data = {
+        "intended": {k: round(v, 1) for k, v in intended.items()},
+        "realized": {k: round(v, 1) for k, v in realized.items()},
+        "control_bytes": round(control_bytes, 1),
+        "user_bytes": round(user_bytes, 1),
+        "unmatched_bytes": round(unmatched_bytes, 1),
+        "n_collectives": n_ops,
+        "n_unmatched": len(unmatched),
+        "channels": [{"label": c.label, "phase": c.phase,
+                      "kinds": list(c.kinds),
+                      "intended_bytes": round(c.bytes, 1),
+                      "realized_bytes": round(c.realized, 1),
+                      "ops": c.matched_ops} for c in channels],
+        "source": source,
+    }
+    if predicted:
+        data["predicted"] = {k: round(float(v), 1)
+                             for k, v in predicted.items()}
+    rows = [f"{k}: {_fmt_bytes(realized.get(k, 0.0))} realized / "
+            f"{_fmt_bytes(intended[k])} intended"
+            for k in sorted(intended)]
+    findings.append(Finding(
+        Severity.INFO, "X006", "hlo-audit",
+        f"realized-vs-intended wire bytes ({n_ops} collective(s), "
+        f"{source}): " + "; ".join(rows)
+        + f"; control {_fmt_bytes(control_bytes)}"
+        + (f"; user model-parallel {_fmt_bytes(user_bytes)}"
+           if user_bytes else ""),
+        "summary", data=data))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the registered pass
+# ---------------------------------------------------------------------------
+
+
+def _axis_group_sizes(transformer):
+    """(data sizes, model-only sizes) a realized replica group may span."""
+    import itertools
+
+    mesh = dict(transformer.mesh.shape)
+    data = set(transformer.data_axes)
+    model_axes = [a for a in mesh if a not in data]
+
+    def products(axes):
+        out = set()
+        for r in range(1, len(axes) + 1):
+            for combo in itertools.combinations(axes, r):
+                p = 1
+                for a in combo:
+                    p *= int(mesh[a])
+                out.add(p)
+        return out
+
+    data_sizes = products(list(data)) | {transformer.num_replicas}
+    for plan in transformer.plans.values():
+        data_sizes.add(transformer._R_for(plan))
+    return tuple(sorted(data_sizes)), tuple(sorted(products(model_axes)))
+
+
+def lowered_text_for(ctx):
+    """The audited module's text, in preference order: an explicitly
+    attached lowering (``ctx.lowered_text`` — the AOT path hands the real
+    TPU lowering over), a program-evolution dump for this strategy id
+    (``utils/visualization_util`` namespaces dumps per strategy + run;
+    reusing the newest one skips a re-lower), else a fresh lowering of
+    the traced step."""
+    if getattr(ctx, "lowered_text", None):
+        return ctx.lowered_text, (getattr(ctx, "lowered_source", "")
+                                  or "attached lowering")
+    sid = getattr(ctx.strategy, "id", "") or ""
+    if sid:
+        from autodist_tpu.utils.visualization_util import latest_dump
+
+        path = latest_dump(sid)
+        if path:
+            with open(path) as f:
+                return f.read(), f"dump {path}"
+    traced = getattr(ctx, "traced", None)
+    if traced is not None:
+        return traced.lower().as_text(), "lowered module"
+    return None, None
+
+
+def hlo_audit_pass(ctx):
+    """PASS_REGISTRY entry (the lowered tier): extract the realized
+    collective schedule and diff it against the strategy's intent."""
+    text, source = lowered_text_for(ctx)
+    if text is None:
+        return [_f(Severity.INFO, "X000",
+                   "audit skipped: no lowered module (trace the step or "
+                   "enable AUTODIST_DUMP_HLO dumps) — the realized "
+                   "collective schedule was not checked")]
+    transformer = getattr(ctx, "transformer", None)
+    if transformer is None:
+        return [_f(Severity.INFO, "X000",
+                   "audit skipped: no GraphTransformer attached — the "
+                   "intended plan cannot be assembled")]
+    ops = extract_collectives(text)
+    channels = channels_from_plan(transformer.intended_collectives())
+    data_sizes, model_sizes = _axis_group_sizes(transformer)
+    predicted = getattr(ctx, "predicted_comm_bytes", None)
+    findings = audit_collectives(
+        ops, channels, data_group_sizes=data_sizes,
+        model_group_sizes=model_sizes, source=source, predicted=predicted)
+    ctx.audit_summary = next(
+        (f.data for f in findings if f.code == "X006"), None)
+    return findings

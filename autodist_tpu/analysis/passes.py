@@ -50,8 +50,23 @@ Finding codes (stable; tests and tools match on them):
   Y004 WARNING PowerSGD main codec under TWO_LEVEL (engine realizes FLAT)
   Y005 WARNING dcn_compressor set on a non-TWO_LEVEL node (ignored)
   Y006 INFO    hierarchy summary (factorization + DCN-hop codec)
+  X000 INFO    HLO audit skipped (no lowered module / no transformer)
+  X001 ERROR   unintended (resharding) collective in the lowered module,
+               absent from the strategy's plan
+  X002 ERROR   expected sync collective missing from the lowered module
+  X003 WARNING realized wire bytes exceed the plan beyond tolerance
+  X004 WARNING replica_groups inconsistent with the declared
+               replica_dcn x replica_ici factorization
+  X005 WARNING per-microbatch collective inside the scan where the plan
+               says once-per-step
+  X006 INFO    realized-vs-intended wire-byte summary (carries the
+               machine-readable table in Finding.data)
   T001 ERROR   tracing the strategy's train step failed
   T002 INFO    trace skipped (trace passes did not run)
+
+The X-codes form the LOWERED tier (:mod:`autodist_tpu.analysis.hlo_audit`):
+they run over the StableHLO text of the transformed step's lowering — the
+realized collective schedule — rather than the jaxpr.
 """
 import numpy as np
 
@@ -632,6 +647,15 @@ def hbm_traced_pass(ctx):
     return findings
 
 
+def hlo_audit_pass(ctx):
+    """Lowered-tier pass: diff the realized collective schedule of the
+    step's StableHLO lowering against the strategy's intended plan
+    (:mod:`autodist_tpu.analysis.hlo_audit`)."""
+    from autodist_tpu.analysis.hlo_audit import hlo_audit_pass as _run
+
+    return _run(ctx)
+
+
 PASS_REGISTRY = {
     "sharding": sharding_pass,
     "hierarchy": hierarchy_pass,
@@ -639,7 +663,12 @@ PASS_REGISTRY = {
     "collectives": collectives_pass,
     "donation": donation_pass,
     "hbm-traced": hbm_traced_pass,
+    "hlo-audit": hlo_audit_pass,
 }
 
 STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
 TRACE_PASSES = ("collectives", "donation", "hbm-traced")
+# passes over the LOWERED StableHLO module (the realized collective
+# schedule); opt-in via verify_strategy(passes=...), the CLI's --hlo, the
+# AOT verify gate, and AutoStrategy's top-candidate audit
+LOWERED_PASSES = ("hlo-audit",)

@@ -35,6 +35,10 @@ class Finding:
     pass_name: str       # which pass produced it, e.g. "collectives"
     message: str
     subject: str = ""    # var name / axis / eqn description, when applicable
+    # optional machine-readable payload (e.g. the HLO audit's X006
+    # realized-vs-intended byte table); rides into to_json() so tools can
+    # consume it without parsing prose
+    data: Optional[dict] = None
 
     def __str__(self):
         where = f" [{self.subject}]" if self.subject else ""
@@ -42,9 +46,12 @@ class Finding:
                f"{self.message}"
 
     def to_json(self):
-        return {"severity": str(self.severity), "code": self.code,
-                "pass": self.pass_name, "subject": self.subject,
-                "message": self.message}
+        out = {"severity": str(self.severity), "code": self.code,
+               "pass": self.pass_name, "subject": self.subject,
+               "message": self.message}
+        if self.data is not None:
+            out["data"] = self.data
+        return out
 
 
 class Report:

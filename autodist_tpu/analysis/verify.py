@@ -19,8 +19,8 @@ does not mask every other finding behind a trace error.
 import dataclasses
 from typing import Any, Dict, Optional
 
-from autodist_tpu.analysis.passes import (PASS_REGISTRY, STATIC_PASSES,
-                                          TRACE_PASSES)
+from autodist_tpu.analysis.passes import (LOWERED_PASSES, PASS_REGISTRY,
+                                          STATIC_PASSES, TRACE_PASSES)
 from autodist_tpu.analysis.report import Report, Severity
 from autodist_tpu.utils import logging
 
@@ -48,6 +48,15 @@ class AnalysisContext:
     donated_invars: Any = None
     static_footprint: Optional[dict] = None
     traced_peak_bytes: Optional[int] = None
+    # lowered tier (the HLO audit): the GraphTransformer the trace came
+    # from (supplies the intended plan), an optionally pre-attached
+    # lowering (the AOT path hands the real TPU StableHLO over), and the
+    # audit's machine-readable realized-vs-intended summary
+    transformer: Any = None
+    lowered_text: Optional[str] = None
+    lowered_source: str = ""
+    predicted_comm_bytes: Optional[dict] = None
+    audit_summary: Optional[dict] = None
 
 
 def _mesh_info(strategy, resource_spec, mesh):
@@ -107,9 +116,11 @@ def _build_transformer(ctx, mesh, report):
         mesh = Mesh(np.array(devices[:ctx.num_replicas]).reshape(shape),
                     ctx.axis_names)
     try:
-        return GraphTransformer(ctx.strategy, ctx.model_item, mesh,
-                                param_specs=ctx.safe_param_specs or None,
-                                **ctx.transformer_kwargs)
+        ctx.transformer = GraphTransformer(
+            ctx.strategy, ctx.model_item, mesh,
+            param_specs=ctx.safe_param_specs or None,
+            **ctx.transformer_kwargs)
+        return ctx.transformer
     except Exception as e:
         report.add(Severity.ERROR, "T001", "trace",
                    f"building the graph transformer failed: "
@@ -158,6 +169,7 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
         axis_sizes=dict(transformer.mesh.shape),
         batch_shapes=batch_shapes, donate=donate,
         hbm_bytes_per_device=hbm_bytes_per_device)
+    ctx.transformer = transformer
     report = Report(strategy_id=getattr(transformer.strategy, "id", ""))
     selected = tuple(passes) if passes is not None else \
         STATIC_PASSES + TRACE_PASSES
@@ -165,9 +177,12 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
         if name in STATIC_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
     trace_selected = [p for p in selected if p in TRACE_PASSES]
-    if trace_selected:
+    lowered_selected = [p for p in selected if p in LOWERED_PASSES]
+    if trace_selected or lowered_selected:
         _run_trace(ctx, report, transformer, rng)
         for name in trace_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+        for name in lowered_selected:
             report.extend(PASS_REGISTRY[name](ctx))
     return report
 
@@ -230,7 +245,8 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         ctx.safe_param_specs = param_specs
 
     trace_selected = [p for p in selected if p in TRACE_PASSES]
-    if trace_selected:
+    lowered_selected = [p for p in selected if p in LOWERED_PASSES]
+    if trace_selected or lowered_selected:
         if batch_shapes is None or model_item is None:
             report.add(Severity.INFO, "T002", "trace",
                        "trace skipped: no batch_shapes/model given — trace "
@@ -240,6 +256,11 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
             if t is not None:
                 _run_trace(ctx, report, t, rng)
         for name in trace_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+        # lowered tier last: the HLO audit lowers ctx.traced (or reuses a
+        # namespaced program-evolution dump) and diffs the realized
+        # collective schedule against the transformer's intended plan
+        for name in lowered_selected:
             report.extend(PASS_REGISTRY[name](ctx))
 
     logging.debug("verify_strategy(%s): %d findings (%d errors)",

@@ -265,11 +265,15 @@ def aot_compile_step(
     with force_on_tpu_selection():
         traced = t.trace_step(batch_shapes, donate=donate, rng=rng,
                               state_avals=state_avals)
+    lowered = traced.lower(lowering_platforms=("tpu",))
     if verify:
         # static verification of the traced program against the TARGET
-        # generation's HBM budget; an infeasible strategy raises here,
-        # before the minutes-long TPU compile
-        from autodist_tpu.analysis.passes import (PASS_REGISTRY,
+        # generation's HBM budget, PLUS the HLO communication audit over
+        # the real TPU lowering (the realized collective schedule vs the
+        # strategy's plan — an implicit reshard is an X001 ERROR); an
+        # infeasible strategy raises here, before the minutes-long compile
+        from autodist_tpu.analysis.passes import (LOWERED_PASSES,
+                                                  PASS_REGISTRY,
                                                   STATIC_PASSES,
                                                   TRACE_PASSES)
         from autodist_tpu.analysis.report import Report
@@ -283,12 +287,14 @@ def aot_compile_step(
             donate=donate, hbm_bytes_per_device=hbm)
         attach_traced(ctx, traced,
                       n_state_leaves=len(jax.tree.leaves(state_avals)))
+        ctx.transformer = t
+        ctx.lowered_text = lowered.as_text()
+        ctx.lowered_source = f"TPU lowering for {topology}"
         report = Report(strategy_id=strategy.id)
-        for pass_name in STATIC_PASSES + TRACE_PASSES:
+        for pass_name in STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES:
             report.extend(PASS_REGISTRY[pass_name](ctx))
         logging.info("AOT strategy verification:\n%s", report)
         report.raise_for_errors()
-    lowered = traced.lower(lowering_platforms=("tpu",))
     # overlap schedule: the deviceless compile gets the same latency-
     # hiding-scheduler + combine-threshold flags the on-chip runner uses
     # (the compile TARGETS tpu even though the process backend is cpu, so

@@ -273,6 +273,162 @@ class GraphTransformer:
                     nbytes * wire_byte_factor(b.compressor, b.total)
         return out
 
+    def intended_collectives(self):
+        """The strategy's communication sketch: every collective this
+        transformer's step is EXPECTED to emit, as channel descriptors the
+        HLO audit (:mod:`autodist_tpu.analysis.hlo_audit`) diffs the
+        lowered module's realized schedule against.
+
+        Each entry: ``{label, kinds, bytes, phase, group_sizes, in_scan,
+        required}`` — ``bytes`` is per-STEP wire volume under the audit's
+        accounting convention (all_reduce/reduce_scatter/all_to_all bill
+        operands, all_gather bills results), already multiplied by the
+        accum factor for channels the overlap schedule issues inside the
+        scan; ``group_sizes`` are the replica-group sizes the collective
+        may legitimately use (empty = any); ``required=False`` marks
+        channels that only materialize when the user's loss exercises
+        them (sparse lookups, mutable-state averaging).
+        """
+        from autodist_tpu.kernel.synchronization.compressor import (
+            Int8Compressor, PowerSGDCompressor, wire_byte_factor)
+
+        _AR = ar_sync._AR
+        out = []
+        R = self.num_replicas
+        A = self.accum_steps
+        R_ici = (self.mesh.shape[self.hier_spec.ici]
+                 if self.hier_spec is not None else 1)
+        R_dcn = (int(np.prod([self.mesh.shape[a]
+                              for a in self.hier_spec.dcn]))
+                 if self.hier_spec is not None else 1)
+
+        def add(label, kinds, nbytes, phase, groups=(), in_scan=False,
+                required=True):
+            out.append({"label": label, "kinds": tuple(kinds),
+                        "bytes": float(nbytes), "phase": phase,
+                        "group_sizes": tuple(groups), "in_scan": in_scan,
+                        "required": required})
+
+        def int8_bytes(elems, n_dev):
+            # per-device chunk padded to the quantization block; int8
+            # payload + f32 scale sidecar, exchanged in BOTH phases
+            # (all_to_all then all_gather) — see Int8Compressor
+            B = Int8Compressor.BLOCK
+            chunk = -(-(-(-elems // n_dev)) // B) * B
+            per_phase = n_dev * chunk * (1 + 4.0 / B)
+            return 2.0 * per_phase
+
+        for b in self.buckets:
+            item = np.dtype(b.dtype).itemsize
+            nbytes = b.total * item
+            in_scan = (self.sync_schedule == "overlap" and A > 1
+                       and ar_sync.elementwise(b))
+            mult = A if in_scan else 1
+            if b.hierarchy == _AR.TWO_LEVEL:
+                shard = -(-b.total // R_ici)
+                padded = shard * R_ici * item
+                add(f"{b.key}/ici-scatter", ("reduce_scatter",),
+                    padded * mult, "ici_hop", (R_ici,), in_scan)
+                d = ar_sync.dcn_codec(b)
+                if d in (_AR.Int8Compressor, _AR.Int8CompressorEF):
+                    add(f"{b.key}/dcn-int8", ("all_to_all", "all_gather"),
+                        int8_bytes(shard, R_dcn) * mult, "dcn_hop",
+                        (R_dcn,), in_scan)
+                else:
+                    add(f"{b.key}/dcn-reduce", ("all_reduce",),
+                        shard * item * wire_byte_factor(d, b.total) * mult,
+                        "dcn_hop", (R_dcn,), in_scan)
+                add(f"{b.key}/ici-gather", ("all_gather",),
+                    padded * mult, "ici_hop", (R_ici,), in_scan)
+            elif b.compressor in (_AR.Int8Compressor, _AR.Int8CompressorEF):
+                add(f"{b.key}/int8", ("all_to_all", "all_gather"),
+                    int8_bytes(b.total, R), "flat", (R,))
+            elif b.compressor == _AR.PowerSGDCompressor:
+                # two separate factor psums per subspace iteration:
+                # P (rows x r) and Q (cols x r), both f32
+                rows, cols = PowerSGDCompressor._dims(b.total)
+                r = PowerSGDCompressor._rank(b.total)
+                add(f"{b.key}/powersgd-P", ("all_reduce",),
+                    rows * r * 4.0, "flat", (R,))
+                add(f"{b.key}/powersgd-Q", ("all_reduce",),
+                    cols * r * 4.0, "flat", (R,))
+            else:
+                add(f"{b.key}", ("all_reduce",),
+                    nbytes * wire_byte_factor(b.compressor, b.total) * mult,
+                    "flat", (R,), in_scan)
+
+        def _shard_len(plan):
+            r = self._R_for(plan)
+            n = int(np.prod(plan.shape)) if plan.shape else 1
+            return (-(-n // r) * r) // r
+
+        for (dtype, _axes_key), names in self.ps_groups.items():
+            plan0 = self.plans[names[0]]
+            r_ps = self._R_for(plan0)
+            item = np.dtype(dtype).itemsize
+            S = sum(_shard_len(self.plans[n]) for n in names)
+            add(f"ps/{dtype}/scatter", ("reduce_scatter",),
+                r_ps * S * item, "ps", (r_ps,))
+            other = self._ps_other_axes(plan0)
+            if other:
+                r_other = int(np.prod([self.mesh.shape[a] for a in other]))
+                add(f"ps/{dtype}/cross-psum", ("all_reduce",),
+                    S * item, "ps", (r_other,))
+            add(f"ps/{dtype}/gather", ("all_gather",),
+                r_ps * S * item, "ps", (r_ps,))
+
+        for name in self.names:
+            plan = self.plans[name]
+            item = np.dtype(plan.dtype).itemsize
+            n = int(np.prod(plan.shape)) if plan.shape else 1
+            if plan.placement == Placement.SHARDED:
+                if plan.sparse and plan.partition_axis == 0:
+                    # ShardedTable: lookups row-exchange only when the
+                    # loss actually embeds (required=False)
+                    add(f"{name}/table-lookup",
+                        ("all_gather", "all_to_all", "all_reduce",
+                         "collective_permute"),
+                        n * item, "sparse", (), required=False)
+                    continue
+                dim = max(1, plan.shape[plan.partition_axis])
+                padded = n * item * (plan.padded_dim / dim)
+                add(f"{name}/materialize", ("all_gather",), padded,
+                    "materialize", (R,))
+                if not plan.sparse:
+                    add(f"{name}/grad-scatter", ("reduce_scatter",),
+                        padded, "materialize", (R,))
+            elif plan.placement == Placement.DIVERGENT:
+                # periodic averaging: the pmean sits inside a lax.cond
+                # branch but is always PRESENT in the lowered program
+                add(f"{name}/stale-avg", ("all_reduce",), n * item,
+                    "stale", (R,))
+            elif plan.sparse:
+                # replicated/PS sparse var: the lookup backward syncs it
+                # only when the loss embeds through it
+                add(f"{name}/sparse-sync",
+                    ("all_gather", "all_to_all", "all_reduce",
+                     "collective_permute"),
+                    n * item * 2, "sparse", (), required=False)
+
+        for (_spec, dtype), (names_c, _axes) in self.custom_groups.items():
+            item = np.dtype(dtype).itemsize if isinstance(dtype, str) else 4
+            total = sum(
+                int(np.prod(self.plans[n].shape)) if self.plans[n].shape
+                else 1 for n in names_c)
+            add(f"custom/{dtype}", ("all_reduce",), total * item,
+                "custom", (R,))
+
+        if self.model_item.mutable_state is not None:
+            leaves = jax.tree.leaves(self.model_item.mutable_state)
+            total = sum(
+                l.size * np.dtype(l.dtype).itemsize for l in leaves
+                if hasattr(l, "dtype")
+                and np.issubdtype(np.dtype(l.dtype), np.floating))
+            if total:
+                add("mutable-state/pmean", ("all_reduce",), total,
+                    "mutable", (R,), required=False)
+        return out
+
     def plan_summary(self):
         """Human-readable transform plan — dump stage 0 of the 4-stage
         program-evolution artifacts (reference logs its graph after each

@@ -406,6 +406,24 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         schedule="overlap" if ar_overlap else "barrier")
 
 
+def predicted_comm_bytes(est: "CostEstimate") -> dict:
+    """Per-phase wire-byte predictions of a :class:`CostEstimate`, keyed
+    the way the HLO communication audit phases its realized/intended
+    tables (``flat``/``ici_hop``/``dcn_hop``/``ps``/``materialize``) — so
+    ``tools/telemetry_report.py --audit`` and ``AutoStrategy.last_audit``
+    can put predicted, intended, realized, and measured side by side
+    without each consumer re-mapping the breakdown keys."""
+    b = est.breakdown
+    return {
+        "flat": float(b.get("ar_bytes", 0.0)),
+        "ici_hop": float(b.get("hier_ici_bytes", 0.0)),
+        "dcn_hop": float(b.get("hier_dcn_bytes", 0.0)),
+        "ps": float(b.get("ps_bytes", 0.0) + b.get("gather_bytes", 0.0)
+                    + b.get("subset_ps_bytes", 0.0)),
+        "sparse": float(b.get("sparse_bytes", 0.0)),
+    }
+
+
 class _FracBox:
     """Opaque leaf carrying (expected update-space shape, per-chip
     fraction) through ``optax.tree_map_params`` (see

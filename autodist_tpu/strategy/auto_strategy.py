@@ -48,7 +48,7 @@ def default_candidates(resource_spec=None):
 class AutoStrategy(StrategyBuilder):
     def __init__(self, candidates=None, flops_per_example=0.0,
                  batch_per_chip=32, calibration=None, verify=True,
-                 hbm_bytes_per_device=None):
+                 hbm_bytes_per_device=None, audit_batch_shapes=None):
         """``calibration``: a dict from :func:`simulator.cost_model.calibrate`
         or a path to a benchmark sweep summary JSON (``examples/benchmark.py
         --strategies ... --records_dir``) — grounds the analytic ranking in
@@ -60,12 +60,24 @@ class AutoStrategy(StrategyBuilder):
         ranked.  ``hbm_bytes_per_device`` supplies the per-chip budget for
         the feasibility check (e.g. ``aot.HBM_BY_DEVICE_KIND["TPU v5
         lite"]``); ``None`` skips the budget comparison but keeps the lint.
+
+        ``audit_batch_shapes`` (a ``(shape, dtype)`` batch pytree, the
+        same form ``verify_strategy`` takes) additionally runs the HLO
+        communication audit over the TOP-RANKED candidate's lowered step:
+        a candidate whose realized collective schedule diverges from its
+        plan (X001 unintended reshard / X002 missing sync) is DEMOTED —
+        recorded in ``last_rejected`` and the next-ranked candidate is
+        audited instead — and the winner's realized-vs-intended byte
+        table lands in ``last_audit`` (+ telemetry gauges
+        ``auto_strategy.audit_{realized,intended}_bytes``) so reports can
+        show intended vs realized vs measured side by side.
         """
         self._candidates = candidates
         self._flops = flops_per_example
         self._batch = batch_per_chip
         self._verify = verify
         self._hbm_budget = hbm_bytes_per_device
+        self._audit_shapes = audit_batch_shapes
         if isinstance(calibration, str):
             import json
 
@@ -83,6 +95,7 @@ class AutoStrategy(StrategyBuilder):
         self.last_ranking = None
         self.last_rejected = None
         self.last_prediction_error = None
+        self.last_audit = None
 
     def _screen(self, cands, model_item, resource_spec):
         """Verifier feasibility gate: (feasible builders, rejected list)."""
@@ -124,11 +137,64 @@ class AutoStrategy(StrategyBuilder):
                                   batch_per_chip=self._batch,
                                   calibration=self._calibration)
         self.last_ranking = [(name, cost) for cost, name, *_ in ranking]
+        if self._audit_shapes is not None:
+            ranking = self._audit_ranked(ranking, model_item, resource_spec)
         cost, name, _builder, _est, strategy = ranking[0]
         logging.info("AutoStrategy picked %s (est %.2fms/step); ranking: %s",
                      name, cost * 1e3,
                      [(n, round(c * 1e3, 3)) for n, c in self.last_ranking])
         return strategy
+
+    def _audit_ranked(self, ranking, model_item, resource_spec):
+        """HLO communication audit of the winner: lower the top-ranked
+        candidate's step and diff its realized collective schedule against
+        the plan (:mod:`autodist_tpu.analysis.hlo_audit`).  A candidate
+        realizing unplanned communication (X001) or dropping planned sync
+        (X002) is demoted and the next one audited.  Returns the ranking
+        with demoted candidates removed (raises when none survive)."""
+        from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
+                                           StrategyVerificationError,
+                                           verify_strategy)
+
+        self.last_rejected = self.last_rejected or []
+        survivors = list(ranking)
+        while survivors:
+            cost, name, _b, est, strategy = survivors[0]
+            report = verify_strategy(
+                strategy, model_item, resource_spec,
+                batch_shapes=self._audit_shapes,
+                hbm_bytes_per_device=self._hbm_budget,
+                passes=STATIC_PASSES + LOWERED_PASSES)
+            bad = {"X001", "X002"} & set(report.error_codes())
+            audit = next((f.data for f in report.findings
+                          if f.code == "X006"), None)
+            if not bad:
+                if audit is not None:
+                    from autodist_tpu.simulator.cost_model import (
+                        predicted_comm_bytes)
+
+                    audit = dict(audit)
+                    audit["strategy"] = name
+                    audit["predicted"] = predicted_comm_bytes(est)
+                    self.last_audit = audit
+                    from autodist_tpu import telemetry
+
+                    telemetry.gauge(
+                        "auto_strategy.audit_realized_bytes",
+                        sum(audit["realized"].values()), strategy=name)
+                    telemetry.gauge(
+                        "auto_strategy.audit_intended_bytes",
+                        sum(audit["intended"].values()), strategy=name)
+                return survivors
+            logging.warning(
+                "AutoStrategy: demoting %s — realized collective schedule "
+                "diverges from the plan (%s): %s", name, sorted(bad),
+                "; ".join(f.message for f in report.errors))
+            self.last_rejected.append((name, report))
+            survivors = survivors[1:]
+        raise StrategyVerificationError(self.last_rejected[-1][1]) \
+            from ValueError(
+                "every ranked candidate failed the HLO communication audit")
 
     def note_measured(self, measured_step_s, name=None):
         """Close the predicted-vs-measured loop: compare a real step time

@@ -4,44 +4,98 @@ Reference ``utils/visualization_util.py``: TensorBoard graph snapshots at
 each transform stage.  TPU equivalent: dump the StableHLO / optimized HLO of
 the compiled step per strategy pass into ``DEFAULT_HLO_DUMP_DIR`` (enabled
 by ``AUTODIST_DUMP_HLO=True``), plus ``jax.profiler`` trace helpers.
+
+Dumps are NAMESPACED per strategy and run: each ``dump_step_artifacts``
+call writes into ``<DEFAULT_HLO_DUMP_DIR>/<strategy_id>_r<NNN>/`` where
+``NNN`` is a monotonic run index, so two strategies (or two runs of one
+strategy) never overwrite each other's artifacts.  :func:`latest_dump`
+returns the newest StableHLO dump for a strategy id — the HLO
+communication audit (:mod:`autodist_tpu.analysis.hlo_audit`) reuses it
+instead of re-lowering the step when one is present.
 """
 import os
+import re
 
 from autodist_tpu.const import DEFAULT_HLO_DUMP_DIR, ENV
 from autodist_tpu.utils import logging
+
+_SAFE_RE = re.compile(r"[^\w.-]+")
+
+
+def _safe(name):
+    return _SAFE_RE.sub("_", str(name)) or "strategy"
+
+
+def _run_dirs(strategy_id, base=None):
+    """Existing (index, path) run dirs for a strategy id, sorted."""
+    base = base or DEFAULT_HLO_DUMP_DIR
+    prefix = f"{_safe(strategy_id)}_r"
+    out = []
+    try:
+        entries = os.listdir(base)
+    except OSError:
+        return out
+    for d in entries:
+        if d.startswith(prefix) and d[len(prefix):].isdigit():
+            out.append((int(d[len(prefix):]), os.path.join(base, d)))
+    out.sort()
+    return out
+
+
+def next_run_dir(strategy_id, base=None):
+    """Fresh ``<base>/<strategy_id>_r<NNN>`` dump dir (monotonic NNN)."""
+    base = base or DEFAULT_HLO_DUMP_DIR
+    runs = _run_dirs(strategy_id, base)
+    idx = runs[-1][0] + 1 if runs else 0
+    path = os.path.join(base, f"{_safe(strategy_id)}_r{idx:03d}")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def latest_dump(strategy_id, base=None):
+    """Path of the newest StableHLO dump for ``strategy_id`` (the
+    stage-1 ``1_*.stablehlo.txt`` artifact, else any ``*.stablehlo.txt``
+    in the newest run dir), or ``None`` when no dump exists."""
+    for _idx, d in reversed(_run_dirs(strategy_id, base)):
+        files = sorted(f for f in os.listdir(d)
+                       if f.endswith(".stablehlo.txt"))
+        staged = [f for f in files if f.startswith("1_")]
+        if staged or files:
+            return os.path.join(d, (staged or files)[0])
+    return None
 
 
 def dump_step_artifacts(transformer, step_fn, state, batch, name="train_step"):
     """Four-stage program-evolution dump (reference parity: the TF
     transformer logs the graph to TensorBoard after each of its four passes,
-    ``kernel/graph_transformer.py:62-90``).  TPU analog, written to
-    ``DEFAULT_HLO_DUMP_DIR`` when ``AUTODIST_DUMP_HLO`` is set:
+    ``kernel/graph_transformer.py:62-90``).  TPU analog, written to a
+    per-(strategy, run) subdir of ``DEFAULT_HLO_DUMP_DIR`` when
+    ``AUTODIST_DUMP_HLO`` is set:
 
-      0_<name>.plan.txt            transform plan (placements, buckets)
-      1_<name>.stablehlo.txt       lowered StableHLO of the jitted step
-      2_<name>.optimized_hlo.txt   XLA-optimized HLO
-      3_<name>.executable.json     executable stats (flops, bytes, memory)
+      <sid>_r<NNN>/0_<name>.plan.txt            transform plan
+      <sid>_r<NNN>/1_<name>.stablehlo.txt       lowered StableHLO
+      <sid>_r<NNN>/2_<name>.optimized_hlo.txt   XLA-optimized HLO
+      <sid>_r<NNN>/3_<name>.executable.json     executable stats
 
-    No-op unless AUTODIST_DUMP_HLO.  Returns the dump dir or None.
+    No-op unless AUTODIST_DUMP_HLO.  Returns the run's dump dir or None.
     """
     if not ENV.AUTODIST_DUMP_HLO.val:
         return None
     import json
 
-    os.makedirs(DEFAULT_HLO_DUMP_DIR, exist_ok=True)
+    sid = getattr(getattr(transformer, "strategy", None), "id", "") or name
+    run_dir = next_run_dir(sid)
 
-    with open(os.path.join(DEFAULT_HLO_DUMP_DIR, f"0_{name}.plan.txt"),
-              "w") as f:
+    with open(os.path.join(run_dir, f"0_{name}.plan.txt"), "w") as f:
         f.write(transformer.plan_summary())
 
     lowered = step_fn.lower(state, batch)
-    with open(os.path.join(DEFAULT_HLO_DUMP_DIR, f"1_{name}.stablehlo.txt"),
-              "w") as f:
+    with open(os.path.join(run_dir, f"1_{name}.stablehlo.txt"), "w") as f:
         f.write(lowered.as_text())
     try:
         compiled = lowered.compile()
-        with open(os.path.join(DEFAULT_HLO_DUMP_DIR,
-                               f"2_{name}.optimized_hlo.txt"), "w") as f:
+        with open(os.path.join(run_dir, f"2_{name}.optimized_hlo.txt"),
+                  "w") as f:
             f.write(compiled.as_text())
         stats = {}
         try:
@@ -61,14 +115,13 @@ def dump_step_artifacts(transformer, step_fn, state, batch, name="train_step"):
                         getattr(ma, attr))
         except Exception as e:
             stats["memory_analysis_error"] = str(e)
-        with open(os.path.join(DEFAULT_HLO_DUMP_DIR,
-                               f"3_{name}.executable.json"), "w") as f:
+        with open(os.path.join(run_dir, f"3_{name}.executable.json"),
+                  "w") as f:
             json.dump(stats, f, indent=1)
     except Exception as e:  # compile may be deferred/unavailable
         logging.debug("optimized HLO unavailable for %s: %s", name, e)
-    logging.info("Dumped 4-stage step artifacts for %s to %s", name,
-                 DEFAULT_HLO_DUMP_DIR)
-    return DEFAULT_HLO_DUMP_DIR
+    logging.info("Dumped 4-stage step artifacts for %s to %s", name, run_dir)
+    return run_dir
 
 
 def dump_hlo(fn_or_lowered, name, *args, **kwargs):

@@ -358,7 +358,9 @@ def _load_tool(name):
 def test_make_check_chain_lint_and_records_clean():
     """The `make check` gate, in-process: tools/lint.py over the default
     roots AND tools/verify_strategy.py over every cpu_mesh record plus the
-    selftest — all green, from tier-1."""
+    selftests — with --hlo, so every record's REALIZED collective schedule
+    is audited against its plan (no X001/X002) and the seeded implicit-
+    reshard case fires X001 — all green, from tier-1."""
     lint = _load_tool("lint.py")
     assert lint.main([os.path.join(REPO, d)
                       for d in ("autodist_tpu", "tests", "examples",
@@ -369,7 +371,7 @@ def test_make_check_chain_lint_and_records_clean():
     records = sorted(os.path.join(records_dir, f)
                      for f in os.listdir(records_dir) if f.endswith(".json"))
     assert records, "cpu_mesh sweep records are missing"
-    assert vs.main(records + ["--selftest"]) == 0
+    assert vs.main(records + ["--selftest", "--hlo"]) == 0
 
 
 def test_cli_rejects_hand_built_case_via_subprocess(tmp_path):

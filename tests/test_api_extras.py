@@ -90,10 +90,19 @@ def test_four_stage_artifact_dump(tmp_path, monkeypatch):
     sess = ad.distribute(loss, {"w": jnp.zeros((6,), jnp.float32)},
                          optax.sgd(0.1))
     sess.run(np.random.RandomState(0).randn(8, 6).astype(np.float32))
-    files = sorted(os.listdir(tmp_path))
+    # dumps are namespaced per (strategy id, run index) so two runs (or
+    # two strategies) never overwrite each other's artifacts
+    sid = sess._t.strategy.id
+    run_dirs = sorted(d for d in os.listdir(tmp_path)
+                      if d.startswith(f"{sid}_r"))
+    assert run_dirs == [f"{sid}_r000"]
+    run_dir = tmp_path / run_dirs[0]
+    files = sorted(os.listdir(run_dir))
     assert "0_train_step.plan.txt" in files
     assert "1_train_step.stablehlo.txt" in files
     assert "2_train_step.optimized_hlo.txt" in files
     assert "3_train_step.executable.json" in files
-    plan = open(tmp_path / "0_train_step.plan.txt").read()
+    plan = open(run_dir / "0_train_step.plan.txt").read()
     assert "replicated/ps" in plan and "mesh:" in plan
+    # the audit's dump-reuse hook resolves to this run's StableHLO
+    assert viz.latest_dump(sid) == str(run_dir / "1_train_step.stablehlo.txt")

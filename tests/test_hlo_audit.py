@@ -1,0 +1,440 @@
+"""HLO communication auditor (autodist_tpu/analysis/hlo_audit.py).
+
+Covers the collective extractor (golden-file pins on small lowered
+modules + live-lowering drift checks), the intended-plan construction
+(:meth:`GraphTransformer.intended_collectives`), the X-code matcher, the
+seeded implicit-reshard case, the two-level per-hop acceptance contract
+against the cost model, dump namespacing/reuse, the AutoStrategy audit
+gate, and the AD01 lint rule.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
+                                   TRACE_PASSES, Severity,
+                                   StrategyVerificationError,
+                                   verify_strategy)
+from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
+                                         build_reshard_case)
+from autodist_tpu.analysis.hlo_audit import (BYTES_TOL, SMALL_BYTES, Channel,
+                                             CollectiveOp, audit_collectives,
+                                             channels_from_plan,
+                                             extract_collectives)
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "hlo")
+
+ALL_PASSES = STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+SPEC8 = ResourceSpec(resource_info={
+    "nodes": [{"address": "localhost", "chips": list(range(8))}]})
+
+
+def _fixture(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+# -- extractor: golden-file pins -------------------------------------------
+
+
+def test_extract_two_level_trio_and_tuple_axis_group():
+    """Golden pin: reduce-scatter over a 2x4 sub-axis, the cross-slice
+    all-reduce over the 4x2 orthogonal groups, the all-gather back, and a
+    tuple-axis pmean whose single group spans all 8 devices."""
+    ops = extract_collectives(_fixture("two_level_tuple_axis.stablehlo.txt"))
+    by_kind = {}
+    for op in ops:
+        by_kind.setdefault(op.kind, []).append(op)
+    (rs,) = by_kind["reduce_scatter"]
+    assert (rs.operand_bytes, rs.result_bytes) == (64, 16)
+    assert (rs.group_count, rs.group_size) == (2, 4)
+    assert rs.dtype == "f32" and not rs.in_loop
+    (ag,) = by_kind["all_gather"]
+    assert (ag.operand_bytes, ag.result_bytes) == (16, 64)
+    assert ag.wire_bytes == 64          # all_gather bills its result
+    assert (ag.group_count, ag.group_size) == (2, 4)
+    ars = sorted(by_kind["all_reduce"], key=lambda o: o.operand_bytes)
+    assert (ars[0].group_count, ars[0].group_size) == (4, 2)   # DCN hop
+    assert (ars[1].group_count, ars[1].group_size) == (1, 8)   # tuple axis
+    assert ars[1].operand_bytes == 64
+
+
+def test_extract_scan_nested_collective_multiplicity():
+    """Golden pin: the scan body is OUTLINED into a function called from
+    the while region — its pmean must come back in_loop with the loop's
+    static trip count (5), while the bf16 psum outside stays count 1."""
+    ops = extract_collectives(_fixture("scan_nested.stablehlo.txt"))
+    in_loop = [o for o in ops if o.in_loop]
+    outside = [o for o in ops if not o.in_loop]
+    assert len(in_loop) == 1 and len(outside) == 1
+    assert in_loop[0].kind == "all_reduce"
+    assert in_loop[0].count == 5.0
+    assert in_loop[0].operand_bytes == 256          # 64 x f32
+    assert in_loop[0].total_bytes == 5 * 256
+    assert outside[0].dtype == "bf16"
+    assert outside[0].operand_bytes == 128          # 64 x bf16
+
+
+def test_extract_live_lowering_matches_golden_shape():
+    """Drift check: a fresh lowering of the same scan program must parse
+    to the same realized schedule the golden file pins (if a jax upgrade
+    changes the textual format, THIS test localizes the breakage)."""
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+
+    def scanny(x):
+        def body(c, _):
+            return c + jax.lax.pmean(c * 2.0, "replica"), None
+        c, _ = jax.lax.scan(body, x, None, length=5)
+        return c + jax.lax.psum(
+            x.astype(jnp.bfloat16), "replica").astype(jnp.float32)
+
+    f = jax.shard_map(scanny, mesh=mesh, in_specs=P("replica"),
+                      out_specs=P("replica"), check_vma=False)
+    txt = jax.jit(f).trace(
+        jax.ShapeDtypeStruct((512,), "float32")).lower().as_text()
+    ops = extract_collectives(txt)
+    assert sorted((o.kind, o.in_loop, o.count) for o in ops) == \
+        [("all_reduce", False, 1.0), ("all_reduce", True, 5.0)]
+
+
+def test_extract_collective_permute_pairs():
+    mesh = Mesh(np.array(jax.devices()[:8]), ("r",))
+
+    def body(x):
+        return jax.lax.ppermute(x, "r", [(i, (i + 1) % 8) for i in range(8)])
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=P("r"), out_specs=P("r"),
+                      check_vma=False)
+    ops = extract_collectives(jax.jit(f).trace(
+        jax.ShapeDtypeStruct((8, 16), "float32")).lower().as_text())
+    (perm,) = [o for o in ops if o.kind == "collective_permute"]
+    assert perm.pairs == 8
+    assert perm.operand_bytes == 16 * 4
+
+
+# -- the matcher (X-codes), unit level --------------------------------------
+
+
+def _chan(label="b0", kinds=("all_reduce",), nbytes=100_000.0, **kw):
+    return Channel(label=label, kinds=tuple(kinds), bytes=nbytes, **kw)
+
+
+def _op(kind="all_reduce", nbytes=100_000.0, **kw):
+    return CollectiveOp(kind=kind, operand_bytes=nbytes,
+                        result_bytes=nbytes, dtype="f32", **kw)
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+def test_matcher_clean_schedule_is_only_a_summary():
+    findings = audit_collectives([_op()], [_chan()])
+    assert _codes(findings) == ["X006"]
+    assert findings[0].data["realized"]["flat"] == 100_000.0
+
+
+def test_x001_unmatched_collective_is_error():
+    findings = audit_collectives([_op("all_to_all")], [_chan()])
+    assert "X001" in _codes(findings)
+    (x1,) = [f for f in findings if f.code == "X001"]
+    assert x1.severity == Severity.ERROR
+    assert "all_to_all" in x1.message
+
+
+def test_x002_missing_required_channel_is_error():
+    findings = audit_collectives([], [_chan()])
+    assert "X002" in _codes(findings)
+    # tiny channels (<= SMALL_BYTES) are control-plane: never required
+    tiny = channels_from_plan([{"label": "t", "kinds": ("all_reduce",),
+                                "bytes": SMALL_BYTES / 2}])
+    assert "X002" not in _codes(audit_collectives([], tiny))
+
+
+def test_x003_overshoot_beyond_tolerance_warns():
+    over = _op(nbytes=100_000.0 * (1 + BYTES_TOL) + SMALL_BYTES)
+    findings = audit_collectives([over], [_chan()])
+    assert "X003" in _codes(findings)
+    within = _op(nbytes=100_000.0 * (1 + BYTES_TOL / 2))
+    assert "X003" not in _codes(audit_collectives([within], [_chan()]))
+
+
+def test_x004_replica_group_factorization_mismatch_warns():
+    op = _op(group_count=2, group_size=4)
+    findings = audit_collectives([op], [_chan(group_sizes=(8,))])
+    assert "X004" in _codes(findings)
+
+
+def test_x005_in_loop_collective_against_once_per_step_plan_warns():
+    op = _op(nbytes=50_000.0, in_loop=True, count=2.0)
+    findings = audit_collectives([op], [_chan()])
+    assert "X005" in _codes(findings)
+    # a plan that ISSUES the sync in-scan (overlap + accum) is clean
+    planned = audit_collectives([op], [_chan(in_scan=True)])
+    assert "X005" not in _codes(planned)
+
+
+def test_small_ops_are_control_plane_and_model_axis_ops_are_users():
+    scalar = _op(nbytes=4.0)
+    tp = _op(nbytes=50_000.0, group_count=4, group_size=2)
+    findings = audit_collectives(
+        [scalar, tp], [], data_group_sizes=(8,), model_group_sizes=(2,))
+    assert _codes(findings) == ["X006"]
+    assert findings[0].data["control_bytes"] == 4.0
+    assert findings[0].data["user_bytes"] == 50_000.0
+
+
+def test_best_fit_matching_never_starves_a_same_kind_channel():
+    """Two same-kind channels; the big channel's tolerance slack must not
+    swallow the small channel's only collective (the PartitionedPS
+    false-X002 regression)."""
+    big = _chan("big", nbytes=131_072.0)
+    small = _chan("small", nbytes=16_384.0)
+    ops = [_op(nbytes=131_072.0), _op(nbytes=16_384.0)]
+    findings = audit_collectives(ops, [big, small])
+    assert "X002" not in _codes(findings)
+    assert small.matched_ops == 1 and big.matched_ops == 1
+
+
+# -- intended plan ----------------------------------------------------------
+
+
+def _item(shape=(64, 64), **kw):
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"]) ** 2) + sum(
+            jnp.sum(jnp.square(x)) for x in jax.tree.leaves(p))
+
+    return ModelItem(loss, {"w": jnp.zeros(shape)}, optax.adam(1e-3), **kw)
+
+
+def _transformer(builder, item, mesh_shape=(8,), axes=("replica",)):
+    from autodist_tpu.kernel.graph_transformer import GraphTransformer
+
+    s = builder.build(item, SPEC8)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(mesh_shape), axes)
+    return GraphTransformer(s, item, mesh)
+
+
+def test_intended_collectives_flat_allreduce():
+    t = _transformer(AllReduce(), _item())
+    plan = t.intended_collectives()
+    flat = [e for e in plan if e["phase"] == "flat"]
+    assert flat and all(e["kinds"] == ("all_reduce",) for e in flat)
+    assert sum(e["bytes"] for e in flat) == 64 * 64 * 4
+    assert all(e["group_sizes"] == (8,) for e in flat)
+
+
+def test_intended_collectives_two_level_phases():
+    t = _transformer(AllReduce(hierarchy="two_level"), _item(),
+                     mesh_shape=(2, 4), axes=("replica_dcn", "replica_ici"))
+    plan = t.intended_collectives()
+    phases = {e["phase"] for e in plan}
+    assert {"ici_hop", "dcn_hop"} <= phases
+    ici = [e for e in plan if e["phase"] == "ici_hop"]
+    dcn = [e for e in plan if e["phase"] == "dcn_hop"]
+    # scatter + gather bill the full (padded) bucket; the DCN hop only
+    # the 1/R_ici shard
+    assert sum(e["bytes"] for e in ici) == pytest.approx(2 * 64 * 64 * 4)
+    assert sum(e["bytes"] for e in dcn) == pytest.approx(64 * 64 * 4 / 4)
+    assert all(e["group_sizes"] == (4,) for e in ici)
+    assert all(e["group_sizes"] == (2,) for e in dcn)
+
+
+# -- end to end -------------------------------------------------------------
+
+
+def _batch_shapes(d=64, n=16):
+    return {"x": ((n, d), "float32")}
+
+
+def test_clean_strategy_audits_clean_end_to_end():
+    item = _item((128, 128))
+    s = AllReduce().build(item, SPEC8)
+    report = verify_strategy(s, item, SPEC8, passes=ALL_PASSES,
+                             batch_shapes=_batch_shapes(128))
+    assert report.ok, str(report)
+    (x6,) = [f for f in report.findings if f.code == "X006"]
+    assert x6.data["n_unmatched"] == 0
+    assert x6.data["realized"]["flat"] == pytest.approx(
+        x6.data["intended"]["flat"], rel=BYTES_TOL)
+
+
+def test_seeded_reshard_case_is_caught_as_x001_only_by_the_audit():
+    case = build_reshard_case()
+    # the jaxpr tier is blind to it ...
+    jaxpr_report = verify_strategy(
+        passes=STATIC_PASSES + TRACE_PASSES, **case)
+    assert jaxpr_report.ok
+    # ... the lowered tier is not
+    report = verify_strategy(passes=ALL_PASSES, **case)
+    assert EXPECTED_AUDIT_ERROR_CODE in report.error_codes()
+    x1 = report.by_code("X001")
+    assert any("all_to_all" in f.message for f in x1)
+    with pytest.raises(StrategyVerificationError):
+        report.raise_for_errors()
+
+
+def test_two_level_record_realized_bytes_match_cost_model_per_hop():
+    """The acceptance contract: X006 realized per-hop bytes for the
+    recorded two-level strategy agree with the cost model's
+    hier_ici_bytes / hier_dcn_bytes within BYTES_TOL."""
+    import importlib.util
+
+    path = os.path.join(REPO, "tools", "verify_strategy.py")
+    spec = importlib.util.spec_from_file_location("verify_strategy_cli", path)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    rec = os.path.join(REPO, "records", "cpu_mesh",
+                       "gpt_tiny_AllReduce_two_level.json")
+    case = cli._record_case(rec, 16 * 1024 ** 3)
+    report = verify_strategy(passes=ALL_PASSES, **case)
+    assert report.ok, str(report)
+    (x6,) = [f for f in report.findings if f.code == "X006"]
+
+    from autodist_tpu.simulator.cost_model import estimate
+
+    est = estimate(case["strategy"], case["model_item"],
+                   ResourceSpec.from_num_chips(8))
+    assert x6.data["realized"]["ici_hop"] == pytest.approx(
+        est.breakdown["hier_ici_bytes"], rel=BYTES_TOL)
+    assert x6.data["realized"]["dcn_hop"] == pytest.approx(
+        est.breakdown["hier_dcn_bytes"], rel=BYTES_TOL)
+
+
+def test_overlap_accum_in_scan_sync_is_planned_not_x005():
+    """overlap + accum issues the elementwise buckets' collectives INSIDE
+    the scan — the audit must see A in-loop collectives and match them to
+    an in_scan channel (no X005, realized == A x bucket bytes)."""
+    from autodist_tpu.analysis.verify import verify_transformer
+
+    item = _item((128, 128))
+    from autodist_tpu.kernel.graph_transformer import GraphTransformer
+
+    s = AllReduce(schedule="overlap").build(item, SPEC8)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("replica",))
+    t = GraphTransformer(s, item, mesh, accum_steps=4)
+    report = verify_transformer(t, _batch_shapes(128, 32),
+                                passes=ALL_PASSES)
+    assert report.ok, str(report)
+    assert not report.by_code("X005")
+    (x6,) = [f for f in report.findings if f.code == "X006"]
+    assert x6.data["realized"]["flat"] == pytest.approx(
+        4 * 128 * 128 * 4, rel=BYTES_TOL)
+
+
+# -- dump namespacing + reuse -----------------------------------------------
+
+
+def test_dump_namespacing_and_latest_dump(tmp_path, monkeypatch):
+    import autodist_tpu.utils.visualization_util as viz
+
+    monkeypatch.setattr(viz, "DEFAULT_HLO_DUMP_DIR", str(tmp_path))
+    d0 = viz.next_run_dir("strat-A")
+    d1 = viz.next_run_dir("strat-A")
+    db = viz.next_run_dir("strat-B")
+    assert d0.endswith("strat-A_r000") and d1.endswith("strat-A_r001")
+    assert db.endswith("strat-B_r000")
+    assert viz.latest_dump("strat-A") is None      # no stablehlo yet
+    with open(os.path.join(d0, "1_step.stablehlo.txt"), "w") as f:
+        f.write("old")
+    with open(os.path.join(d1, "1_step.stablehlo.txt"), "w") as f:
+        f.write("new")
+    assert open(viz.latest_dump("strat-A")).read() == "new"
+    assert viz.latest_dump("strat-C") is None
+
+
+def test_audit_reuses_namespaced_dump_instead_of_relowering(tmp_path,
+                                                            monkeypatch):
+    """The auditor picks up an existing program-evolution dump for the
+    strategy id rather than re-lowering (satellite contract)."""
+    import autodist_tpu.utils.visualization_util as viz
+    from autodist_tpu.analysis.hlo_audit import lowered_text_for
+    from autodist_tpu.analysis.verify import AnalysisContext
+
+    monkeypatch.setattr(viz, "DEFAULT_HLO_DUMP_DIR", str(tmp_path))
+    item = _item()
+    s = AllReduce().build(item, SPEC8)
+    d = viz.next_run_dir(s.id)
+    with open(os.path.join(d, "1_train_step.stablehlo.txt"), "w") as f:
+        f.write(_fixture("scan_nested.stablehlo.txt"))
+    ctx = AnalysisContext(strategy=s, model_item=item)
+    text, source = lowered_text_for(ctx)
+    assert text.startswith("module @jit_scanny")
+    assert "dump" in source and s.id in source
+
+
+# -- AutoStrategy gate ------------------------------------------------------
+
+
+def test_auto_strategy_audit_exports_realized_bytes():
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    item = _item((128, 128))
+    auto = AutoStrategy(audit_batch_shapes=_batch_shapes(128))
+    auto.build(item, SPEC8)
+    assert auto.last_audit is not None
+    assert auto.last_audit["strategy"] == auto.last_ranking[0][0]
+    assert set(auto.last_audit["realized"]) <= \
+        {"flat", "ici_hop", "dcn_hop", "ps", "materialize", "custom",
+         "stale", "sparse", "mutable"}
+    assert "predicted" in auto.last_audit
+
+
+def test_auto_strategy_demotes_reshard_realizations():
+    """Every candidate realizes the loss's unplanned all_to_all, so the
+    audit demotes the whole ranking and raises — recording each X001
+    rejection in last_rejected."""
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    case = build_reshard_case()
+    auto = AutoStrategy(
+        candidates=[AllReduce(), AllReduce(compressor="BF16Compressor")],
+        audit_batch_shapes=case["batch_shapes"])
+    with pytest.raises(StrategyVerificationError):
+        auto.build(case["model_item"], case["resource_spec"])
+    assert len(auto.last_rejected) == 2
+    for _name, rep in auto.last_rejected:
+        assert "X001" in rep.error_codes()
+
+
+# -- AD01 lint rule ---------------------------------------------------------
+
+
+def _lint_snippet(tmp_path, relpath, source):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [code for _p, _ln, code, _m in lint.lint_file(p)]
+
+
+def test_ad01_flags_bare_jit_lower_in_engine_code(tmp_path):
+    bad = "import jax\nlo = jax.jit(lambda x: x).lower(1.0)\n"
+    assert "AD01" in _lint_snippet(tmp_path, "autodist_tpu/x.py", bad)
+    assert "AD01" in _lint_snippet(tmp_path, "tools/y.py", bad)
+
+
+def test_ad01_exempts_xla_options_tests_and_traced_lowerings(tmp_path):
+    bad = "import jax\nlo = jax.jit(lambda x: x).lower(1.0)\n"
+    ok = ("import jax\n"
+          "tr = jax.jit(lambda x: x).trace(1.0)\n"
+          "lo = tr.lower()\n")
+    assert "AD01" not in _lint_snippet(
+        tmp_path, "autodist_tpu/kernel/xla_options.py", bad)
+    assert "AD01" not in _lint_snippet(tmp_path, "tests/test_z.py", bad)
+    assert "AD01" not in _lint_snippet(tmp_path, "autodist_tpu/ok.py", ok)

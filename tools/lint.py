@@ -15,6 +15,14 @@ codebase:
   W291  trailing whitespace
   W191  tab indentation
   F502  f-string without placeholders
+  AD01  bare ``jax.jit(...).lower()`` in engine/tool code: lowering (and
+        the compile that follows) must route through the shared
+        compile-options path (``kernel/xla_options.py`` — the latency-
+        hiding-scheduler flags the overlap schedule depends on) or the
+        engine's trace-then-lower AOT path; a bare chain silently
+        compiles WITHOUT the engine's compiler options.  Scoped to
+        ``autodist_tpu/`` and ``tools/``; ``kernel/xla_options.py``
+        itself (the blessed probe site) is exempt.
 
 Exit code 1 when any finding is reported.
 """
@@ -24,6 +32,17 @@ from pathlib import Path
 
 IGNORED_DIRS = {"__pycache__", ".git", "build", ".pytest_cache"}
 GENERATED_SUFFIX = "_pb2.py"
+
+# AD01 applies to engine + tool code only (tests may lower helper fns for
+# equivalence checks); the shared compile-options path is exempt
+_AD01_PARTS = ("autodist_tpu", "tools")
+_AD01_EXEMPT = "xla_options.py"
+
+
+def _ad01_applies(path):
+    p = Path(path)
+    return any(part in _AD01_PARTS for part in p.parts) \
+        and p.name != _AD01_EXEMPT
 
 
 class Checker(ast.NodeVisitor):
@@ -138,6 +157,31 @@ class Checker(ast.NodeVisitor):
                 isinstance(t, ast.Name) for t in node.targets):
             self.add(node.lineno, "E731",
                      "lambda assigned to a name (use 'def')")
+        self.generic_visit(node)
+
+    # -- AD01: bare jax.jit(...).lower() chains ----------------------------
+
+    @staticmethod
+    def _is_jit_call(node):
+        """``jax.jit(...)`` or ``jit(...)`` as a direct call expression."""
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "jit":
+            return True
+        return (isinstance(f, ast.Attribute) and f.attr == "jit"
+                and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+    def visit_Call(self, node):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "lower"
+                and self._is_jit_call(f.value)
+                and _ad01_applies(self.path)):
+            self.add(node.lineno, "AD01",
+                     "bare jax.jit(...).lower(): route the lowering "
+                     "through kernel/xla_options.py (compile_lowered / "
+                     "compiler_options_for) so the engine's compiler "
+                     "options apply")
         self.generic_visit(node)
 
     def visit_Compare(self, node):

@@ -13,7 +13,12 @@ absent; schema in ``autodist_tpu/telemetry/schema.py``) and reports:
   the backend reports ``memory_stats`` and the kind is recognized),
 - predicted comm/compute overlap from the recorded cost estimate next
   to the measured walls (predicted-vs-measured error),
-- async-PS staleness counters and watchdog captures when present.
+- async-PS staleness counters and watchdog captures when present,
+- with ``--audit <report.json>`` (the ``tools/verify_strategy.py --hlo
+  --json`` output, or an ``AutoStrategy.last_audit`` dump): the HLO
+  communication audit's INTENDED vs REALIZED wire bytes per phase, next
+  to the cost model's PREDICTED bytes and the run's MEASURED walls — the
+  full plan -> lowering -> hardware chain in one table.
 """
 import argparse
 import json
@@ -193,21 +198,83 @@ def render(summary):
     return "\n".join(lines)
 
 
+def load_audit(path):
+    """Extract per-phase intended/realized byte tables from an audit
+    artifact: a ``verify_strategy --hlo --json`` report (X006 findings
+    carry the table in ``data``) or a bare ``AutoStrategy.last_audit``
+    dict dump.  Returns ``[(name, table), ...]``."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "intended" in doc and "realized" in doc:
+        return [(doc.get("strategy", os.path.basename(path)), doc)]
+    out = []
+    for name, report in (doc.items() if isinstance(doc, dict) else []):
+        for finding in report.get("findings", []):
+            if finding.get("code") == "X006" and finding.get("data"):
+                out.append((os.path.basename(name), finding["data"]))
+    return out
+
+
+def render_audit(audits, summary=None):
+    """Intended (plan) vs realized (lowered HLO) vs predicted (cost
+    model) wire bytes, next to the measured step wall when a manifest
+    summary is at hand."""
+    lines = []
+    for name, table in audits:
+        intended = table.get("intended", {})
+        realized = table.get("realized", {})
+        predicted = table.get("predicted", {})
+        lines.append(f"HLO audit — {name} "
+                     f"({table.get('n_collectives', '?')} collective(s), "
+                     f"{table.get('source', 'lowered module')}):")
+        for phase in sorted(set(intended) | set(realized) | set(predicted)):
+            row = (f"  {phase:12s} intended {_fmt_bytes(int(intended.get(phase, 0)))}"
+                   f"  realized {_fmt_bytes(int(realized.get(phase, 0)))}")
+            if phase in predicted:
+                row += f"  predicted {_fmt_bytes(int(predicted[phase]))}"
+            lines.append(row)
+        extra = []
+        if table.get("control_bytes"):
+            extra.append(f"control {_fmt_bytes(int(table['control_bytes']))}")
+        if table.get("user_bytes"):
+            extra.append(
+                f"user model-parallel {_fmt_bytes(int(table['user_bytes']))}")
+        if table.get("unmatched_bytes"):
+            extra.append(
+                f"UNPLANNED {_fmt_bytes(int(table['unmatched_bytes']))}")
+        if extra:
+            lines.append("  " + ", ".join(extra))
+    if summary and summary.get("step_time_p50_s") is not None:
+        lines.append(f"  measured step wall p50: "
+                     f"{_fmt_s(summary['step_time_p50_s'])}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("path", help="telemetry run dir or manifest.jsonl")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of text")
+    ap.add_argument("--audit", default=None,
+                    help="HLO-audit artifact (verify_strategy --hlo --json "
+                         "output or an AutoStrategy.last_audit dump): show "
+                         "intended vs realized vs predicted wire bytes "
+                         "next to the measured walls")
     args = ap.parse_args(argv)
     records = load_manifest(args.path)
     if not records:
         print(f"no telemetry records under {args.path}", file=sys.stderr)
         return 1
     summary = summarize_manifest(records)
+    audits = load_audit(args.audit) if args.audit else []
+    if audits:
+        summary["hlo_audit"] = {name: table for name, table in audits}
     if args.json:
         print(json.dumps(summary, indent=2))
     else:
         print(render(summary))
+        if audits:
+            print(render_audit(audits, summary))
     return 0
 
 

@@ -19,6 +19,11 @@ Targets:
   (:mod:`autodist_tpu.analysis.cases`): asserts the verifier still
   produces its three distinct ERROR findings (C001 deadlock, S011 bad
   mesh axis, H001 HBM overflow).
+- ``--hlo`` — additionally run the lowered-tier HLO communication audit
+  (``make audit``): every target's step is lowered and its REALIZED
+  collective schedule diffed against the strategy's plan (X-codes —
+  implicit reshards are X001 ERRORs); with ``--selftest``, the seeded
+  implicit-reshard case must be caught as X001.
 
 Exit status: 0 when every target is free of ERROR findings (and the
 selftest, when requested, fires correctly); 1 otherwise.
@@ -105,6 +110,10 @@ def main(argv=None):
                          "(e.g. 'TPU v5 lite')")
     ap.add_argument("--static-only", action="store_true",
                     help="skip the trace passes (no devices needed at all)")
+    ap.add_argument("--hlo", action="store_true",
+                    help="also run the lowered-tier HLO communication "
+                         "audit (X-codes): diff each strategy's realized "
+                         "collective schedule against its plan")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write all reports as JSON to this path")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -112,9 +121,15 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     _force_cpu_devices()
-    from autodist_tpu.analysis import (STATIC_PASSES, verify_strategy)
-    from autodist_tpu.analysis.cases import (EXPECTED_ERROR_CODES,
-                                             build_rejected_case)
+    from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
+                                       TRACE_PASSES, verify_strategy)
+    from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
+                                             EXPECTED_ERROR_CODES,
+                                             build_rejected_case,
+                                             build_reshard_case)
+
+    if args.hlo and args.static_only:
+        ap.error("--hlo needs the traced step; drop --static-only")
 
     hbm_bytes = int(args.hbm_gib * 1024 ** 3)
     if args.device_kind:
@@ -125,7 +140,12 @@ def main(argv=None):
                      f"known: {sorted(HBM_BY_DEVICE_KIND)}")
         hbm_bytes = HBM_BY_DEVICE_KIND[args.device_kind]
 
-    passes = STATIC_PASSES if args.static_only else None
+    if args.static_only:
+        passes = STATIC_PASSES
+    elif args.hlo:
+        passes = STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+    else:
+        passes = None
     results = {}
     failed = False
 
@@ -173,6 +193,21 @@ def main(argv=None):
         else:
             print(f"selftest passed: rejected with distinct ERROR codes "
                   f"{list(EXPECTED_ERROR_CODES)}")
+        if args.hlo:
+            # the seeded implicit-reshard case: clean under every
+            # jaxpr-tier pass, caught ONLY by the HLO audit as X001
+            report = verify_strategy(passes=passes, **build_reshard_case())
+            results["<reshard-selftest>"] = report
+            _print_report("audit selftest (expected X001)", report,
+                          args.verbose)
+            if EXPECTED_AUDIT_ERROR_CODE not in report.error_codes():
+                print(f"[ERROR] audit selftest: expected "
+                      f"{EXPECTED_AUDIT_ERROR_CODE} did not fire "
+                      f"(got {report.error_codes()})")
+                failed = True
+            else:
+                print(f"audit selftest passed: the implicit reshard is "
+                      f"{EXPECTED_AUDIT_ERROR_CODE}")
 
     if args.json_out:
         with open(args.json_out, "w") as f:
