@@ -50,6 +50,14 @@ Finding codes (stable; tests and tools match on them):
   Y004 WARNING PowerSGD main codec under TWO_LEVEL (engine realizes FLAT)
   Y005 WARNING dcn_compressor set on a non-TWO_LEVEL node (ignored)
   Y006 INFO    hierarchy summary (factorization + DCN-hop codec)
+  Y007 WARNING sharded_update with a block wire codec (int8/PowerSGD):
+               the scatter only decomposes elementwise codecs; the
+               engine realizes the REPLICATED update for those buckets
+  Y008 WARNING sharded_update var smaller than the shard count: whole
+               shards are padding (prefer the replicated update for
+               tiny vars, or a coarser bucket group)
+  Y009 INFO    sharded-update summary (shard↔mesh factorization, per-var
+               padding plan, 1/R opt-state fraction)
   X000 INFO    HLO audit skipped (no lowered module / no transformer)
   X001 ERROR   unintended (resharding) collective in the lowered module,
                absent from the strategy's plan
@@ -423,9 +431,17 @@ def hierarchy_pass(ctx):
     collectives must have declared ``replica_dcn x replica_ici`` axes to
     reference, and the DCN-hop codec must be shard-decomposable (the
     elementwise family + int8; a PowerSGD low-rank exchange cannot ride a
-    shard hop — ERROR, per docs/performance.md "Hierarchical sync")."""
+    shard hop — ERROR, per docs/performance.md "Hierarchical sync").
+
+    Also the ZeRO sharded-update lint (Y007-Y009): verifies the
+    shard↔mesh factorization and the per-var padding plan of
+    ``ShardedUpdate.SHARDED`` nodes — block wire codecs fall back to the
+    replicated update (Y007), vars smaller than the shard count waste
+    whole shards on padding (Y008), and Y009 summarizes the sharded
+    update's factorization + 1/R opt-state fraction."""
     from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
-    from autodist_tpu.kernel.synchronization.all_reduce import DCN_SAFE_CODECS
+    from autodist_tpu.kernel.synchronization.all_reduce import (
+        DCN_SAFE_CODECS, ELEMENTWISE_CODECS)
     from autodist_tpu.proto import synchronizers_pb2
 
     _C = synchronizers_pb2.AllReduceSynchronizer
@@ -450,12 +466,50 @@ def hierarchy_pass(ctx):
                 f"two-level schedule would address devices that do not "
                 f"exist (or leave some idle)", "mesh"))
 
+    var_infos = {v.name: v for v in ctx.model_item.var_infos} \
+        if ctx.model_item is not None else {}
+    R = max(1, ctx.num_replicas)
     two_level_nodes = dcn_codecs = 0
+    sharded_nodes = sharded_fallbacks = 0
     for node in proto.node_config:
         for src in (node, *node.part_config):
             if src.WhichOneof("synchronizer") != "AllReduceSynchronizer":
                 continue
             ar = src.AllReduceSynchronizer
+            if ar.sharded_update:
+                sharded_nodes += 1
+                wire = (ar.dcn_compressor or ar.compressor
+                        if ar.hierarchy != _C.FLAT else ar.compressor)
+                if (ar.compressor not in ELEMENTWISE_CODECS
+                        or wire not in ELEMENTWISE_CODECS):
+                    sharded_fallbacks += 1
+                    findings.append(_f(
+                        Severity.WARNING, "Y007", "hierarchy",
+                        f"sharded_update with a block wire codec "
+                        f"(compressor={ar.compressor}, effective wire="
+                        f"{wire}): a per-shard re-encoding of int8 blocks "
+                        f"or PowerSGD factors approximates differently "
+                        f"from the barrier reduce, so the engine realizes "
+                        f"the REPLICATED update for this bucket — the 1/R "
+                        f"opt-state saving does not apply",
+                        node.var_name))
+                else:
+                    v = var_infos.get(node.var_name)
+                    n_elems = 1
+                    if v is not None and v.shape:
+                        n_elems = 1
+                        for d in v.shape:
+                            n_elems *= int(d)
+                    if v is not None and v.shape and n_elems < R:
+                        findings.append(_f(
+                            Severity.WARNING, "Y008", "hierarchy",
+                            f"sharded_update over {R} shards but the "
+                            f"variable has only {n_elems} element(s): "
+                            f"{R - n_elems} shard(s) are pure padding — "
+                            f"the scatter/gather wire and the flat-shard "
+                            f"bookkeeping buy nothing for vars this "
+                            f"small; prefer the replicated update",
+                            node.var_name))
             if ar.dcn_compressor and \
                     ar.dcn_compressor not in DCN_SAFE_CODECS:
                 findings.append(_f(
@@ -498,6 +552,20 @@ def hierarchy_pass(ctx):
             f"replica_dcn={axis_sizes[AXIS_REPLICA_DCN]} x "
             f"replica_ici={axis_sizes[AXIS_REPLICA_ICI]} "
             f"({dcn_codecs} with an explicit DCN-hop codec)", "mesh"))
+    if sharded_nodes:
+        factorization = (
+            f"replica_dcn={axis_sizes.get(AXIS_REPLICA_DCN)} x "
+            f"replica_ici={axis_sizes.get(AXIS_REPLICA_ICI)} (fused "
+            f"ici-major shards)" if factored else f"{R} flat shards")
+        findings.append(_f(
+            Severity.INFO, "Y009", "hierarchy",
+            f"sharded weight update: {sharded_nodes} node(s) reduce-"
+            f"scatter into {factorization}; optimizer state shards 1/{R} "
+            f"per chip and an all-gather of fresh params replaces the "
+            f"gradient all-gather"
+            + (f" ({sharded_fallbacks} node(s) fall back to the "
+               f"replicated update — block wire codec)"
+               if sharded_fallbacks else ""), "mesh"))
     return findings
 
 

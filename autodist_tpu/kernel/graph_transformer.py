@@ -148,9 +148,36 @@ class GraphTransformer:
                     # flat (the analysis hierarchy pass warns about this)
                     h = _AR.FLAT
             plan.hierarchy = h
+        # -- ZeRO-style sharded weight update (ShardedUpdate.SHARDED) ------
+        # Normalize eligibility AFTER hierarchy resolution: only dense,
+        # non-scalar, replicated AR plans whose every wire transform is
+        # elementwise realize the reduce-scatter -> shard update ->
+        # param all-gather schedule; the rest (block codecs, sparse,
+        # scalars) fall back to the replicated update (Y007 warns).
+        for name in self.names:
+            plan = self.plans[name]
+            if not plan.sharded_update:
+                continue
+            if not part.plan_sharded_update(plan):
+                if (plan.sync == SyncKind.ALL_REDUCE
+                        and plan.placement == Placement.REPLICATED
+                        and not plan.sparse and plan.shape):
+                    logging.debug(
+                        "Variable %s: sharded_update requested but the "
+                        "wire codec is not elementwise; realizing the "
+                        "replicated update", name)
+                plan.sharded_update = 0
         shapes = {v.name: v.shape for v in model_item.var_infos}
         dtypes = {v.name: v.dtype for v in model_item.var_infos}
-        self.buckets = ar_sync.plan_buckets(self.plans, shapes, dtypes)
+        self.buckets = ar_sync.plan_buckets(self.plans, shapes, dtypes,
+                                            num_replicas=self.num_replicas)
+        self.sharded_buckets = [b for b in self.buckets
+                                if ar_sync.bucket_sharded(b)]
+        # var name -> (bucket, flat shard length) for the update-space
+        # param slice in the SPMD step
+        self._shard_of = {
+            n: (b, ss) for b in self.sharded_buckets
+            for n, ss in zip(b.var_names, b.shard_sizes)}
         # collective issue schedule: "overlap" = per-bucket reverse-
         # topological collectives under XLA's latency-hiding scheduler
         # (kernel/synchronization/all_reduce.sync_overlapped); "barrier" =
@@ -218,9 +245,9 @@ class GraphTransformer:
                 self.ps_groups.setdefault(key, []).append(name)
         logging.info(
             "Transform plan: %d vars, %d AR buckets (%s schedule, %s "
-            "hierarchy), placements=%s",
+            "hierarchy, %d sharded-update), placements=%s",
             len(self.names), len(self.buckets), self.sync_schedule,
-            self.sync_hierarchy,
+            self.sync_hierarchy, len(self.sharded_buckets),
             {p.value: sum(1 for q in self.plans.values() if q.placement is p)
              for p in Placement},
         )
@@ -232,6 +259,39 @@ class GraphTransformer:
         return ("two_level" if any(
             b.hierarchy == ar_sync._AR.TWO_LEVEL for b in self.buckets)
             else "flat")
+
+    @property
+    def sync_sharded_update(self):
+        """``True`` when any AR bucket realizes the ZeRO-style sharded
+        weight update (reduce-scatter -> shard update -> param gather)."""
+        return bool(self.sharded_buckets)
+
+    def sharded_update_summary(self):
+        """Static accounting of the sharded weight update — what telemetry
+        records (``sync.sharded_update``) and reports render next to the
+        HBM numbers (docs/performance.md "Sharded weight update").
+
+        ``shard_bytes`` is the per-chip update-space volume (the 1/R the
+        optimizer touches instead of the full parameter set);
+        ``padding_bytes`` is the per-chip cost of the per-var padding
+        plan; ``param_gather_bytes`` the fresh-param all-gather volume
+        that replaces the gradient all-gather."""
+        import numpy as _np
+
+        out = {"enabled": self.sync_sharded_update,
+               "buckets": len(self.sharded_buckets),
+               "vars": sum(len(b.var_names) for b in self.sharded_buckets),
+               "num_shards": (self.sharded_buckets[0].num_shards
+                              if self.sharded_buckets else 1),
+               "shard_bytes": 0.0, "padding_bytes": 0.0,
+               "param_gather_bytes": 0.0}
+        for b in self.sharded_buckets:
+            item = _np.dtype(b.dtype).itemsize
+            out["shard_bytes"] += b.shard_total * item
+            out["padding_bytes"] += \
+                (b.padded_total - b.total) * item / b.num_shards
+            out["param_gather_bytes"] += b.padded_total * item
+        return out
 
     def hierarchy_summary(self):
         """Static per-hop wire accounting of the chosen hierarchy — what
@@ -258,16 +318,28 @@ class GraphTransformer:
                "replica_ici": R_ici,
                "ici_hop_bytes": 0.0, "dcn_hop_bytes": 0.0,
                "flat_bytes": 0.0, "dcn_compressors": []}
+        out["sharded_update"] = self.sync_sharded_update
         for b in self.buckets:
-            nbytes = b.total * _np.dtype(b.dtype).itemsize
+            item = _np.dtype(b.dtype).itemsize
+            nbytes = b.total * item
+            sharded = ar_sync.bucket_sharded(b)
+            # sharded-update buckets move the padded matrix: grad scatter
+            # (codec-scaled) + FRESH-PARAM gather (native dtype) replace
+            # the gradient allreduce's two ring phases
+            pbytes = b.padded_total * item if sharded else nbytes
             if b.hierarchy == _AR.TWO_LEVEL:
                 d = ar_sync.dcn_codec(b)
-                out["ici_hop_bytes"] += 2.0 * nbytes
+                dcn_f = wire_byte_factor(d, b.total)
+                out["ici_hop_bytes"] += 2.0 * pbytes
                 out["dcn_hop_bytes"] += \
-                    nbytes * wire_byte_factor(d, b.total) / max(1, R_ici)
+                    pbytes * ((dcn_f + 1.0) if sharded else dcn_f) \
+                    / max(1, R_ici)
                 name = get_compressor(d).name if d else "none"
                 if name not in out["dcn_compressors"]:
                     out["dcn_compressors"].append(name)
+            elif sharded:
+                wf = wire_byte_factor(ar_sync.wire_codec(b), b.total)
+                out["flat_bytes"] += pbytes * (wf + 1.0) / 2.0
             else:
                 out["flat_bytes"] += \
                     nbytes * wire_byte_factor(b.compressor, b.total)
@@ -324,6 +396,29 @@ class GraphTransformer:
             in_scan = (self.sync_schedule == "overlap" and A > 1
                        and ar_sync.elementwise(b))
             mult = A if in_scan else 1
+            if ar_sync.bucket_sharded(b):
+                # ZeRO sharded update: grad reduce-scatter (codec-scaled,
+                # in-scan under overlapped accumulation) + ONE fresh-param
+                # all-gather per step (native dtype, never in the scan) —
+                # there is no gradient all-gather at all
+                pbytes = b.padded_total * item
+                wf = wire_byte_factor(ar_sync.wire_codec(b), b.total)
+                if b.hierarchy == _AR.TWO_LEVEL:
+                    shard_b = pbytes / max(1, R_ici)
+                    add(f"{b.key}/ici-scatter", ("reduce_scatter",),
+                        pbytes * mult, "ici_hop", (R_ici,), in_scan)
+                    add(f"{b.key}/dcn-scatter", ("reduce_scatter",),
+                        shard_b * wf * mult, "dcn_hop", (R_dcn,), in_scan)
+                    add(f"{b.key}/dcn-param-gather", ("all_gather",),
+                        shard_b, "dcn_hop", (R_dcn,))
+                    add(f"{b.key}/ici-param-gather", ("all_gather",),
+                        pbytes, "ici_hop", (R_ici,))
+                else:
+                    add(f"{b.key}/shard-scatter", ("reduce_scatter",),
+                        pbytes * wf * mult, "flat", (R,), in_scan)
+                    add(f"{b.key}/param-gather", ("all_gather",),
+                        pbytes, "flat", (R,))
+                continue
             if b.hierarchy == _AR.TWO_LEVEL:
                 shard = -(-b.total // R_ici)
                 padded = shard * R_ici * item
@@ -441,7 +536,8 @@ class GraphTransformer:
                  f"fused PS groups: {len(self.ps_groups)}  "
                  f"custom groups: {len(self.custom_groups)}  "
                  f"sync_schedule: {self.sync_schedule}  "
-                 f"sync_hierarchy: {self.sync_hierarchy}", ""]
+                 f"sync_hierarchy: {self.sync_hierarchy}  "
+                 f"sharded_update_buckets: {len(self.sharded_buckets)}", ""]
         for name in self.names:
             p = self.plans[name]
             extra = ""
@@ -451,6 +547,8 @@ class GraphTransformer:
                 extra += f" ps_axes={p.ps_axes}"
             if p.staleness:
                 extra += f" staleness={p.staleness}"
+            if name in self._shard_of:
+                extra += f" sharded_update(ss={self._shard_of[name][1]})"
             lines.append(f"{name}: shape={tuple(p.shape)} "
                          f"{p.placement.value}/{p.sync.value}"
                          f"{' sparse' if p.sparse else ''}{extra}")
@@ -491,6 +589,14 @@ class GraphTransformer:
             if (plan.sync == part.SyncKind.PS
                     and plan.placement == Placement.REPLICATED):
                 return self._ps_axis(plan)
+            # fused TWO_LEVEL sharded update: the scatter runs ICI first,
+            # so the flat shard's global layout is ici-major — spec the
+            # update space over (ici, *dcn) to match scatter_bucket's row
+            # assignment (a P(self.axis) spec would permute the shards)
+            if (plan.name in self._shard_of
+                    and plan.hierarchy == ar_sync._AR.TWO_LEVEL
+                    and self.hier_spec is not None):
+                return (self.hier_spec.ici,) + tuple(self.hier_spec.dcn)
             return self.axis
 
         return [part.update_space_spec(self.plans[n], axis_for(self.plans[n]))
@@ -552,7 +658,7 @@ class GraphTransformer:
     def _to_update_space(self, leaf, plan):
         if plan.placement in (Placement.SHARDED, Placement.DIVERGENT):
             return self._to_storage(leaf, plan)
-        if plan.sync == SyncKind.PS:
+        if part.flat_shard_update(plan):
             r = self._R_for(plan)
             n = leaf.size
             npad = -(-n // r) * r
@@ -879,8 +985,12 @@ class GraphTransformer:
 
                 zero_g = jax.tree.map(jnp.zeros_like, full)
                 if overlap_in_scan:
+                    # sharded-update buckets sync into per-var (ss,) flat
+                    # SHARDS inside the scan; their accumulator carries the
+                    # shard shape, never the full gradient
                     zero_synced = {
-                        n: jnp.zeros_like(leaf)
+                        n: (jnp.zeros((self._shard_of[n][1],), leaf.dtype)
+                            if n in self._shard_of else jnp.zeros_like(leaf))
                         for n, leaf in zip(self.names,
                                            self.treedef.flatten_up_to(full))
                         if n in bucket_names}
@@ -995,7 +1105,13 @@ class GraphTransformer:
                     buf, off, size).reshape(gshape)
                 off += size
 
-        # 4b. update-space params/grads per variable
+        # 4b. update-space params/grads per variable.  Sharded-update AR
+        # vars slice their flat padded 1/R param shard at the row the
+        # bucket's reduce-scatter assigned this device (ici-major under
+        # the fused TWO_LEVEL schedule).
+        shard_rows = {b_sh.key: ar_sync.shard_index(b_sh, axis,
+                                                    self.hier_spec)
+                      for b_sh in self.sharded_buckets}
         u_params, u_grads = [], []
         for name, plan, s_leaf in zip(self.names, plans, s_leaves):
             g = g_by_name[name]
@@ -1049,6 +1165,17 @@ class GraphTransformer:
                 else:
                     ug = ps_grad_shards[name]
                 u_grads.append(ug)
+            elif name in self._shard_of:
+                # ZeRO sharded update: the bucket scatter already delivered
+                # this device's (ss,) gradient shard in `synced`; pair it
+                # with the matching flat param shard
+                b_sh, ss = self._shard_of[name]
+                n = int(np.prod(plan.shape)) if plan.shape else 1
+                flatp = jnp.zeros((ss * b_sh.num_shards,),
+                                  s_leaf.dtype).at[:n].set(s_leaf.ravel())
+                u_params.append(jax.lax.dynamic_slice_in_dim(
+                    flatp, shard_rows[b_sh.key] * ss, ss))
+                u_grads.append(synced[name])
             else:  # REPLICATED + AllReduce
                 u_params.append(s_leaf)
                 u_grads.append(synced.get(name, g))  # sparse: pre-synced
@@ -1079,9 +1206,10 @@ class GraphTransformer:
                     # axis — keeps the norm comparable to single-device
                     sq_sharded = sq_sharded + s / R
                 elif (plan.placement == Placement.SHARDED
-                        or plan.sync == SyncKind.PS):
-                    # disjoint shards: full-axis psum = true sum.  A
-                    # subset-axis PS shard is replicated over the other
+                        or part.flat_shard_update(plan)):
+                    # disjoint shards (PS flat shards, sharded-update AR
+                    # shards, SHARDED storage): full-axis psum = true sum.
+                    # A subset-axis PS shard is replicated over the other
                     # data axes, so pre-divide by that multiplicity.
                     mult = R // self._R_for(plan)
                     sq_sharded = sq_sharded + (s / mult if mult > 1 else s)
@@ -1111,6 +1239,20 @@ class GraphTransformer:
         # identical across the other axes (same grads -> same update), so
         # no cross-slice gather is needed at all.
         new_by_name = dict(zip(self.names, new_u_leaves))
+
+        # 6a'. fused per-bucket all-gather of FRESH PARAMS for the ZeRO
+        # sharded-update buckets — the collective that replaces the
+        # replicated schedule's gradient all-gather (under TWO_LEVEL it
+        # retraces the scatter hops in reverse: DCN shard gather, then
+        # ICI gather).  One gather per bucket, each depending only on its
+        # own bucket's updated shards, so under schedule="overlap" the
+        # latency-hiding scheduler pipelines bucket i's gather behind
+        # bucket i+1's still-running shard update.
+        sharded_full = {}
+        for b_sh in self.sharded_buckets:
+            sharded_full.update(ar_sync.gather_bucket_params(
+                new_by_name, b_sh, axis, self.hier_spec))
+
         ps_full = {}
         for (dtype, _axes_key), names_d in ps_fused.items():
             plan0 = self.plans[names_d[0]]
@@ -1154,6 +1296,8 @@ class GraphTransformer:
                     flat = jax.lax.all_gather(nu, self._ps_axis(plan),
                                               axis=0, tiled=True)
                     new_storage.append(jnp.reshape(flat[:n], plan.shape))
+            elif name in sharded_full:  # sharded-update AR var
+                new_storage.append(sharded_full[name])
             else:
                 new_storage.append(nu)
 
@@ -1195,7 +1339,7 @@ class GraphTransformer:
             return leaf
         if plan.placement == Placement.DIVERGENT:
             return jnp.mean(leaf, axis=0)
-        if plan.sync == SyncKind.PS:
+        if part.flat_shard_update(plan):
             n = int(np.prod(plan.shape)) if plan.shape else 1
             return jnp.reshape(leaf[:n], plan.shape)
         return leaf
@@ -1215,7 +1359,7 @@ class GraphTransformer:
             return leaf
         if plan.placement == Placement.DIVERGENT:
             return jnp.broadcast_to(leaf[None], (R,) + leaf.shape)
-        if plan.sync == SyncKind.PS:
+        if part.flat_shard_update(plan):
             r = self._R_for(plan)
             n = leaf.size
             npad = -(-n // r) * r

@@ -80,6 +80,11 @@ class VarPlan:
     # Compressor enum for the TWO_LEVEL cross-slice (DCN) hop;
     # 0 = follow `compressor`
     dcn_compressor: int = 0
+    # AllReduceSynchronizer.ShardedUpdate: 0 = REPLICATED_UPDATE (reduce ->
+    # identical full optimizer update on every chip), 1 = SHARDED (ZeRO-
+    # style: reduce-scatter grads -> per-shard update on the flat padded
+    # 1/R shard, opt state permanently sharded -> all-gather fresh params)
+    sharded_update: int = 0
     # PS fields
     ps_sync: bool = True
     staleness: int = 0
@@ -192,6 +197,7 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
             plan.schedule = ar.schedule
             plan.hierarchy = ar.hierarchy
             plan.dcn_compressor = ar.dcn_compressor
+            plan.sharded_update = ar.sharded_update
         else:
             logging.debug("Variable %s node has no synchronizer; AllReduce default", v.name)
 
@@ -203,6 +209,9 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
                 logging.debug("Scalar variable %s: forcing AllReduce sync", v.name)
             plan.sync = SyncKind.ALL_REDUCE
             plan.placement = Placement.REPLICATED
+            # a 1-element flat shard padded R-way buys nothing and wastes
+            # R-1 padding slots per scalar; scalars always update replicated
+            plan.sharded_update = 0
             plans[v.name] = plan
             continue
         if axis is not None:
@@ -225,6 +234,43 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
             f"param_specs entries {sorted(unmatched)} match no trainable "
             f"variable; have {[v.name for v in model_item.var_infos]}")
     return plans
+
+
+def plan_sharded_update(plan):
+    """Engine eligibility for the ZeRO-style sharded weight update, at
+    plan level (:class:`VarPlan.sharded_update`; bucket level:
+    ``all_reduce.bucket_sharded``): dense, non-scalar, replicated
+    AllReduce plans whose EVERY wire transform is elementwise — the
+    scatter of a block-compressed wire (int8 re-blocking, PowerSGD's
+    low-rank factors) would compute a different approximation per shard,
+    so those buckets keep the replicated update (analysis Y007 warns).
+    Under TWO_LEVEL (or an unresolved AUTO) the effective DCN-hop codec
+    must decompose too."""
+    from autodist_tpu.kernel.synchronization.all_reduce import (
+        ELEMENTWISE_CODECS, _AR)
+
+    if not plan.sharded_update or plan.sync != SyncKind.ALL_REDUCE:
+        return False
+    if (plan.placement != Placement.REPLICATED or plan.sparse
+            or not plan.shape):
+        return False
+    if plan.compressor not in ELEMENTWISE_CODECS:
+        return False
+    if plan.hierarchy != _AR.FLAT:
+        if (plan.dcn_compressor or plan.compressor) not in ELEMENTWISE_CODECS:
+            return False
+    return True
+
+
+def flat_shard_update(plan):
+    """Plans whose update space is the flat padded 1/R shard (per var):
+    the PS family's weight-update sharding, and the AR family's
+    ZeRO-style ``sharded_update`` (``plan_sharded_update``)."""
+    if plan.placement != Placement.REPLICATED:
+        return False
+    if plan.sync == SyncKind.PS:
+        return True
+    return plan_sharded_update(plan)
 
 
 def storage_spec(plan, replica_axis="replica"):
@@ -252,8 +298,9 @@ def update_space_spec(plan, replica_axis="replica"):
         return storage_spec(plan, replica_axis)
     if plan.placement == Placement.DIVERGENT:
         return storage_spec(plan, replica_axis)
-    if plan.sync == SyncKind.PS:
-        # flat padded shard, sharded over the replica axis
+    if flat_shard_update(plan):
+        # flat padded shard, sharded over the replica axis (PS weight-
+        # update sharding and the AR family's ZeRO-style sharded update)
         return P(replica_axis)
     return P()
 
@@ -276,7 +323,7 @@ def update_space_shape(plan, num_replicas):
     if plan.placement in (Placement.SHARDED, Placement.DIVERGENT,
                           Placement.CUSTOM):
         return storage_shape(plan, num_replicas)
-    if plan.sync == SyncKind.PS:
+    if flat_shard_update(plan):
         import numpy as np
 
         n = int(np.prod(plan.shape)) if plan.shape else 1

@@ -40,6 +40,26 @@ Orthogonal to the issue schedule, each bucket carries a sync HIERARCHY
   native dtype at full precision (the EQuARX recipe of quantizing only
   the slow wire, arXiv 2506.17615).  With no DCN compression the result
   equals the flat reduce up to float re-association.
+
+Orthogonal to both, each bucket carries a WEIGHT-UPDATE mode
+(``AllReduceSynchronizer.ShardedUpdate``, arXiv 2004.13336):
+
+- REPLICATED_UPDATE — the reduce above returns the full mean gradient and
+  every replica applies the identical optimizer update (R-fold redundant
+  update FLOPs + full Adam state per chip).
+- SHARDED (:func:`scatter_bucket` / :func:`gather_bucket_params`) — the
+  bucket's gradients **reduce-scatter** into per-variable flat padded 1/R
+  shards (row ``r`` of the bucket's ``(R, S)`` update matrix is the r-th
+  shard of every var), the optimizer updates only the local shard (its
+  state lives permanently sharded — ~1/R of Adam's HBM), and an
+  all-gather of the FRESH PARAMS rebuilds the replicated storage,
+  replacing the gradient all-gather entirely.  Under TWO_LEVEL the ICI
+  reduce-scatter's shard feeds the DCN hop directly (rows are ici-major;
+  no gradient re-gather in between) and the param gather retraces the
+  hops in reverse: DCN shard gather -> ICI all-gather.  Only elementwise
+  wire codecs decompose into the scatter — the codec applies to the
+  GRADIENT legs only; param gathers ride the native dtype (a compressed
+  param gather would let replicas drift).
 """
 import dataclasses
 from typing import Dict, List, Optional
@@ -59,6 +79,9 @@ _AR = synchronizers_pb2.AllReduceSynchronizer
 # flat f32 residual and slices at the same offsets)
 _ELEMENTWISE_CODECS = frozenset(
     (_AR.NoneCompressor, _AR.BF16Compressor, _AR.BF16CompressorEF))
+# public alias: the partitioner's plan-level sharded-update eligibility
+# and the cost model both key off the same codec family
+ELEMENTWISE_CODECS = _ELEMENTWISE_CODECS
 # codecs that may ride the cross-slice (DCN) hop of a TWO_LEVEL bucket:
 # the elementwise family plus the int8 all_to_all/dequant-sum recipe
 # (whose two phases both stay on the DCN sub-ring).  PowerSGD's low-rank
@@ -131,19 +154,41 @@ class Bucket:
     hierarchy: int = 0
     # Compressor enum for the cross-slice hop; 0 = follow `compressor`
     dcn_compressor: int = 0
+    # AllReduceSynchronizer.ShardedUpdate; SHARDED buckets reduce-scatter
+    # into the (num_shards, shard_total) update matrix below instead of
+    # all-reducing, and all-gather fresh PARAMS after the update
+    sharded_update: int = 0
+    # ZeRO shard plan (populated only for SHARDED buckets): the replica
+    # count the update space shards over, and each var's flat shard
+    # length ceil(size / num_shards) — the per-var padding plan
+    num_shards: int = 1
+    shard_sizes: tuple = ()
 
     @property
     def total(self):
         return sum(self.sizes)
 
+    @property
+    def shard_total(self):
+        """Columns of the (num_shards, shard_total) update matrix — the
+        flat elements each device updates."""
+        return sum(self.shard_sizes)
 
-def plan_buckets(plans, var_shapes, var_dtypes) -> List[Bucket]:
+    @property
+    def padded_total(self):
+        """Elements of the full padded update matrix."""
+        return self.shard_total * self.num_shards
+
+
+def plan_buckets(plans, var_shapes, var_dtypes,
+                 num_replicas=1) -> List[Bucket]:
     """Group AR-replicated dense vars by (group, dtype, compressor,
-    hierarchy, dcn_compressor).
+    hierarchy, dcn_compressor, sharded_update).
 
     `plans`: name -> VarPlan; only vars with dense AllReduce-on-replicated
     placement participate (sparse vars sync in the lookup backward; sharded /
-    PS vars reduce-scatter instead).
+    PS vars reduce-scatter instead).  ``num_replicas`` sizes the ZeRO shard
+    plan of SHARDED-update buckets (per-var flat shards + padding).
     """
     from autodist_tpu.kernel.partitioner import Placement, SyncKind
 
@@ -154,36 +199,62 @@ def plan_buckets(plans, var_shapes, var_dtypes) -> List[Bucket]:
         if plan.sparse:
             continue
         key = (plan.group, str(var_dtypes[name]), plan.compressor,
-               plan.hierarchy, plan.dcn_compressor)
+               plan.hierarchy, plan.dcn_compressor, plan.sharded_update)
         groups.setdefault(key, []).append(name)
     buckets = []
-    for (group, dtype, comp, hier, dcn), names in sorted(
+    R = max(1, int(num_replicas))
+    for (group, dtype, comp, hier, dcn, shup), names in sorted(
             groups.items(), key=lambda kv: kv[0]):
         # the key string keeps its pre-hierarchy format for FLAT buckets so
         # compressor-state checkpoints stay addressable
         suffix = f"_h{hier}_d{dcn}" if hier == _AR.TWO_LEVEL else ""
+        if shup:
+            suffix += f"_z{shup}"
+        sizes = tuple(int(np.prod(var_shapes[n])) if var_shapes[n] else 1
+                      for n in names)
         buckets.append(Bucket(
             key=f"g{group}_{dtype}_c{comp}{suffix}",
             var_names=tuple(names),
-            sizes=tuple(int(np.prod(var_shapes[n])) if var_shapes[n] else 1 for n in names),
+            sizes=sizes,
             shapes=tuple(var_shapes[n] for n in names),
             compressor=comp,
             dtype=dtype,
             hierarchy=hier,
             dcn_compressor=dcn,
+            sharded_update=shup,
+            num_shards=R if shup else 1,
+            shard_sizes=tuple(-(-s // R) for s in sizes) if shup else (),
         ))
     return buckets
+
+
+def bucket_sharded(bucket) -> bool:
+    """True when the bucket realizes the ZeRO-style sharded weight
+    update: the knob is set, a shard plan was computed, and every wire
+    transform is elementwise — a block codec's per-shard re-encoding
+    would approximate differently from the barrier reduce, so those
+    buckets keep the replicated update (the transformer normalizes the
+    plan; the analysis hierarchy pass warns with Y007)."""
+    return (bool(bucket.sharded_update) and bool(bucket.shard_sizes)
+            and elementwise(bucket))
 
 
 def init_compressor_states(buckets):
     """Residual state per stateful bucket (flat f32), else empty tuple.
     TWO_LEVEL buckets carry the state of their DCN-hop codec (the only
     wire transform they apply) at full bucket size; each device reads and
-    writes only its own ICI-shard slice of it."""
+    writes only its own ICI-shard slice of it.  TWO_LEVEL buckets with a
+    SHARDED update carry it in the padded ``(num_shards, shard_total)``
+    row layout instead (the buffer the DCN hop actually compresses)."""
     states = {}
     for b in buckets:
         comp = get_compressor(wire_codec(b))
-        states[b.key] = comp.init_state(b.total) if comp.stateful else ()
+        if not comp.stateful:
+            states[b.key] = ()
+        elif bucket_sharded(b) and b.hierarchy == _AR.TWO_LEVEL:
+            states[b.key] = comp.init_state(b.padded_total)
+        else:
+            states[b.key] = comp.init_state(b.total)
     return states
 
 
@@ -247,6 +318,169 @@ def _two_level_reduce(buf, state, bucket, hier: HierAxes):
     return full[:n], new_state
 
 
+def _pack_rows(flat, b):
+    """Unpadded bucket-ordered flat buffer -> the ``(num_shards, S)``
+    update matrix: each var is padded to ``num_shards * ss`` separately
+    (the per-var padding plan), so row ``r`` holds the r-th flat shard of
+    every var and one collective moves the whole bucket."""
+    R = b.num_shards
+    cols, off = [], 0
+    for sz, ss in zip(b.sizes, b.shard_sizes):
+        piece = flat[off:off + sz]
+        pad = ss * R - sz
+        if pad:
+            piece = jnp.concatenate(
+                [piece, jnp.zeros((pad,), piece.dtype)])
+        cols.append(piece.reshape(R, ss))
+        off += sz
+    return jnp.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+
+
+def _unpack_shard(b, row, grads_by_name, synced):
+    """Split a device's ``(shard_total,)`` mean row back into per-var flat
+    shards (the update-space gradients)."""
+    off = 0
+    for n, ss in zip(b.var_names, b.shard_sizes):
+        synced[n] = row[off:off + ss].astype(grads_by_name[n].dtype)
+        off += ss
+
+
+def _dcn_tuple(hier: HierAxes):
+    return hier.dcn if len(hier.dcn) > 1 else hier.dcn[0]
+
+
+def _scatter_two_level(grads_by_name, b, state, hier: HierAxes):
+    """Fused two-level ZeRO scatter: the ICI reduce-scatter's shard feeds
+    the DCN hop DIRECTLY (rows of the update matrix are ici-major, so no
+    gradient re-gather sits between the hops):
+
+    1. intra-slice **reduce-scatter** over ICI (native dtype) — ici index
+       ``j`` ends up owning rows ``[j*R_dcn, (j+1)*R_dcn)``;
+    2. cross-slice **reduce-scatter** of those rows over the DCN axes,
+       through the bucket's DCN codec — dcn index ``d`` keeps row
+       ``j*R_dcn + d``, the device's final 1/R update shard.
+
+    The matching update-space PartitionSpec is ``P((ici, *dcn))`` (the
+    transformer's ``axis_for``), and :func:`gather_bucket_params`
+    retraces the hops in reverse.  EF residuals live in the padded row
+    layout; each device reads/writes only its ICI region.
+    """
+    comp = get_compressor(dcn_codec(b))
+    mat = _pack_rows(_bucket_buf(grads_by_name, b), b)       # (R, S)
+    R = b.num_shards
+    S = mat.shape[1]
+    R_ici = jax.lax.axis_size(hier.ici)
+    R_dcn = max(1, R // R_ici)
+    local = jax.lax.psum_scatter(mat, hier.ici, scatter_dimension=0,
+                                 tiled=True)                 # (R_dcn, S)
+    codec = dcn_codec(b)
+    if codec in (_AR.BF16Compressor, _AR.BF16CompressorEF):
+        src = local.reshape(-1).astype(jnp.float32)
+        if comp.stateful:
+            my = jax.lax.axis_index(hier.ici)
+            region = jax.lax.dynamic_slice_in_dim(
+                state, my * R_dcn * S, R_dcn * S)
+            corrected = src + region
+        else:
+            corrected = src
+        wire = corrected.astype(jnp.bfloat16)
+        if comp.stateful:
+            new_state = jax.lax.dynamic_update_slice(
+                state, corrected - wire.astype(jnp.float32),
+                (my * R_dcn * S,))
+        else:
+            new_state = state
+        row = jax.lax.psum_scatter(wire.reshape(R_dcn, S), _dcn_tuple(hier),
+                                   scatter_dimension=0, tiled=True)
+        row = row.reshape(-1).astype(jnp.float32) / R
+    else:                       # NoneCompressor: native dtype end to end
+        row = jax.lax.psum_scatter(local, _dcn_tuple(hier),
+                                   scatter_dimension=0, tiled=True)
+        row = row.reshape(-1) / R
+        new_state = state
+    return row, new_state
+
+
+def scatter_bucket(grads_by_name, b, state, axis_name, hier=None):
+    """ZeRO-style reduce-scatter of one SHARDED-update bucket: returns
+    ``((shard_total,) mean row, new_state)`` — the gradient shard the
+    local optimizer update consumes.  The wire codec applies to the
+    gradient leg only, exactly where the flat reduce would apply it
+    (whole-bucket for FLAT, DCN hop only for TWO_LEVEL)."""
+    if b.hierarchy == _AR.TWO_LEVEL:
+        if hier is None:
+            raise ValueError(
+                f"bucket {b.key}: TWO_LEVEL sharded update but no "
+                f"replica_dcn x replica_ici axes were supplied")
+        return _scatter_two_level(grads_by_name, b, state, hier)
+    comp = get_compressor(wire_codec(b))
+    codec = wire_codec(b)
+    buf = _bucket_buf(grads_by_name, b)
+    R = b.num_shards
+    if codec in (_AR.BF16Compressor, _AR.BF16CompressorEF):
+        src = buf.astype(jnp.float32)
+        corrected = src + state if comp.stateful else src
+        wire = corrected.astype(jnp.bfloat16)
+        new_state = (corrected - wire.astype(jnp.float32)
+                     if comp.stateful else state)
+        row = jax.lax.psum_scatter(_pack_rows(wire, b), axis_name,
+                                   scatter_dimension=0, tiled=True)
+        row = row.reshape(-1).astype(jnp.float32) / R
+    else:                       # NoneCompressor: native-dtype wire
+        row = jax.lax.psum_scatter(_pack_rows(buf, b), axis_name,
+                                   scatter_dimension=0, tiled=True)
+        row = row.reshape(-1) / R
+        new_state = state
+    return row, new_state
+
+
+def gather_bucket_params(new_by_name, b, axis_name, hier=None):
+    """All-gather the UPDATED flat param shards of one SHARDED-update
+    bucket back into full variables (``{name: full array}``) — the
+    collective that replaces the replicated schedule's gradient
+    all-gather.  Native dtype on every hop: compressing a param gather
+    would hand replicas drifting copies.  Under TWO_LEVEL the hops
+    retrace the scatter in reverse (DCN shard gather, then ICI gather of
+    the slice rows)."""
+    flats = [jnp.ravel(new_by_name[n]) for n in b.var_names]
+    row = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+    if b.hierarchy == _AR.TWO_LEVEL:
+        if hier is None:
+            raise ValueError(
+                f"bucket {b.key}: TWO_LEVEL sharded update but no "
+                f"replica_dcn x replica_ici axes were supplied")
+        block = jax.lax.all_gather(row, _dcn_tuple(hier), axis=0,
+                                   tiled=True)               # (R_dcn*S,)
+        full = jax.lax.all_gather(block, hier.ici, axis=0, tiled=True)
+    else:
+        full = jax.lax.all_gather(row, axis_name, axis=0, tiled=True)
+    mat = full.reshape(b.num_shards, -1)
+    out, off = {}, 0
+    for n, sz, ss, shp in zip(b.var_names, b.sizes, b.shard_sizes,
+                              b.shapes):
+        cols = jax.lax.dynamic_slice_in_dim(mat, off, ss, axis=1)
+        out[n] = jnp.reshape(cols.reshape(-1)[:sz], shp)
+        off += ss
+    return out
+
+
+def shard_index(b, axis_name, hier=None):
+    """Row of the bucket's ``(num_shards, S)`` update matrix this device
+    owns — must mirror :func:`scatter_bucket`'s scatter order (under
+    TWO_LEVEL the ICI scatter runs first, so rows are ici-major)."""
+    from autodist_tpu.parallel.collectives import axis_index
+
+    if b.hierarchy == _AR.TWO_LEVEL:
+        if hier is None:
+            raise ValueError(
+                f"bucket {b.key}: TWO_LEVEL sharded update but no "
+                f"replica_dcn x replica_ici axes were supplied")
+        R_dcn = max(1, b.num_shards // jax.lax.axis_size(hier.ici))
+        return (jax.lax.axis_index(hier.ici) * R_dcn
+                + axis_index(_dcn_tuple(hier)))
+    return axis_index(axis_name)
+
+
 def _bucket_reduce(buf, state, bucket, axis_name, hier: Optional[HierAxes]):
     """Reduce one flat buffer by the bucket's hierarchy: two-level on a
     factored mesh, else the flat codec collective."""
@@ -262,10 +496,17 @@ def _bucket_reduce(buf, state, bucket, axis_name, hier: Optional[HierAxes]):
 def sync_bucketed(grads_by_name, buckets, comp_states, axis_name, hier=None):
     """AllReduce all buckets; returns (synced grads dict, new comp states).
     ``hier`` (a :class:`HierAxes`) realizes TWO_LEVEL buckets via the
-    hierarchical decomposition; FLAT buckets ignore it."""
+    hierarchical decomposition; FLAT buckets ignore it.  SHARDED-update
+    buckets reduce-SCATTER instead: their entries in the returned dict
+    are the per-var ``(ss,)`` update-space shards, not full gradients."""
     synced = {}
     new_states = dict(comp_states)
     for b in buckets:
+        if bucket_sharded(b):
+            row, new_states[b.key] = scatter_bucket(
+                grads_by_name, b, comp_states[b.key], axis_name, hier)
+            _unpack_shard(b, row, grads_by_name, synced)
+            continue
         buf = _bucket_buf(grads_by_name, b)
         reduced, new_states[b.key] = _bucket_reduce(
             buf, comp_states[b.key], b, axis_name, hier)
@@ -320,6 +561,16 @@ def sync_overlapped(grads_by_name, buckets, comp_states, axis_name,
     synced = {}
     new_states = dict(comp_states)
     for b in reversed(buckets):
+        if bucket_sharded(b):
+            # ZeRO scatter: one reduce-scatter per bucket (the bucket IS
+            # the pipelining granularity — a chunked scatter would break
+            # the per-var shard layout the optimizer and the checkpoint
+            # canonicalization address), still issued in reverse
+            # topological order so it hoists behind backward compute
+            row, new_states[b.key] = scatter_bucket(
+                grads_by_name, b, comp_states[b.key], axis_name, hier)
+            _unpack_shard(b, row, grads_by_name, synced)
+            continue
         comp = get_compressor(wire_codec(b))
         buf = _bucket_buf(grads_by_name, b)
         nbytes = b.total * np.dtype(b.dtype).itemsize

@@ -30,6 +30,16 @@ Model (per step, seconds):
                1/R_ici shard (scaled by the DCN-hop codec's wire factor)
                across slices at DCN bandwidth — replacing the flat
                min(ici, dcn) ring that ships the whole gradient over DCN.
+  sharded    ~ AR vars under ``ShardedUpdate.SHARDED`` (ZeRO-style) swap
+   update      the allreduce ring's two phases for a gradient
+               reduce-scatter (codec-scaled) + a FRESH-PARAM all-gather
+               (native dtype): same wire volume at NoneCompressor, less
+               under a gradient codec (the codec never applies to the
+               param leg), and the ``update`` term drops to 1/R — the
+               optimizer touches only the local shard, with opt state
+               permanently sharded (the HBM counterpart lives in
+               :func:`hbm_footprint`).  Under TWO_LEVEL the DCN hop pays
+               scatter+gather one-way instead of the shard ring.
   overlap    ~ strategies with ``schedule="overlap"`` price comm and
                compute as max(comm, compute) + exposed-tail instead of
                the serialized hi + 0.7*lo: the per-bucket collectives
@@ -42,7 +52,9 @@ Model (per step, seconds):
 import dataclasses
 import json
 
-from autodist_tpu.kernel.partitioner import Placement, SyncKind, build_var_plans
+from autodist_tpu.kernel.partitioner import (Placement, SyncKind,
+                                             build_var_plans,
+                                             plan_sharded_update)
 
 # v5e-class defaults; override per ResourceSpec bandwidths when present.
 DEFAULT_PEAK_FLOPS = 394e12        # bf16 FLOPs/s per chip (v5e ~394 TFLOPs)
@@ -274,6 +286,13 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
     R_dcn, R_ici = _hier_factors(strategy, resource_spec, R)
     mesh_factored = R_dcn > 1
     hier_ici_bytes = hier_dcn_bytes = 0.0
+    # the one-way (scatter/gather) share of the DCN hop — sharded-update
+    # buckets' grad scatter + param gather, priced at (n-1)/n instead of
+    # the replicated shard ring's 2(n-1)/n
+    hier_dcn_oneway_bytes = 0.0
+    # ZeRO sharded-update flat wire: grad reduce-scatter (codec-scaled)
+    # and fresh-param all-gather, each a single (n-1)/n phase
+    shard_scatter_bytes = shard_gather_bytes = 0.0
 
     ar_bytes = ps_bytes = gather_bytes = sparse_bytes = 0
     update_bytes = 0.0
@@ -297,8 +316,13 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         # earn the 1/R term — an async strategy (even a partitioned one)
         # must not inherit the HBM-bound discount in rankings (ADVICE r5)
         async_ps = plan.sync == SyncKind.PS and not plan.ps_sync
+        # AR plans under ShardedUpdate.SHARDED join the 1/R update club —
+        # the plan-level eligibility mirror of the engine's normalization
+        # (block-codec buckets fall back to the replicated update)
+        ar_sharded = plan_sharded_update(plan)
         sharded_update = not async_ps and (
             plan.placement == Placement.SHARDED
+            or ar_sharded
             or (plan.sync == SyncKind.PS
                 and plan.placement != Placement.DIVERGENT))
         update_bytes += nbytes / R if sharded_update else nbytes
@@ -339,7 +363,7 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                 ar_overlap = True
             ar_bucket_keys.add((plan.group, str(plan.dtype),
                                 plan.compressor, plan.hierarchy,
-                                plan.dcn_compressor))
+                                plan.dcn_compressor, plan.sharded_update))
             # wire factors keyed on the proto enum (not raw ints) so a
             # reordering in synchronizers.proto cannot skew rankings;
             # PowerSGD's factor depends on the bucket geometry
@@ -357,13 +381,29 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                 dcn_factor = wire_byte_factor(
                     plan.dcn_compressor or plan.compressor, max(1, v.size))
                 hier_ici_bytes += 2.0 * nbytes    # scatter + gather phases
-                hier_dcn_bytes += nbytes * dcn_factor / R_ici
+                if ar_sharded:
+                    # ZeRO x two-level: the DCN hop pays the grad-shard
+                    # scatter (codec-scaled) + the param-shard gather
+                    # (native), each one-way, instead of the shard ring
+                    oneway = nbytes * (dcn_factor + 1.0) / R_ici
+                    hier_dcn_bytes += oneway
+                    hier_dcn_oneway_bytes += oneway
+                else:
+                    hier_dcn_bytes += nbytes * dcn_factor / R_ici
+            elif ar_sharded:
+                shard_scatter_bytes += nbytes * comp_factor
+                shard_gather_bytes += nbytes
             else:
                 ar_bytes += nbytes * comp_factor
 
     comm_s = (_ring_time(ar_bytes, R, bw)
               + _gather_time(ps_bytes, R, bw)      # reduce-scatter of grads
               + _gather_time(gather_bytes, R, bw)  # all-gather of params
+              # ZeRO sharded update (flat): grad scatter + param gather,
+              # one (n-1)/n phase each — the scatter+gather vs allreduce
+              # wire delta the sharded mode trades on
+              + _gather_time(shard_scatter_bytes, R, bw)
+              + _gather_time(shard_gather_bytes, R, bw)
               + sparse_bytes / bw)
     subset_s = 0.0
     if subset_ps_bytes:
@@ -382,7 +422,11 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         ici_bw = ici_gbps * 1e9 / 8
         dcn_bw = dcn_gbps * 1e9 / 8
         hier_ici_s = _gather_time(hier_ici_bytes, R_ici, ici_bw)
-        hier_dcn_s = _ring_time(hier_dcn_bytes, R_dcn, dcn_bw)
+        # the sharded-update share of the DCN hop moves one-way (grad
+        # scatter + param gather); only the replicated share pays a ring
+        hier_dcn_s = (_ring_time(hier_dcn_bytes - hier_dcn_oneway_bytes,
+                                 R_dcn, dcn_bw)
+                      + _gather_time(hier_dcn_oneway_bytes, R_dcn, dcn_bw))
         comm_s += hier_ici_s + hier_dcn_s
     update_s = opt_bytes_factor * update_bytes / (hbm_gbps * 1e9)
     # overlap schedule (arXiv 2004.13336-style pipelining under the
@@ -390,7 +434,10 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
     # remaining backward FLOPs — total becomes max(comm, compute) — except
     # the topologically LAST bucket, whose reduce has no backward left to
     # hide behind; one bucket's share of the AR time stays exposed
-    ar_ring_s = _ring_time(ar_bytes, R, bw) + hier_ici_s + hier_dcn_s
+    shard_scatter_s = _gather_time(shard_scatter_bytes, R, bw)
+    shard_gather_s = _gather_time(shard_gather_bytes, R, bw)
+    ar_ring_s = (_ring_time(ar_bytes, R, bw) + hier_ici_s + hier_dcn_s
+                 + shard_scatter_s + shard_gather_s)
     exposed_s = ar_ring_s / max(1, len(ar_bucket_keys))
     return CostEstimate(compute_s + update_s, comm_s, {
         "ar_bytes": ar_bytes, "ps_bytes": ps_bytes,
@@ -400,6 +447,10 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         "hier_ici_s": hier_ici_s, "hier_dcn_s": hier_dcn_s,
         "hier_replica_dcn": R_dcn if hier_ici_bytes else 1,
         "hier_replica_ici": R_ici if hier_ici_bytes else R,
+        "sharded_scatter_bytes": shard_scatter_bytes,
+        "sharded_gather_bytes": shard_gather_bytes,
+        "sharded_scatter_s": shard_scatter_s,
+        "sharded_gather_s": shard_gather_s,
         "update_bytes": update_bytes, "update_s": update_s,
         "ar_buckets": len(ar_bucket_keys), "overlap_exposed_s": exposed_s,
         "num_replicas": R},
@@ -415,7 +466,9 @@ def predicted_comm_bytes(est: "CostEstimate") -> dict:
     without each consumer re-mapping the breakdown keys."""
     b = est.breakdown
     return {
-        "flat": float(b.get("ar_bytes", 0.0)),
+        "flat": float(b.get("ar_bytes", 0.0)
+                      + b.get("sharded_scatter_bytes", 0.0)
+                      + b.get("sharded_gather_bytes", 0.0)),
         "ici_hop": float(b.get("hier_ici_bytes", 0.0)),
         "dcn_hop": float(b.get("hier_dcn_bytes", 0.0)),
         "ps": float(b.get("ps_bytes", 0.0) + b.get("gather_bytes", 0.0)
@@ -504,6 +557,16 @@ def hbm_footprint(strategy, model_item, num_replicas, *,
             param_bytes += nbytes    # gathered copy lives on every chip
             grad_bytes += nbytes
             u_frac[v.name] = 1.0 / R
+        elif plan_sharded_update(plan):
+            # ZeRO sharded weight update: the gathered param copy still
+            # lives on every chip, but the optimizer's update space — and
+            # with it Adam's moments — shards 1/R (the ~2/3 Adam HBM cut
+            # the mode exists for).  Async PS never qualifies: plan_
+            # sharded_update is AR-only, so the PR 1 "no 1/R discount for
+            # async" fix cannot regress through this branch.
+            param_bytes += nbytes
+            grad_bytes += nbytes
+            u_frac[v.name] = 1.0 / R
         else:                        # replicated AR / async PS
             param_bytes += nbytes
             grad_bytes += nbytes
@@ -563,6 +626,30 @@ def hbm_footprint(strategy, model_item, num_replicas, *,
             "num_replicas": R}
 
 
+def builder_label(b):
+    """Variant-qualified display name of a strategy builder, so rankings
+    and rejection lists can tell ``AllReduce`` from
+    ``AllReduce:overlap:sharded`` (the AR family enumerates several
+    knob combinations under one class name)."""
+    name = type(b).__name__
+    tags = []
+    comp = getattr(b, "compressor", "NoneCompressor")
+    if comp and comp != "NoneCompressor":
+        tags.append(str(comp))
+    if getattr(b, "schedule", "barrier") == "overlap":
+        tags.append("overlap")
+    if str(getattr(b, "hierarchy", "auto")).lower() in ("two_level",
+                                                        "hierarchical",
+                                                        "2level"):
+        tags.append("two_level")
+    if getattr(b, "dcn_compressor", None):
+        tags.append(f"dcn={b.dcn_compressor}")
+    shup = getattr(b, "sharded_update", "replicated")
+    if shup not in ("replicated", 0, None, False):
+        tags.append("sharded")
+    return name + (":" + ":".join(tags) if tags else "")
+
+
 def rank_strategies(builders, model_item, resource_spec, calibration=None, **kw):
     """Rank candidate builders by estimated step time (cheapest first);
     with ``calibration`` (from :func:`calibrate`) the measured-corrected
@@ -573,7 +660,7 @@ def rank_strategies(builders, model_item, resource_spec, calibration=None, **kw)
         est = estimate(s, model_item, resource_spec, **kw)
         total = (est.calibrated_total(calibration) if calibration
                  else est.total_s)
-        scored.append((total, type(b).__name__, b, est, s))
+        scored.append((total, builder_label(b), b, est, s))
     scored.sort(key=lambda t: t[0])
     return scored
 

@@ -11,8 +11,9 @@ handled by the sparse all-gather synchronizer, matching in capability.
 """
 from autodist_tpu.proto import synchronizers_pb2
 from autodist_tpu.strategy.base import (Strategy, StrategyBuilder,
-                                        resolve_compressor,
-                                        resolve_hierarchy, resolve_schedule)
+                                        resolve_compressor, resolve_hierarchy,
+                                        resolve_schedule,
+                                        resolve_sharded_update)
 
 _SPECS = {
     "AUTO": synchronizers_pb2.AllReduceSynchronizer.AUTO,
@@ -27,7 +28,8 @@ _SPECS = {
 class AllReduce(StrategyBuilder):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO",
                  compressor="NoneCompressor", schedule="barrier",
-                 hierarchy="auto", dcn_compressor=None):
+                 hierarchy="auto", dcn_compressor=None,
+                 sharded_update="replicated"):
         """``schedule="overlap"`` emits per-bucket collectives in reverse
         layer-topological order and compiles with XLA's latency-hiding
         scheduler so each bucket's reduce hoists behind remaining backward
@@ -46,6 +48,19 @@ class AllReduce(StrategyBuilder):
         hop only (elementwise family or int8; ICI phases always stay full
         precision) — default: the strategy's own ``compressor``
         (docs/performance.md "Hierarchical sync").
+
+        ``sharded_update="sharded"`` selects the ZeRO-style cross-replica
+        sharded weight update (arXiv 2004.13336): per bucket, gradients
+        reduce-scatter instead of all-reduce, the optimizer updates only
+        the local 1/R shard (optimizer state lives permanently sharded —
+        ~1/R of Adam's HBM per chip), and an all-gather of the FRESH
+        PARAMS replaces the gradient all-gather.  Composes with
+        ``hierarchy="two_level"`` (the ICI reduce-scatter's shard feeds
+        the update directly; no gradient re-gather in between) and with
+        ``schedule="overlap"``.  Only elementwise wire codecs
+        (none/bf16/bf16-EF) decompose into the scatter; block-codec
+        buckets keep the replicated update (docs/performance.md "Sharded
+        weight update").
         """
         if chunk_size < 1:
             raise ValueError("The chunk_size must be greater than zero")
@@ -59,6 +74,8 @@ class AllReduce(StrategyBuilder):
         if dcn_compressor is not None:
             resolve_compressor(dcn_compressor)
         self.dcn_compressor = dcn_compressor
+        resolve_sharded_update(sharded_update)
+        self.sharded_update = sharded_update
 
     def _fill_node(self, n, v, group):
         n.var_name = v.name
@@ -72,6 +89,7 @@ class AllReduce(StrategyBuilder):
         ar.hierarchy = resolve_hierarchy(self.hierarchy)
         if self.dcn_compressor is not None:
             ar.dcn_compressor = resolve_compressor(self.dcn_compressor)
+        ar.sharded_update = resolve_sharded_update(self.sharded_update)
 
     def make_graph_config(self, strategy, resource_spec):
         """Replicas + mesh, factored into ``replica_dcn x replica_ici``

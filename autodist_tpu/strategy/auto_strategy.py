@@ -21,6 +21,12 @@ def default_candidates(resource_spec=None):
         AllReduce(),
         AllReduce(compressor="BF16Compressor"),
         AllReduce(schedule="overlap"),
+        # ZeRO-style sharded weight update: same wire volume as the ring,
+        # 1/R optimizer work + opt state — wins whenever the step is
+        # update/HBM-bound (and survives H001 screening on budgets the
+        # replicated-update AR family overflows)
+        AllReduce(sharded_update="sharded"),
+        AllReduce(schedule="overlap", sharded_update="sharded"),
         PS(),
         PSLoadBalancing(),
         PartitionedPS(),
@@ -34,12 +40,17 @@ def default_candidates(resource_spec=None):
         # multi-node: the DCN hop bottlenecks every flat collective, so
         # enumerate the two-level hierarchy (ICI reduce-scatter -> DCN
         # shard ring -> ICI all-gather), with and without DCN-hop wire
-        # compression, under both issue schedules
+        # compression, under both issue schedules — and the fused
+        # two-level sharded update (the ICI scatter's shard feeds the
+        # optimizer directly; fresh params gather back through both hops)
         cands += [
             AllReduce(hierarchy="two_level"),
             AllReduce(hierarchy="two_level",
                       dcn_compressor="BF16Compressor"),
             AllReduce(hierarchy="two_level", schedule="overlap"),
+            AllReduce(hierarchy="two_level", sharded_update="sharded"),
+            AllReduce(hierarchy="two_level", schedule="overlap",
+                      sharded_update="sharded"),
             Parallax(hierarchy="two_level"),
         ]
     return cands
@@ -100,6 +111,7 @@ class AutoStrategy(StrategyBuilder):
     def _screen(self, cands, model_item, resource_spec):
         """Verifier feasibility gate: (feasible builders, rejected list)."""
         from autodist_tpu.analysis import STATIC_PASSES, verify_strategy
+        from autodist_tpu.simulator.cost_model import builder_label
 
         feasible, rejected = [], []
         for b in cands:
@@ -111,10 +123,10 @@ class AutoStrategy(StrategyBuilder):
             if report.ok:
                 feasible.append(b)
             else:
-                rejected.append((type(b).__name__, report))
+                rejected.append((builder_label(b), report))
                 logging.warning(
                     "AutoStrategy: rejecting infeasible candidate %s: %s",
-                    type(b).__name__,
+                    builder_label(b),
                     "; ".join(f.message for f in report.errors))
         return feasible, rejected
 

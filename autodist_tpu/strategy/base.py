@@ -205,6 +205,38 @@ def resolve_hierarchy(name_or_value):
             f"{_enum_choices(_HIERARCHY_ALIASES)}") from None
 
 
+_SHARDED_UPDATE_ALIASES = {
+    "replicated": synchronizers_pb2.AllReduceSynchronizer.REPLICATED_UPDATE,
+    "sharded": synchronizers_pb2.AllReduceSynchronizer.SHARDED,
+    # spelling aliases (the paper family the mode implements)
+    "zero": synchronizers_pb2.AllReduceSynchronizer.SHARDED,
+    "sharded_update": synchronizers_pb2.AllReduceSynchronizer.SHARDED,
+}
+
+
+def resolve_sharded_update(name_or_value):
+    """Map a user-facing ``sharded_update="replicated"|"sharded"`` knob (or
+    the raw proto enum) to ``AllReduceSynchronizer.ShardedUpdate``; unknown
+    inputs raise with the full accepted name/value table."""
+    if isinstance(name_or_value, bool):
+        return (synchronizers_pb2.AllReduceSynchronizer.SHARDED
+                if name_or_value
+                else synchronizers_pb2.AllReduceSynchronizer.REPLICATED_UPDATE)
+    if isinstance(name_or_value, int):
+        if name_or_value in set(_SHARDED_UPDATE_ALIASES.values()):
+            return name_or_value
+        raise ValueError(
+            f"Unknown sharded_update enum value {name_or_value}; accepted "
+            f"names/values: {_enum_choices(_SHARDED_UPDATE_ALIASES)}")
+    try:
+        return _SHARDED_UPDATE_ALIASES[str(name_or_value).lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown sharded_update {name_or_value!r}; accepted "
+            f"names/values: "
+            f"{_enum_choices(_SHARDED_UPDATE_ALIASES)}") from None
+
+
 class StrategyCompiler:
     """Resolve + prune a strategy against the concrete cluster.
 

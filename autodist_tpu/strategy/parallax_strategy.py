@@ -14,10 +14,11 @@ class Parallax(AllReduce):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO", compressor="NoneCompressor",
                  local_proxy_variable=False, sync=True, staleness=0,
                  ps_axes=None, schedule="barrier", hierarchy="auto",
-                 dcn_compressor=None):
+                 dcn_compressor=None, sharded_update="replicated"):
         super().__init__(chunk_size, all_reduce_spec, compressor,
                          schedule=schedule, hierarchy=hierarchy,
-                         dcn_compressor=dcn_compressor)
+                         dcn_compressor=dcn_compressor,
+                         sharded_update=sharded_update)
         self._local_replication = local_proxy_variable
         self._sync = sync
         self._staleness = staleness

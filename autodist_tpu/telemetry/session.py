@@ -103,6 +103,23 @@ class SessionTelemetry:
                                     hier["ici_hop_bytes"])
                 self.registry.gauge("sync.dcn_hop_bytes",
                                     hier["dcn_hop_bytes"])
+        # ZeRO sharded weight update: whether the session runs it, plus
+        # the per-chip shard volume and the fresh-param gather bytes that
+        # replaced the gradient all-gather (docs/performance.md "Sharded
+        # weight update")
+        try:
+            shup = self._t.sharded_update_summary()
+        except Exception:
+            shup = None
+        if shup is not None:
+            meta["sharded_update"] = shup
+            self.registry.gauge("sync.sharded_update",
+                                1.0 if shup["enabled"] else 0.0)
+            if shup["enabled"]:
+                self.registry.gauge("sync.shard_bytes",
+                                    shup["shard_bytes"])
+                self.registry.gauge("sync.param_gather_bytes",
+                                    shup["param_gather_bytes"])
         est = self._predicted_estimate()
         if est is not None:
             meta["cost_estimate"] = est

@@ -33,7 +33,11 @@ measurement is also written to ``BENCH_MEASURED.json`` (keyed by metric,
 with git SHA + timestamp) for committing; when the probe fails, the last
 committed record is attached to the error JSON as ``last_measured`` —
 clearly labeled, never as ``value`` — so a wedged relay cannot erase the
-round's hardware evidence.
+round's hardware evidence.  A probe failure ALSO runs the CPU-mesh proxy
+(``_cpu_proxy``: engine SPMD step vs raw jitted step on a virtual CPU
+mesh, including the ZeRO sharded-update variant) and attaches it as
+``cpu_proxy`` — the engine-overhead trajectory stays observable between
+on-chip windows (r01-r05 all missed the relay with nothing to show).
 
 Timing methodology (``autodist_tpu/utils/timing.py``): K dependent steps
 then ONE host scalar fetch, differenced against 2K steps so the constant
@@ -95,6 +99,10 @@ MODELS = {
     },
 }
 MFU_PASS_BAR = 0.35
+# CPU-mesh proxy metric (relay-down observability): engine SPMD step vs a
+# raw jitted step over the same math — tracks the ENGINE's overhead
+# trajectory between on-chip windows (r01-r05 all missed the TPU relay)
+CPU_PROXY_METRIC = "cpu_mesh_engine_overhead"
 # narrow OOM markers only — a bare "Allocator" matches generic XLA error
 # text and would silently halve the headline batch (ADVICE r2)
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
@@ -442,6 +450,104 @@ def _bench():
     return rec
 
 
+# ---------------------------------------------------------- cpu proxy --
+
+def _cpu_proxy(steps=8):
+    """CPU-mesh engine-overhead proxy: the engine's full SPMD step (an
+    AllReduce session over a virtual CPU mesh — shard_map, bucketed
+    collectives, the whole transform) timed against a raw single-jit
+    train step on the same model/batch/optimizer.  No TPU involved, so
+    the ratio says nothing about chip throughput — it tracks the
+    ENGINE's dispatch/transform overhead across rounds while the relay
+    is down, which is exactly the trajectory r01-r05 lost.  Also times
+    the ZeRO sharded-update variant so the new sync path's overhead is
+    observable from the same record."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ.setdefault("AUTODIST_IS_TESTING", "True")  # two sessions
+    _force_requested_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.utils.timing import fetch_scalar, measure_per_step
+
+    n = jax.device_count()
+    r = np.random.RandomState(0)
+    D = 256
+    B = 8 * n
+    params = {"w1": jnp.asarray(r.randn(D, D) * 0.05, jnp.float32),
+              "b1": jnp.zeros((D,), jnp.float32),
+              "w2": jnp.asarray(r.randn(D, D) * 0.05, jnp.float32)}
+    batch = {"x": r.randn(B, D).astype(np.float32),
+             "y": r.randn(B, D).astype(np.float32)}
+
+    def loss(p, b):
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - b["y"]) ** 2)
+
+    opt = optax.adam(1e-3)
+
+    def engine_ms(**kw):
+        ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n),
+                      strategy_builder=AllReduce(**kw))
+        sess = ad.distribute(loss, params, opt)
+        g = sess._shard_batch(batch)
+        fetch_scalar(sess.run(g)["loss"])  # compile + warm
+
+        def run(k):
+            m = None
+            for _ in range(k):
+                m = sess.run(g)
+            return m["loss"]
+
+        dt, _ = measure_per_step(run, k=steps, repeats=1)
+        return dt * 1e3
+
+    # raw baseline: the same math, one jit, no engine in the loop
+    state = [params, opt.init(params)]
+
+    @jax.jit
+    def raw_step(p, s, b):
+        loss_v, grads = jax.value_and_grad(loss)(p, b)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss_v
+
+    _, _, loss_v = raw_step(state[0], state[1], batch)
+    fetch_scalar(loss_v)                   # compile + warm
+
+    def run_raw(k):
+        loss_v = None
+        for _ in range(k):
+            state[0], state[1], loss_v = raw_step(state[0], state[1], batch)
+        return loss_v
+
+    raw_dt, _ = measure_per_step(run_raw, k=steps, repeats=1)
+    raw_ms = raw_dt * 1e3
+    eng_ms = engine_ms()
+    shard_ms = engine_ms(sharded_update="sharded")
+    return {
+        "metric": CPU_PROXY_METRIC,
+        "value": round(eng_ms / max(raw_ms, 1e-9), 3),
+        "unit": "engine_step / raw_jit_step (cpu mesh)",
+        "backend": "cpu",
+        "n_devices": n,
+        "raw_step_ms": round(raw_ms, 3),
+        "engine_step_ms": round(eng_ms, 3),
+        "engine_sharded_update_step_ms": round(shard_ms, 3),
+        "sharded_update_ratio": round(shard_ms / max(raw_ms, 1e-9), 3),
+        "note": ("CPU-mesh pipeline proxy — engine dispatch/transform "
+                 "overhead only, never a hardware throughput claim"),
+    }
+
+
 # --------------------------------------------------------------- parent --
 
 def _run_child(env_extra, timeout_s):
@@ -457,6 +563,8 @@ def _run_child(env_extra, timeout_s):
     # override BENCH_MODEL per-child (gpt_small secondary)
     child_model = env.get("BENCH_MODEL", "resnet50")
     metric = MODELS.get(child_model, MODELS["resnet50"])["metric"]
+    if "_BENCH_CPU_PROXY" in env_extra:
+        metric = CPU_PROXY_METRIC
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,
@@ -491,6 +599,15 @@ def main():
         return
     if os.environ.get("_BENCH_PROBE"):
         _probe()
+        return
+    if os.environ.get("_BENCH_CPU_PROXY"):
+        try:
+            print(json.dumps(_cpu_proxy()), flush=True)
+        except BaseException:
+            import traceback
+
+            traceback.print_exc()
+            sys.exit(1)
         return
     if os.environ.get("_BENCH_CHILD"):
         try:
@@ -547,10 +664,21 @@ def main():
             break
         time.sleep(next_sleep)
     if probe is None:
-        _emit(_error_rec("backend_probe_failed",
+        rec = _error_rec("backend_probe_failed",
                          f"{len(attempts)} probe attempts spanning "
                          f"{round(time.monotonic() - t_start)}s of {budget}s "
-                         f"budget: {json.dumps(attempts)}"))
+                         f"budget: {json.dumps(attempts)}")
+        # relay down: run the CPU-mesh proxy so THIS round still records
+        # an engine-overhead number (the perf trajectory r01-r05 lost) —
+        # clearly a pipeline artifact, never merged into hardware claims
+        remaining = budget - (time.monotonic() - t_start) - 30
+        if remaining > 45:
+            prox, _info, _out = _run_child({"_BENCH_CPU_PROXY": "1",
+                                            "JAX_PLATFORMS": "cpu"},
+                                           int(min(180, remaining)))
+            if prox is not None:
+                rec["cpu_proxy"] = prox
+        _emit(rec)
         return
     probe["n_probe_attempts"] = len(attempts) + 1
 

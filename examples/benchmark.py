@@ -229,9 +229,10 @@ def _real_pipeline(args, cap, B, sess):
 
 def _make_builder(args, strategy_name):
     """``Name`` or ``Name:variant[:variant]`` — AllReduce-family variants:
-    ``overlap``/``barrier`` (sync schedule) and ``two_level``/``flat``
-    (sync hierarchy), e.g. ``AllReduce:two_level`` or
-    ``AllReduce:overlap:two_level``; ``--ar_chunk_size`` sets the
+    ``overlap``/``barrier`` (sync schedule), ``two_level``/``flat``
+    (sync hierarchy) and ``sharded_update`` (ZeRO-style sharded weight
+    update), e.g. ``AllReduce:two_level`` or
+    ``AllReduce:overlap:sharded_update``; ``--ar_chunk_size`` sets the
     family's bucket-group granularity so the overlap term has buckets to
     pipeline."""
     from autodist_tpu import strategy as S
@@ -244,10 +245,12 @@ def _make_builder(args, strategy_name):
             kwargs["schedule"] = variant
         elif variant in ("two_level", "flat"):
             kwargs["hierarchy"] = variant
+        elif variant in ("sharded_update", "sharded"):
+            kwargs["sharded_update"] = "sharded"
         else:
             raise SystemExit(f"unknown strategy variant {variant!r} in "
                              f"{strategy_name!r} (overlap | barrier | "
-                             f"two_level | flat)")
+                             f"two_level | flat | sharded_update)")
     if args.ar_chunk_size and issubclass(builder_cls, S.AllReduce):
         kwargs["chunk_size"] = args.ar_chunk_size
     return builder_cls(**kwargs)
