@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check chaos check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -80,10 +80,18 @@ audit:
 telemetry-check:
 	$(PY) tools/telemetry_check.py
 
+# fault-injection gate (docs/elasticity.md): CPU-mesh chaos drills —
+# kill-one-worker (drain -> manifest checkpoint -> AutoStrategy re-plan on
+# the shrunk topology -> R->R' reshard incl. sharded opt state -> Y/X
+# verify gate -> loss-continuous resume), SIGTERM preempt + bitwise
+# same-topology resume, and straggler-delay injection
+chaos:
+	$(PY) tools/chaos_check.py
+
 # the pre-merge gate: lint + strategy verification + HLO audit + live
-# telemetry (tests/test_analysis.py + test_telemetry.py run the same
-# chains, so tier-1 exercises it)
-check: lint verify audit telemetry-check
+# telemetry + chaos drills (tests/test_analysis.py + test_telemetry.py +
+# test_elastic.py run the same chains, so tier-1 exercises it)
+check: lint verify audit telemetry-check chaos
 
 clean:
 	$(MAKE) -C native clean

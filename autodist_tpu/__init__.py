@@ -16,6 +16,7 @@ _LAZY = {
     "AutoDist": ("autodist_tpu.autodist", "AutoDist"),
     "ModelItem": ("autodist_tpu.model_item", "ModelItem"),
     "DistributedSession": ("autodist_tpu.runner", "DistributedSession"),
+    "ElasticTrainer": ("autodist_tpu.elastic", "ElasticTrainer"),
     "embedding_lookup": ("autodist_tpu.ops.sparse", "embedding_lookup"),
 }
 

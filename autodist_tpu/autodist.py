@@ -80,6 +80,19 @@ class AutoDist:
             self._mesh = build_mesh(self._resource_spec)
         return self._mesh
 
+    def rebind(self, resource_spec):
+        """Elastic re-plan entry (docs/elasticity.md): swap in the
+        SURVIVING topology's spec (usually ``old_spec.shrink(...)``) and
+        drop the cached mesh, so the next :meth:`distribute` plans —
+        AutoStrategy re-enumerates, builders re-factor the mesh — against
+        what is actually alive.  Sessions built before the rebind keep
+        their old mesh; the elastic driver rebuilds the session and
+        reshards the checkpoint onto it
+        (:func:`autodist_tpu.checkpoint.reshard.reshard_restore`)."""
+        self._resource_spec = resource_spec
+        self._mesh = None
+        return self
+
     def _mesh_for(self, strategy):
         """The session mesh for a compiled strategy.  Normally the spec's
         mesh (``build_mesh``); when the strategy's ``graph_config.mesh``

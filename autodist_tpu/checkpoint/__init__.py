@@ -1,0 +1,20 @@
+"""Checkpointing: canonical + manifest/update-space savers and the
+topology-resharding restore path (docs/elasticity.md)."""
+
+
+def __getattr__(name):
+    # lazy: importing the package must not pull in jax/orbax
+    if name in ("Saver", "SavedModelBuilder", "load_serving"):
+        from autodist_tpu.checkpoint import saver
+
+        return getattr(saver, name)
+    if name == "reshard_restore":
+        from autodist_tpu.checkpoint.reshard import reshard_restore
+
+        return reshard_restore
+    if name in ("load_manifest", "build_manifest", "geometry_matches"):
+        from autodist_tpu.checkpoint import manifest
+
+        return getattr(manifest, name)
+    raise AttributeError(
+        f"module 'autodist_tpu.checkpoint' has no attribute {name!r}")

@@ -1,0 +1,238 @@
+"""Topology-resharding restore: an R-way checkpoint onto an R'-way mesh.
+
+The elastic-training primitive (ROADMAP item 4; "Memory-efficient array
+redistribution through portable collective communication", arxiv
+2112.01075): a manifest checkpoint written under one mesh factorization is
+re-laid-out for a different one — params, and PR 6's permanently-sharded
+1/R flat optimizer-state shards — WITHOUT requiring the writing topology
+to gather everything first (the preemption-fast
+:meth:`Saver.save_sharded` layout).
+
+The re-layout runs as two jitted programs on the TARGET mesh:
+
+1. **saved layout -> canonical**: unpad the flat 1/R update-space shards
+   (``leaf[:n].reshape(shape)``), slice padded partition axes, average
+   divergent copies — XLA realizes the gathers/dynamic-slices as
+   collectives when the restored arrays live device-side;
+2. **canonical -> target layout**: the transformer's existing
+   ``uncanonicalize_params`` / ``uncanonicalize_opt_state`` programs,
+   whose ``out_shardings`` scatter each leaf straight into the target's
+   storage / update-space specs (the reduce-scatter half of the portable
+   redistribution).
+
+Orbax stages the checkpoint through the host on load (arrays arrive as
+committed host buffers), so the end-to-end path is
+``disk -> host -> one device program per tree -> target shards``; there is
+no per-variable host gather round trip, and the host staging degrades
+gracefully when the source and target meshes do not overlap at all.
+
+Before the caller can take a single step, the restored session's
+re-planned schedule is verified: the static passes (incl. the Y-code
+hierarchy lint) always run, and with ``batch_shapes`` the traced passes
+plus the X-code HLO audit diff the realized collective schedule of the
+NEW step against the new strategy's plan — a reshard onto a topology the
+strategy cannot realize fails here, not three hours into the resumed run.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.checkpoint.manifest import (LAYOUT_UPDATE_SPACE,
+                                              geometry_matches,
+                                              load_manifest)
+from autodist_tpu.utils import logging
+
+
+class _EntryBox:
+    """Pytree-leaf wrapper for a manifest var record (dicts are containers
+    to jax.tree; the per-var geometry must ride along as a LEAF)."""
+
+    def __init__(self, entry):
+        self.entry = entry
+
+
+def _canon_saved_leaf(leaf, entry):
+    """One saved-layout array -> its canonical (original-shape) form,
+    using the SAVED geometry recorded in the manifest (not the target's).
+    Leaves that match no saved layout shape (per-param scalar statistics,
+    reduced optimizer state) pass through unchanged."""
+    if entry is None:
+        return leaf
+    shape = tuple(entry["shape"])
+    got = tuple(np.shape(leaf))
+    if entry["flat_update"] and got == tuple(entry["update_shape"]):
+        n = int(np.prod(shape)) if shape else 1
+        return jnp.reshape(leaf[:n], shape)
+    if entry["placement"] == "sharded" and got == tuple(entry["storage_shape"]):
+        axis = int(entry["partition_axis"])
+        dim = shape[axis]
+        if got[axis] != dim:
+            return jax.lax.slice_in_dim(leaf, 0, dim, axis=axis)
+        return leaf
+    if entry["placement"] == "divergent" and got == tuple(entry["storage_shape"]):
+        return jnp.mean(leaf, axis=0)
+    return leaf
+
+
+def _saved_templates(transformer, manifest):
+    """Host templates (numpy zeros) with the SAVED geometry, in the
+    target session's tree structures — what orbax restores into."""
+    t = transformer
+    entries = [manifest["vars"][n] for n in t.names]
+    params = t.treedef.unflatten(
+        [np.zeros(tuple(e["storage_shape"]), np.dtype(e["dtype"]))
+         for e in entries])
+    update_avals = t.treedef.unflatten(
+        [jax.ShapeDtypeStruct(tuple(e["update_shape"]), np.dtype(e["dtype"]))
+         for e in entries])
+    opt = t.model_item.optimizer
+    opt_shapes = jax.eval_shape(opt.init, update_avals)
+    opt_state = jax.tree.map(lambda a: np.zeros(a.shape, a.dtype), opt_shapes)
+    return params, opt_state
+
+
+def _canonicalize_saved(transformer, manifest, raw):
+    """Both saved trees -> canonical form, as ONE jitted program per tree
+    on the target mesh (replicated outputs feed the uncanonicalize
+    scatter)."""
+    t = transformer
+    rep = NamedSharding(t.mesh, P())
+    entries_tree = t.treedef.unflatten(
+        [_EntryBox(manifest["vars"][n]) for n in t.names])
+
+    def canon_params(p):
+        return jax.tree.map(
+            lambda leaf, box: _canon_saved_leaf(leaf, box.entry),
+            p, entries_tree,
+            is_leaf=lambda x: isinstance(x, _EntryBox))
+
+    def canon_opt(s):
+        return optax.tree_map_params(
+            t.model_item.optimizer,
+            lambda leaf, box: _canon_saved_leaf(leaf, box.entry),
+            s, entries_tree,
+            transform_non_params=lambda leaf: leaf,
+            is_leaf=lambda x: isinstance(x, _EntryBox))
+
+    params = jax.jit(canon_params, out_shardings=rep)(raw["params"])
+    opt_state = jax.jit(canon_opt, out_shardings=rep)(raw["opt_state"])
+    return params, opt_state
+
+
+def reshard_restore(session, path, *, batch_shapes=None, verify=True,
+                    raise_on_error=True):
+    """Restore a manifest checkpoint into ``session``, resharding when the
+    saved geometry differs from the session's.
+
+    Dispatch:
+
+    - canonical layout, or update-space layout with IDENTICAL geometry ->
+      the plain :meth:`Saver.restore` path (bitwise for update-space);
+    - update-space layout with different geometry (different R, mesh
+      factorization, hierarchy, or padding plan) -> the resharding
+      programs above; compressor state (error-feedback residuals)
+      reinitializes — its layout is R-dependent by construction.
+
+    With ``verify`` (default), the restored session's schedule is checked
+    before any step runs: static passes (Y-codes included) always, and —
+    when ``batch_shapes`` (a ``(shape, dtype)`` pytree of one global
+    batch) is given — the traced passes plus the X-code HLO audit of the
+    newly-lowered step.  Returns the verification
+    :class:`~autodist_tpu.analysis.report.Report` (``None`` when
+    ``verify=False``); ERROR findings raise unless ``raise_on_error`` is
+    False.
+    """
+    from autodist_tpu.checkpoint.saver import Saver
+
+    sess = session
+    t = sess._t
+    path = Saver._norm(path)
+    manifest = load_manifest(path, required=True)
+
+    ok, reasons = geometry_matches(t, manifest)
+    if manifest.get("layout") != LAYOUT_UPDATE_SPACE or ok:
+        # canonical checkpoints are R-independent; matching update-space
+        # geometry restores bitwise — both through the Saver front door
+        Saver(sess).restore(path)
+    else:
+        logging.info(
+            "Resharding checkpoint %s: saved R=%d (%s, %s) -> this mesh "
+            "R=%d (%s, %s); %s", path, manifest["num_replicas"],
+            "x".join(str(s) for s in manifest["mesh"]["axis_sizes"]),
+            manifest.get("hierarchy", "flat"), t.num_replicas,
+            "x".join(str(t.mesh.shape[a]) for a in t.mesh.axis_names),
+            t.sync_hierarchy, "; ".join(reasons[:3]))
+        raw = Saver(sess)._ckptr.restore(
+            path, item=_restore_template(sess, t, manifest))
+        # orbax re-attaches the SAVED topology's sharding (when those
+        # devices still exist in this process); commit to host buffers so
+        # the canonicalize program is free to run on the TARGET mesh —
+        # this is the host staging the portable-redistribution paper
+        # replaces on-device when source and target meshes coincide, and
+        # the always-correct fallback when they do not
+        raw = jax.tree.map(np.asarray, raw)
+        canon_params, canon_opt = _canonicalize_saved(t, manifest, raw)
+        fresh_comp = t.init_comp_states()
+        if any(jax.tree.leaves(v) for v in fresh_comp.values()):
+            logging.warning(
+                "Reshard restore: compressor state (error-feedback "
+                "residuals) is layout-bound to the saving topology; "
+                "reinitialized to zero")
+        rep = NamedSharding(t.mesh, P())
+        sess.state = {
+            "params": t.uncanonicalize_params(canon_params),
+            "opt_state": t.uncanonicalize_opt_state(canon_opt),
+            "comp": fresh_comp,
+            "mutable": (jax.device_put(raw["mutable"], rep)
+                        if raw["mutable"] is not None else None),
+            "step": jax.device_put(jnp.asarray(raw["step"]), rep),
+            "rng": jax.device_put(raw["rng"], rep),
+        }
+        from autodist_tpu import telemetry
+
+        telemetry.counter("elastic.reshards")
+        telemetry.gauge("elastic.reshard_from_replicas",
+                        manifest["num_replicas"])
+        logging.info("Resharded checkpoint %s restored at step %d "
+                     "(epoch %d)", path, int(manifest["step"]),
+                     int(manifest.get("epoch", 0)))
+
+    report = None
+    if verify:
+        report = _verify_restored(sess, batch_shapes,
+                                  raise_on_error=raise_on_error)
+    return report
+
+
+def _restore_template(sess, t, manifest):
+    params, opt_state = _saved_templates(t, manifest)
+    return {
+        "params": params,
+        "opt_state": opt_state,
+        # replicated leaves are host-addressable on every process and
+        # R-independent: take their geometry from the live state
+        "mutable": (jax.device_get(sess.state["mutable"])
+                    if sess.state["mutable"] is not None else None),
+        "step": np.zeros((), np.int32),
+        "rng": jax.device_get(sess.state["rng"]),
+    }
+
+
+def _verify_restored(sess, batch_shapes, raise_on_error=True):
+    """The post-reshard gate: the re-planned schedule must verify clean
+    BEFORE the first step runs (Y-codes statically; with batch shapes the
+    full trace tier plus the X-code HLO audit of the new lowering)."""
+    from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
+                                       TRACE_PASSES, verify_transformer)
+
+    passes = STATIC_PASSES if batch_shapes is None else \
+        STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+    report = verify_transformer(sess._t, batch_shapes,
+                                donate=sess._donate, passes=passes)
+    if report.findings:
+        logging.info("Post-restore verification:\n%s", report)
+    if raise_on_error:
+        report.raise_for_errors()
+    return report

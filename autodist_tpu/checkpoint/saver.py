@@ -20,11 +20,21 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from autodist_tpu.checkpoint import manifest as ckpt_manifest
+from autodist_tpu.const import ENV
 from autodist_tpu.utils import logging
 
 
 class Saver:
-    """Save/restore a DistributedSession (reference Saver analog)."""
+    """Save/restore a DistributedSession (reference Saver analog).
+
+    Every save also writes a **manifest** sidecar
+    (:mod:`autodist_tpu.checkpoint.manifest`) recording the strategy id,
+    mesh factorization, sharded-update padding plan and membership epoch
+    the checkpoint was written under — the contract the elastic restore
+    path (:mod:`autodist_tpu.checkpoint.reshard`) reshards against when
+    the restoring topology differs (docs/elasticity.md).
+    """
 
     def __init__(self, session=None):
         self._sess = session
@@ -76,17 +86,58 @@ class Saver:
         stateless buckets carry () and need no persistence."""
         return {k: v for k, v in comp.items() if jax.tree.leaves(v)}
 
-    def save(self, path):
+    def save(self, path, epoch=None):
         """Write a canonical (single-device-shaped) checkpoint.
 
         Stateful compressor state (error-feedback residuals, warm PowerSGD
         factors — per-device, stacked on the replica axis) goes to a
         ``<path>.comp`` sidecar so the MAIN checkpoint keeps the exact
         single-device structure (``restore_single_device`` contract).
+
+        A manifest sidecar records provenance (strategy id, mesh
+        factorization, padding plan, membership ``epoch`` — defaults to
+        the AUTODIST_EPOCH env contract) so elastic restores can reason
+        about the layout; the canonical layout itself is R-independent.
         """
         path = self._norm(path)
         canonical = jax.device_get(self._canonical_state())
         self._ckptr.save(path, canonical, force=True)
+        self._save_comp_sidecar(path)
+        self._write_manifest(path, ckpt_manifest.LAYOUT_CANONICAL,
+                             int(canonical["step"]), epoch)
+        logging.info("Saved checkpoint to %s (step %d)", path, int(canonical["step"]))
+        return path
+
+    def save_sharded(self, path, epoch=None):
+        """Preemption-fast checkpoint: write the live state AS LAID OUT —
+        params in storage layout, optimizer state in the update space
+        (PR 6's permanently-sharded 1/R flat shards included) — with NO
+        gather-on-save.  The manifest records the exact geometry; restore
+        is bitwise on identical geometry and routes through
+        :func:`autodist_tpu.checkpoint.reshard.reshard_restore` on a
+        different one (a plain :meth:`restore` on mismatched geometry
+        refuses loudly instead of producing garbage).
+        """
+        path = self._norm(path)
+        state = self._sess.state
+        live = {k: state[k] for k in
+                ("params", "opt_state", "mutable", "step", "rng")}
+        self._ckptr.save(path, live, force=True)
+        self._save_comp_sidecar(path)
+        self._write_manifest(path, ckpt_manifest.LAYOUT_UPDATE_SPACE,
+                             int(state["step"]), epoch)
+        logging.info("Saved sharded (update-space) checkpoint to %s "
+                     "(step %d)", path, int(state["step"]))
+        return path
+
+    def _write_manifest(self, path, layout, step, epoch):
+        if epoch is None:
+            epoch = ENV.AUTODIST_EPOCH.val
+        ckpt_manifest.write_manifest(
+            path, ckpt_manifest.build_manifest(
+                self._sess._t, step=step, layout=layout, epoch=epoch))
+
+    def _save_comp_sidecar(self, path):
         sidecar = self._comp_sidecar(path)
         comp = {}
         if jax.process_count() == 1:
@@ -116,11 +167,18 @@ class Saver:
                     shutil.rmtree(sidecar, ignore_errors=True)
             except Exception:
                 logging.warning("Could not remove stale sidecar %s", sidecar)
-        logging.info("Saved checkpoint to %s (step %d)", path, int(canonical["step"]))
-        return path
 
     def restore(self, path):
-        """Load a canonical checkpoint into the session (any strategy).
+        """Load a checkpoint into the session.
+
+        Canonical checkpoints restore under ANY strategy/topology (the
+        single-device contract).  Update-space checkpoints
+        (:meth:`save_sharded`) restore bitwise when the session's array
+        geometry matches the manifest, and REFUSE loudly otherwise —
+        restoring R-way shards onto an R'-way mesh without resharding
+        would scramle nothing visibly but train on garbage; use
+        :func:`autodist_tpu.checkpoint.reshard.reshard_restore` for the
+        topology-change path.
 
         Compressor state is restored from the sidecar when the restoring
         session's bucket layout matches the saving one, so resumed training
@@ -130,9 +188,60 @@ class Saver:
         sess = self._sess
         t = sess._t
         path = self._norm(path)
+        m = ckpt_manifest.load_manifest(path)
+        if m is not None and m.get("layout") == \
+                ckpt_manifest.LAYOUT_UPDATE_SPACE:
+            return self._restore_update_space(path, m)
         template = jax.device_get(self._canonical_state())
         restored = self._ckptr.restore(path, item=template)
+        comp = self._restore_comp(path)
+        sess.state = {
+            "params": t.uncanonicalize_params(restored["params"]),
+            "opt_state": t.uncanonicalize_opt_state(restored["opt_state"]),
+            "comp": comp,
+            "mutable": jax.device_put(restored["mutable"]),
+            "step": jax.device_put(restored["step"]),
+            "rng": jax.device_put(restored["rng"]),
+        }
+        logging.info("Restored checkpoint %s (step %d)", path, int(restored["step"]))
+        return sess.state
 
+    def _restore_update_space(self, path, m):
+        """Bitwise restore of a :meth:`save_sharded` checkpoint: the
+        manifest geometry must match the session's exactly."""
+        sess = self._sess
+        t = sess._t
+        ok, reasons = ckpt_manifest.geometry_matches(t, m)
+        if not ok:
+            raise ValueError(
+                f"Checkpoint {path} was saved in the update-space layout "
+                f"for a different geometry ({'; '.join(reasons[:4])}). A "
+                f"direct restore would silently train on scrambled "
+                f"shards; use autodist_tpu.checkpoint.reshard."
+                f"reshard_restore(session, path) to re-lay it out for "
+                f"this mesh (docs/elasticity.md).")
+        state = sess.state
+        live = {k: state[k] for k in
+                ("params", "opt_state", "mutable", "step", "rng")}
+        # template via eval_shape, NOT device_get: update-space shards are
+        # not host-addressable on multi-host
+        template = jax.tree.map(
+            lambda a: np.zeros(a.shape, a.dtype),
+            jax.eval_shape(lambda s: s, live))
+        restored = self._ckptr.restore(path, item=template)
+        shardings = jax.tree.map(lambda a: a.sharding, live)
+        new = jax.device_put(restored, shardings)
+        new["comp"] = self._restore_comp(path)
+        sess.state = new
+        logging.info("Restored sharded (update-space) checkpoint %s "
+                     "(step %d, epoch %d)", path, int(m["step"]),
+                     int(m.get("epoch", 0)))
+        return sess.state
+
+    def _restore_comp(self, path):
+        """Compressor state for a restore at ``path``: the sidecar when it
+        matches this session's bucket layout, else a fresh init."""
+        t = self._sess._t
         fresh = t.init_comp_states()
         comp = fresh
         sidecar = self._comp_sidecar(path)
@@ -164,17 +273,7 @@ class Saver:
             logging.warning(
                 "No compressor sidecar at %s; error-feedback residuals "
                 "reset to zero", sidecar)
-
-        sess.state = {
-            "params": t.uncanonicalize_params(restored["params"]),
-            "opt_state": t.uncanonicalize_opt_state(restored["opt_state"]),
-            "comp": comp,
-            "mutable": jax.device_put(restored["mutable"]),
-            "step": jax.device_put(restored["step"]),
-            "rng": jax.device_put(restored["rng"]),
-        }
-        logging.info("Restored checkpoint %s (step %d)", path, int(restored["step"]))
-        return sess.state
+        return comp
 
     @staticmethod
     def restore_single_device(path, item=None):

@@ -26,13 +26,38 @@ import shlex
 import subprocess
 import sys
 import threading
+import time
 
 from autodist_tpu.const import DEFAULT_COORDINATOR_PORT, ENV
 from autodist_tpu.utils import logging
 
 
+class WorkerLaunchError(RuntimeError):
+    """A worker could not be launched within the retry budget."""
+
+
 class Cluster:
-    """jax.distributed process-group bookkeeping for a ResourceSpec."""
+    """jax.distributed process-group bookkeeping for a ResourceSpec.
+
+    Elasticity surface (docs/elasticity.md):
+
+    - **membership epochs** — a monotonically increasing counter bumped on
+      every topology change (:meth:`advance_epoch`); workers inherit it via
+      ``AUTODIST_EPOCH`` in the env contract, so a process relaunched into
+      epoch N can never apply a strategy planned for epoch N-1;
+    - **worker-exit callback** — setting :attr:`on_worker_exit` turns the
+      fail-fast monitor into a membership-change signal: the callback
+      (called from the monitor thread with ``(addr, exit_code)``) returns
+      True to claim the failure (drain -> checkpoint -> re-plan is the
+      :class:`~autodist_tpu.elastic.ElasticTrainer` loop); returning
+      False (or raising) falls back to the reference's ``os._exit(1)``;
+    - **launch retry** — :meth:`launch_workers` retries each worker with
+      exponential backoff and a per-attempt probe window instead of
+      surfacing one opaque subprocess error;
+    - **chief failover groundwork** — :meth:`successor_chief` names the
+      deterministic successor (next surviving address in ``_rank_order``),
+      so every process agrees on the new chief without an election.
+    """
 
     def __init__(self, resource_spec, coordinator_port=DEFAULT_COORDINATOR_PORT):
         self._spec = resource_spec
@@ -40,6 +65,10 @@ class Cluster:
         self._procs = []
         self._monitor_threads = []
         self._terminating = False
+        self._epoch = ENV.AUTODIST_EPOCH.val
+        # callable(addr, exit_code) -> bool, consulted by _monitor before
+        # the fail-fast os._exit; runs on the monitor thread
+        self.on_worker_exit = None
 
     # -- identity ----------------------------------------------------------
 
@@ -71,6 +100,34 @@ class Cluster:
     @property
     def is_chief(self):
         return self.process_id == 0
+
+    # -- membership epochs --------------------------------------------------
+
+    @property
+    def epoch(self):
+        """Current membership epoch (0 for a fresh, full-topology run)."""
+        return self._epoch
+
+    def advance_epoch(self):
+        """Enter the next membership epoch (chief-side, on any topology
+        change: worker lost, workers relaunched, chief failover)."""
+        self._epoch += 1
+        from autodist_tpu import telemetry
+
+        telemetry.gauge("cluster.membership_epoch", self._epoch)
+        logging.info("Cluster membership epoch -> %d", self._epoch)
+        return self._epoch
+
+    def successor_chief(self, down=()):
+        """Deterministic chief-failover successor: the first address in
+        ``_rank_order`` not in ``down``.  Every surviving process computes
+        the same answer from the same spec — no election round needed."""
+        down = set(down)
+        for addr in self._rank_order():
+            if addr not in down:
+                return addr
+        raise RuntimeError(
+            f"No surviving node: all of {self._rank_order()} are down")
 
     def initialize(self):
         """Join the jax.distributed process group (no-op single node)."""
@@ -106,6 +163,9 @@ class Cluster:
             "AUTODIST_NUM_PROCESSES": str(self.num_processes),
             "AUTODIST_COORDINATOR": self.coordinator_address,
             "AUTODIST_MIN_LOG_LEVEL": ENV.AUTODIST_MIN_LOG_LEVEL.val,
+            # membership epoch: a worker relaunched after a topology
+            # change knows which epoch's plan it belongs to
+            "AUTODIST_EPOCH": str(self._epoch),
             # where the chief's async PS serves, should the strategy go
             # async (harmless otherwise); launch-scoped extra_env wins,
             # then the chief's own env override, so an ephemeral bound
@@ -133,10 +193,13 @@ class Cluster:
             env.update(ssh.env)
         return env
 
-    def remote_command(self, worker_address, argv, env):
+    def remote_command(self, worker_address, argv, env, connect_timeout_s=10):
         """Build the ssh command line re-executing `argv` on the worker
         (reference cluster.py:316-345, via the openssh client instead of
-        paramiko)."""
+        paramiko).  ``connect_timeout_s`` bounds each ATTEMPT: a black-holed
+        address fails the attempt in seconds (and enters
+        :meth:`launch_workers`'s retry/backoff loop) instead of hanging
+        the chief on the TCP default."""
         ssh = self._spec.ssh_config(worker_address)
         envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in sorted(env.items()))
         py = sys.executable
@@ -146,7 +209,8 @@ class Cluster:
         # trust-on-first-use: unlike =no this still detects key CHANGES, so
         # the chief->worker channel (which executes code remotely) cannot be
         # silently MITM'd after first contact
-        cmd = ["ssh", "-o", "StrictHostKeyChecking=accept-new", "-tt"]
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=accept-new",
+               "-o", f"ConnectTimeout={int(connect_timeout_s)}", "-tt"]
         if ssh is not None:
             if ssh.key_file:
                 cmd += ["-i", ssh.key_file]
@@ -158,22 +222,73 @@ class Cluster:
         cmd += [target, f"bash -c {shlex.quote(remote)}"]
         return cmd
 
-    def launch_workers(self, strategy_id, argv=None, extra_env=None):
+    def launch_workers(self, strategy_id, argv=None, extra_env=None,
+                       max_attempts=3, backoff_s=1.0, probe_s=2.0):
         """Chief only: re-execute the user script on every non-chief node.
         ``extra_env``: launch-scoped additions to the worker env contract
-        (see :meth:`worker_env`)."""
+        (see :meth:`worker_env`).
+
+        Each worker launch gets ``max_attempts`` tries: an attempt whose
+        process dies (nonzero) within the ``probe_s`` startup window is
+        retried after an exponentially growing ``backoff_s`` pause — a
+        transient ssh/connection hiccup no longer surfaces as one opaque
+        subprocess error at the first address.  Every retry lands in
+        telemetry (``cluster.launch_retries`` per address); exhausting the
+        budget raises :class:`WorkerLaunchError` naming the address and
+        the attempt count."""
         if not self.is_chief:
             return
         argv = argv or [os.path.abspath(sys.argv[0])] + sys.argv[1:]
         for addr in self._rank_order()[1:]:
-            env = self.worker_env(addr, strategy_id, extra_env=extra_env)
-            cmd = self.remote_command(addr, argv, env)
-            logging.info("Launching worker on %s", addr)
-            proc = subprocess.Popen(cmd, start_new_session=True)
+            def cmd_fn(a=addr):
+                # rebuilt per attempt: the env contract may carry
+                # attempt-sensitive state (epoch) and a fresh ssh
+                # invocation per retry is the intent
+                env = self.worker_env(a, strategy_id, extra_env=extra_env)
+                return self.remote_command(a, argv, env)
+
+            proc = self._launch_with_retry(addr, cmd_fn, max_attempts,
+                                           backoff_s, probe_s)
             self._procs.append((addr, proc))
             t = threading.Thread(target=self._monitor, args=(addr, proc), daemon=True)
             t.start()
             self._monitor_threads.append(t)
+
+    def _launch_with_retry(self, addr, cmd_fn, max_attempts, backoff_s,
+                           probe_s):
+        from autodist_tpu import telemetry
+
+        delay = backoff_s
+        code = None
+        for attempt in range(1, max_attempts + 1):
+            logging.info("Launching worker on %s (attempt %d/%d)",
+                         addr, attempt, max_attempts)
+            proc = subprocess.Popen(cmd_fn(), start_new_session=True)
+            deadline = time.monotonic() + probe_s
+            while time.monotonic() < deadline and proc.poll() is None:
+                time.sleep(min(0.05, probe_s / 4 or 0.01))
+            code = proc.poll()
+            if code is None or code == 0:
+                return proc  # alive past the probe window (or instant
+                #              clean exit): the monitor takes over
+            telemetry.counter("cluster.launch_retries", addr=addr,
+                              attempt=attempt, exit_code=code)
+            logging.warning(
+                "Worker launch on %s died with exit %d within %.1fs "
+                "(attempt %d/%d)%s", addr, code, probe_s, attempt,
+                max_attempts,
+                f"; retrying in {delay:.1f}s" if attempt < max_attempts
+                else "")
+            if attempt < max_attempts:
+                time.sleep(delay)
+                delay *= 2
+        telemetry.counter("cluster.launch_failures", addr=addr)
+        raise WorkerLaunchError(
+            f"Could not launch worker on {addr}: {max_attempts} attempt(s) "
+            f"exited nonzero within the {probe_s:.1f}s startup window "
+            f"(last exit code {code}); check ssh reachability and the "
+            f"worker's environment (cluster.launch_retries in telemetry "
+            f"has per-attempt details)")
 
     def _monitor(self, addr, proc, poll_s=0.5):
         """Fail fast: a dead worker kills the chief (reference
@@ -196,15 +311,48 @@ class Cluster:
         code = proc.returncode
         telemetry.counter("cluster.worker_exits", exit_code=code, addr=addr)
         if code != 0 and not self._terminating:
+            if self.on_worker_exit is not None:
+                telemetry.counter("cluster.worker_failures", addr=addr,
+                                  exit_code=code)
+                try:
+                    if self.on_worker_exit(addr, code):
+                        logging.warning(
+                            "Worker %s exited with %d; membership handler "
+                            "claimed the failure (epoch %d)", addr, code,
+                            self._epoch)
+                        return
+                except Exception:
+                    logging.exception(
+                        "on_worker_exit(%s, %d) raised; falling back to "
+                        "fail-fast", addr, code)
             logging.error("Worker %s exited with %d; terminating chief", addr, code)
             os._exit(1)
 
-    def terminate(self):
+    def terminate(self, grace_s=5.0):
+        """Stop every launched worker: TERM first, escalate to KILL after
+        ``grace_s``, and reap the monitor threads — an interrupted run
+        must not leak zombie worker processes or orphaned monitors."""
+        from autodist_tpu import telemetry
+
         self._terminating = True
-        for addr, proc in self._procs:
+        procs, self._procs = self._procs, []
+        for addr, proc in procs:
             if proc.poll() is None:
                 proc.terminate()
-        self._procs = []
+        deadline = time.monotonic() + grace_s
+        for addr, proc in procs:
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                logging.warning(
+                    "Worker %s ignored SIGTERM for %.1fs; escalating to "
+                    "SIGKILL", addr, grace_s)
+                telemetry.counter("cluster.terminate_kills", addr=addr)
+                proc.kill()
+            proc.wait()  # reap: no zombies left behind
+        threads, self._monitor_threads = self._monitor_threads, []
+        for t in threads:
+            t.join(timeout=max(grace_s, 2.0))
 
     def merge_telemetry(self, run_dir=None):
         """Chief-side aggregation: merge every host's

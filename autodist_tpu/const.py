@@ -86,6 +86,16 @@ class ENV(Enum):
     # launched workers so every host writes into the same run directory
     AUTODIST_TELEMETRY = (lambda v: v == "True" or v == "1",)
     AUTODIST_TELEMETRY_DIR = (lambda v: v or "",)
+    # cluster membership epoch (docs/elasticity.md): bumped by the chief on
+    # every topology change and handed to relaunched workers through the
+    # worker-env contract, so a worker joining epoch N can never apply a
+    # strategy planned for epoch N-1; checkpoint manifests record it
+    AUTODIST_EPOCH = (lambda v: int(v) if v else 0,)
+    # fault-injection contract for the chaos harness (tools/chaos_check.py;
+    # docs/elasticity.md): a semicolon-separated event list, each
+    # "<kind>@<step>[:<arg>]" — kind in {kill_worker, delay, preempt} —
+    # consumed by ElasticTrainer on the CPU mesh.  Empty = no injection.
+    AUTODIST_CHAOS = (lambda v: v or "",)
     SYS_DATA_PATH = (lambda v: v or "",)
     SYS_RESOURCE_PATH = (lambda v: v or "",)
 

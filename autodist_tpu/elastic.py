@@ -1,0 +1,313 @@
+"""Elastic fault-tolerant training: the membership-epoch driver.
+
+The reference AutoDist launches a fixed SSH worker set and fail-fasts on
+the first death; this module is the layer that ACTS on membership changes
+(ROADMAP item 4, docs/elasticity.md).  :class:`ElasticTrainer` runs the
+managed loop under the protocol::
+
+    worker lost (Cluster.on_worker_exit / chaos injection)
+        -> drain the in-flight step
+        -> manifest checkpoint (update-space layout, no gather)
+        -> epoch += 1 (Cluster.advance_epoch; AUTODIST_EPOCH contract)
+        -> shrink the ResourceSpec to the survivors (chief failover =
+           deterministic successor)
+        -> AutoStrategy re-plan on the surviving topology
+           (AutoDist.rebind + distribute)
+        -> reshard the R-way checkpoint onto the R'-way mesh
+           (checkpoint.reshard — params AND the 1/R flat opt-state shards)
+        -> Y-code + X-audit verification of the re-planned schedule
+           BEFORE the first step of the new epoch
+        -> continue training, loss continuous across the boundary
+
+SIGTERM/SIGINT preemption rides the same machinery via the runner's
+:class:`~autodist_tpu.runner.PreemptionGuard`: drain, manifest
+checkpoint, clean exit, resume (bitwise on an unchanged topology).
+
+**Scope.**  Within one ``jax.distributed`` process group the device set
+is fixed for the life of the processes — a live SPMD step cannot lose a
+participant.  The protocol therefore spans a *restart*: the surviving
+chief checkpoints + re-plans, relaunches workers for the new epoch
+(:meth:`Cluster.launch_workers` with retry/backoff), and every process of
+epoch N+1 restores the resharded state.  On a single host (the CPU mesh,
+and the chaos harness ``tools/chaos_check.py``) the whole cycle runs in
+process, which is what pins the protocol in tier-1.
+
+Fault injection (``AUTODIST_CHAOS`` env contract)::
+
+    AUTODIST_CHAOS="kill_worker@3;delay@5:0.2"
+
+a semicolon-separated event list, each ``<kind>@<step>[:<arg>]``:
+
+``kill_worker@N[:addr]``
+    before step N, treat ``addr`` (default: the last non-chief node in
+    rank order, or the last half of a single node's chips) as dead.
+``delay@N:seconds``
+    before step N, stall the host for ``seconds`` (straggler injection).
+``preempt@N``
+    before step N, deliver SIGTERM to this process (preemption drill).
+"""
+import os
+import time
+
+import numpy as np
+
+from autodist_tpu.const import ENV
+from autodist_tpu.utils import logging
+
+
+class ChaosEvent:
+    KINDS = ("kill_worker", "delay", "preempt")
+
+    def __init__(self, kind, step, arg=None):
+        if kind not in self.KINDS:
+            raise ValueError(
+                f"Unknown chaos event kind {kind!r}; accepted: "
+                f"{', '.join(self.KINDS)} (AUTODIST_CHAOS contract, "
+                f"docs/elasticity.md)")
+        self.kind = kind
+        self.step = int(step)
+        self.arg = arg
+        self.fired = False
+
+    def __repr__(self):
+        return (f"ChaosEvent({self.kind}@{self.step}"
+                + (f":{self.arg}" if self.arg else "") + ")")
+
+
+def parse_chaos(text):
+    """Parse the ``AUTODIST_CHAOS`` contract: ``<kind>@<step>[:<arg>]``
+    entries separated by ``;``.  Empty/None -> no events."""
+    events = []
+    for piece in (text or "").split(";"):
+        piece = piece.strip()
+        if not piece:
+            continue
+        kind, sep, rest = piece.partition("@")
+        if not sep:
+            raise ValueError(
+                f"Bad AUTODIST_CHAOS entry {piece!r}: expected "
+                f"'<kind>@<step>[:<arg>]' (e.g. 'kill_worker@3', "
+                f"'delay@5:0.2', 'preempt@4')")
+        step_s, _, arg = rest.partition(":")
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"Bad AUTODIST_CHAOS step in {piece!r}: {step_s!r} is "
+                f"not an integer") from None
+        events.append(ChaosEvent(kind.strip(), step, arg or None))
+    return events
+
+
+class ElasticTrainer:
+    """Membership-epoch training driver (see module docstring).
+
+    Args:
+      resource_spec: the FULL starting topology.
+      strategy_builder: any StrategyBuilder; AutoStrategy makes the
+        re-plan meaningful (the surviving topology may rank a different
+        family/hierarchy first).
+      loss_fn / params / optimizer: the single-device model, exactly as
+        :meth:`AutoDist.distribute` takes them.
+      checkpoint_dir: where epoch-boundary manifest checkpoints live.
+      distribute_kwargs: forwarded to ``distribute`` on every (re)build.
+      verify_restore: run the Y/X verification gate on every restore
+        (static always; with batch shapes the HLO audit too).
+      chaos: explicit chaos spec string (default: the ``AUTODIST_CHAOS``
+        env); parsed events inject failures at step boundaries.
+      max_replans: hard cap on topology changes per run (a flapping
+        cluster must not re-plan forever).
+    """
+
+    def __init__(self, resource_spec, strategy_builder, loss_fn, params,
+                 optimizer, *, checkpoint_dir, distribute_kwargs=None,
+                 verify_restore=True, chaos=None, max_replans=8):
+        from autodist_tpu.autodist import AutoDist
+        from autodist_tpu.cluster import Cluster
+
+        self._ad = AutoDist(resource_spec=resource_spec,
+                            strategy_builder=strategy_builder)
+        self.cluster = Cluster(resource_spec)
+        self.cluster.on_worker_exit = self._note_worker_exit
+        self._ckpt = os.path.join(checkpoint_dir, "elastic_ckpt")
+        self._model = (loss_fn, params, optimizer)
+        self._kwargs = dict(distribute_kwargs or {})
+        self._verify = verify_restore
+        self._chaos = parse_chaos(
+            chaos if chaos is not None else ENV.AUTODIST_CHAOS.val)
+        self._lost = []          # addresses reported dead, pending handling
+        self._keep_chips = None  # single-host chip-shrink injection
+        self._max_replans = max_replans
+        self.epoch = self.cluster.epoch
+        self.replans = 0
+        self.history = []        # (epoch, step, loss) across the whole run
+        self.session = None
+
+    # -- membership signals -------------------------------------------------
+
+    def _note_worker_exit(self, addr, code):
+        """Cluster monitor callback (monitor thread): queue the death for
+        the step-boundary handler; True = claimed, no fail-fast."""
+        logging.warning("ElasticTrainer: worker %s exited with %d; "
+                        "queueing membership change", addr, code)
+        self._lost.append(addr)
+        return True
+
+    def _default_kill_target(self):
+        """Who dies when a chaos kill names no address: the last non-chief
+        node, or — single-node specs — the upper half of its chips."""
+        spec = self._ad.resource_spec
+        order = [spec.chief] + [a for a in spec.node_addresses
+                                if a != spec.chief]
+        if len(order) > 1:
+            return order[-1], None
+        addr = order[0]
+        chips = [d.device_index for _, d in spec.accelerator_devices] or \
+            [d.device_index for _, d in spec.cpu_devices]
+        keep = chips[:max(1, len(chips) // 2)]
+        return addr, {addr: keep}
+
+    def _fire_chaos(self, step):
+        for ev in self._chaos:
+            if ev.fired or ev.step != step:
+                continue
+            ev.fired = True
+            from autodist_tpu import telemetry
+
+            telemetry.counter("elastic.chaos_events", kind=ev.kind,
+                              step=step)
+            logging.warning("Chaos injection at step %d: %r", step, ev)
+            if ev.kind == "kill_worker":
+                if ev.arg:
+                    self._lost.append(ev.arg)
+                else:
+                    addr, keep = self._default_kill_target()
+                    if keep is None:
+                        self._lost.append(addr)
+                    else:
+                        self._keep_chips = keep
+            elif ev.kind == "delay":
+                time.sleep(float(ev.arg or 0.1))
+            elif ev.kind == "preempt":
+                import signal
+
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    # -- session lifecycle --------------------------------------------------
+
+    def _build_session(self):
+        loss_fn, params, optimizer = self._model
+        self.session = self._ad.distribute(loss_fn, params, optimizer,
+                                           **self._kwargs)
+        return self.session
+
+    def _restore(self, batch=None):
+        """Manifest-aware restore into the current session: direct when
+        the geometry matches, reshard otherwise — always through the
+        verification gate when ``verify_restore`` is on."""
+        from autodist_tpu.checkpoint.reshard import reshard_restore
+
+        shapes = None
+        if batch is not None:
+            import jax
+
+            shapes = jax.tree.map(
+                lambda a: (tuple(np.shape(a)), np.asarray(a).dtype), batch)
+        return reshard_restore(self.session, self._ckpt,
+                               batch_shapes=shapes if self._verify else None,
+                               verify=self._verify)
+
+    def _handle_membership_change(self, batch_fn):
+        """The epoch transition: drain -> checkpoint -> shrink -> re-plan
+        -> relaunch (multi-process) -> reshard -> verify."""
+        import jax
+
+        from autodist_tpu.checkpoint.saver import Saver
+        from autodist_tpu import telemetry
+
+        lost = list(dict.fromkeys(self._lost))
+        self._lost = []
+        keep_chips, self._keep_chips = self._keep_chips, None
+        if self.replans + 1 > self._max_replans:
+            raise RuntimeError(
+                f"ElasticTrainer: {self.replans + 1} topology changes "
+                f"exceed max_replans={self._max_replans}; the cluster is "
+                f"flapping — stop and investigate")
+
+        # 1. drain: every dispatched step completes before state is read
+        jax.block_until_ready(self.session.state)
+        # 2. preemption-safe manifest checkpoint of the OLD epoch
+        Saver(self.session).save_sharded(self._ckpt, epoch=self.epoch)
+        # 3. survivors-only spec; deterministic chief failover inside
+        old_spec = self._ad.resource_spec
+        new_spec = old_spec.shrink(drop_addresses=lost,
+                                   keep_chips=keep_chips)
+        self.epoch = self.cluster.advance_epoch()
+        logging.warning(
+            "Membership epoch %d: lost %s; surviving topology %r",
+            self.epoch, lost or list(keep_chips or ()), new_spec)
+        # 4. stop what remains of the old epoch's launches, carry the
+        #    epoch into the new cluster view
+        self.cluster.terminate()
+        from autodist_tpu.cluster import Cluster
+
+        cl = Cluster(new_spec)
+        cl._epoch = self.epoch
+        cl.on_worker_exit = self._note_worker_exit
+        self.cluster = cl
+        # 5. re-plan on the surviving topology (AutoStrategy re-enumerates)
+        self._ad.rebind(new_spec)
+        self.replans += 1
+        telemetry.counter("elastic.replans")
+        sess = self._build_session()
+        # 6. reshard the R-way checkpoint onto the R'-way mesh, verified
+        #    (Y-codes + X-audit) before the new epoch's first step
+        probe = batch_fn(int(sess.step)) if batch_fn is not None else None
+        self._restore(probe)
+        logging.info(
+            "Epoch %d resumed at step %d on R=%d after re-plan #%d",
+            self.epoch, sess.step, sess._t.num_replicas, self.replans)
+        return sess
+
+    # -- the managed loop ---------------------------------------------------
+
+    def fit(self, batch_fn, steps, log_every=0):
+        """Train to ``steps`` total steps, surviving worker loss, chaos
+        injection and preemption.  Returns the (possibly rebuilt) session;
+        per-step ``(epoch, step, loss)`` triples are in :attr:`history`.
+        """
+        from autodist_tpu.checkpoint.saver import Saver
+        from autodist_tpu.runner import PreemptionGuard
+
+        sess = self.session or self._build_session()
+        if Saver.exists(self._ckpt):
+            self._restore(batch_fn(0))
+            logging.info("ElasticTrainer: resumed from %s at step %d",
+                         self._ckpt, sess.step)
+        with PreemptionGuard() as guard:
+            while sess.step < steps:
+                step = sess.step
+                self._fire_chaos(step)
+                if self._lost or self._keep_chips:
+                    sess = self._handle_membership_change(batch_fn)
+                    continue
+                if guard.requested:
+                    from autodist_tpu.checkpoint.saver import Saver
+
+                    Saver(sess).save_sharded(self._ckpt, epoch=self.epoch)
+                    logging.warning(
+                        "ElasticTrainer: preempted at step %d; manifest "
+                        "checkpoint written, exiting cleanly", sess.step)
+                    sess.preempted = True
+                    break
+                metrics = sess.run(batch_fn(step))
+                loss = metrics.get("loss") if isinstance(metrics, dict) \
+                    else None
+                self.history.append(
+                    (self.epoch, int(sess.step),
+                     float(loss) if loss is not None else float("nan")))
+                if log_every and sess.step % log_every == 0:
+                    logging.info("epoch %d step %d: %s", self.epoch,
+                                 sess.step, sess._metrics_log_str(metrics))
+        sess.finalize_telemetry()
+        return sess

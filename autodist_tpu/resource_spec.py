@@ -298,6 +298,80 @@ class ResourceSpec:
     def is_single_node(self):
         return len(self._nodes) == 1
 
+    # -- elastic topology surgery (docs/elasticity.md) ----------------------
+
+    def shrink(self, drop_addresses=(), keep_chips=None):
+        """A new spec describing the SURVIVING topology after a membership
+        change: ``drop_addresses`` removes whole nodes (a dead worker),
+        ``keep_chips`` (``{address: [chip, ...]}``) shrinks a node's chip
+        set in place (a partially-degraded host, or single-host CPU-mesh
+        emulation of a shrink).
+
+        Chief failover is deterministic: when the chief is dropped, the
+        first surviving node in the original spec order becomes chief —
+        the same successor :meth:`Cluster.successor_chief` names, so every
+        process re-derives the identical new spec.  An explicit ``mesh:``
+        request and ``topology:`` string are NOT carried over (they were
+        sized for the old device count; the mesh builder re-factors for
+        the survivors); ssh groups and explicit bandwidths are.
+        """
+        drop = set(drop_addresses)
+        keep_chips = dict(keep_chips or {})
+        unknown = (drop | set(keep_chips)) - set(self._nodes)
+        if unknown:
+            raise ResourceSpecError(
+                f"shrink: unknown node address(es) {sorted(unknown)}; "
+                f"spec has {list(self._nodes)}")
+        survivors = [a for a in self._nodes if a not in drop]
+        if not survivors:
+            raise ResourceSpecError("shrink would drop every node")
+        chief = self._chief_address if self._chief_address in survivors \
+            else survivors[0]
+        nodes = []
+        for addr in survivors:
+            node = self._nodes[addr]
+            accel = [d.device_index for d in node["devices"]
+                     if d.device_type != DeviceType.CPU]
+            cpus = [d.device_index for d in node["devices"]
+                    if d.device_type == DeviceType.CPU]
+            if addr in keep_chips:
+                kept = list(keep_chips[addr])
+                bad = set(kept) - set(accel or cpus)
+                if bad:
+                    raise ResourceSpecError(
+                        f"shrink: node {addr} has no chip(s) {sorted(bad)}")
+                if accel:
+                    accel = [i for i in accel if i in kept]
+                else:
+                    cpus = [i for i in cpus if i in kept]
+                if not accel and not cpus:
+                    continue  # node shrunk to nothing: drop it entirely
+            entry = {"address": addr, "chief": addr == chief}
+            gpu_only = (accel and all(
+                d.device_type == DeviceType.GPU for d in node["devices"]
+                if d.device_type != DeviceType.CPU))
+            if accel:
+                entry["gpus" if gpu_only else "chips"] = accel
+            if cpus and not accel:
+                entry["cpus"] = cpus
+            if node.get("ssh_config") is not None:
+                entry["ssh_config"] = node["ssh_config"]
+            if addr in self._explicit_bandwidths:
+                entry["network_bandwidth"] = self._explicit_bandwidths[addr]
+            nodes.append(entry)
+        if not nodes:
+            raise ResourceSpecError("shrink would drop every device")
+        if not any(n["chief"] for n in nodes):
+            nodes[0]["chief"] = True  # chief's node lost all its chips
+        info = {"nodes": nodes}
+        if self._ssh_configs:
+            info["ssh"] = {
+                g: {"username": c.username, "port": c.port,
+                    "python_venv": c.python_venv, "key_file": c.key_file,
+                    "pythonpath": c.pythonpath, "shared_envs": dict(c.env)}
+                for g, c in self._ssh_configs.items()}
+        return ResourceSpec(resource_info=info)
+
     def __repr__(self):
         return (
             f"ResourceSpec(nodes={len(self._nodes)}, accelerators={self.num_accelerators}, "

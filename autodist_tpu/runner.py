@@ -8,7 +8,10 @@ TPU equivalent: a global batch array is sharded over the replica mesh axis
 ``host_local_array_to_global_array``), the jitted SPMD step runs, and
 metrics come back replicated (fetch contraction = reading any shard).
 """
+import contextlib
 import os
+import signal
+import threading
 
 import jax
 import numpy as np
@@ -17,6 +20,46 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from autodist_tpu.const import BATCH_MASK_KEY
 from autodist_tpu.kernel.partitioner import Placement
 from autodist_tpu.utils import logging
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT drain hook for training loops (docs/elasticity.md).
+
+    A preemption notice must not kill the process mid-step: the guard
+    turns the signal into a flag the loop checks at the next step
+    boundary, where it drains (the in-flight step completes), writes a
+    manifest checkpoint, and returns cleanly — the TPU-pod / spot-VM
+    preemption contract.  Previous handlers are restored on exit.  Off
+    the main thread (where CPython forbids ``signal.signal``) the guard
+    degrades to an inert flag holder rather than failing the loop.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._prev = {}
+        self._received = None
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            for s in self._signals:
+                self._prev[s] = signal.signal(s, self._on_signal)
+        return self
+
+    def _on_signal(self, signum, frame):
+        logging.warning(
+            "Received signal %d: draining the in-flight step, then "
+            "writing a preemption checkpoint", signum)
+        self._received = signum
+
+    @property
+    def requested(self):
+        return self._received is not None
+
+    def __exit__(self, *exc):
+        for s, h in self._prev.items():
+            signal.signal(s, h)
+        self._prev = {}
+        return False
 
 
 class DistributedSession:
@@ -57,6 +100,9 @@ class DistributedSession:
         self._verify_budget = hbm_bytes_per_device
         self._donate = donate
         self._verified = False
+        # set True when a run_steps/fit loop exited via the preemption
+        # hook (docs/elasticity.md) after writing its manifest checkpoint
+        self.preempted = False
         # runtime telemetry (autodist_tpu/telemetry, docs/observability.md):
         # OFF by default — ``run`` then takes the uninstrumented hot path
         # (no device sync, no file I/O; pinned by test_telemetry).  Opt in
@@ -372,18 +418,60 @@ class DistributedSession:
             return self._telemetry.finalize()
         return None
 
-    def run_steps(self, batches, log_every=0):
+    def _preempt_path(self, preempt_checkpoint_dir):
+        return os.path.join(preempt_checkpoint_dir, "preempt_ckpt")
+
+    def _preempt_save(self, preempt_checkpoint_dir):
+        """Drain + write the preemption checkpoint (manifest, update-space
+        layout: no gather on save — the preemption window is short)."""
+        from autodist_tpu.checkpoint.saver import Saver
+
+        jax.block_until_ready(self.state)
+        path = Saver(self).save_sharded(
+            self._preempt_path(preempt_checkpoint_dir))
+        logging.warning(
+            "Preemption checkpoint written to %s (step %d); exiting the "
+            "training loop cleanly", path, self.step)
+        self.preempted = True
+        return path
+
+    def _preempt_resume(self, preempt_checkpoint_dir):
+        """Resume from a preemption checkpoint when one exists AND is
+        ahead of the session's current step (a periodic checkpoint_path
+        restore may already be newer)."""
+        from autodist_tpu.checkpoint.manifest import load_manifest
+        from autodist_tpu.checkpoint.saver import Saver
+
+        path = self._preempt_path(preempt_checkpoint_dir)
+        if not Saver.exists(path):
+            return
+        m = load_manifest(path)
+        if m is not None and int(m["step"]) <= self.step:
+            return
+        Saver(self).restore(path)
+        logging.info("Resumed from preemption checkpoint %s at step %d",
+                     path, self.step)
+
+    def run_steps(self, batches, log_every=0, preempt_checkpoint_dir=None):
+        """Run a sequence of steps.  With ``preempt_checkpoint_dir`` a
+        SIGTERM/SIGINT drains the in-flight step, writes a manifest
+        checkpoint there and returns cleanly (see :meth:`fit`)."""
         metrics = None
-        for i, b in enumerate(batches):
-            metrics = self.run(b)
-            if log_every and (i + 1) % log_every == 0:
-                logging.info("step %d: %s", i + 1,
-                             self._metrics_log_str(metrics))
+        with PreemptionGuard() if preempt_checkpoint_dir else \
+                contextlib.nullcontext() as guard:
+            for i, b in enumerate(batches):
+                metrics = self.run(b)
+                if log_every and (i + 1) % log_every == 0:
+                    logging.info("step %d: %s", i + 1,
+                                 self._metrics_log_str(metrics))
+                if guard is not None and guard.requested:
+                    self._preempt_save(preempt_checkpoint_dir)
+                    break
         self.finalize_telemetry()
         return metrics
 
     def fit(self, batch_fn, steps, *, checkpoint_path=None, save_every=0,
-            log_every=0, resume=True):
+            log_every=0, resume=True, preempt_checkpoint_dir=None):
         """Managed training loop: periodic checkpoints + crash resume.
 
         ``batch_fn(step) -> batch`` supplies the step's global batch (a
@@ -394,8 +482,17 @@ class DistributedSession:
         crashed job re-run with the same arguments continues where it left
         off (the reference's fail-fast coordinator offers no recovery; this
         is the TPU-pod-preemption story on top of the Saver contract).
+
+        ``preempt_checkpoint_dir`` opts into the SIGTERM/SIGINT preemption
+        hook (:class:`PreemptionGuard`): on a signal the in-flight step
+        drains, a manifest (update-space, no-gather) checkpoint lands in
+        ``<dir>/preempt_ckpt``, and ``fit`` returns cleanly with
+        ``self.preempted`` set — re-running with the same arguments
+        resumes from it (topology changes go through
+        :class:`autodist_tpu.elastic.ElasticTrainer`, which reshards).
         """
         saver = None
+        self.preempted = False
         if checkpoint_path:
             from autodist_tpu.checkpoint.saver import Saver
 
@@ -413,19 +510,27 @@ class DistributedSession:
                 else:
                     logging.info("fit: no checkpoint at %s; starting fresh",
                                  checkpoint_path)
+        if preempt_checkpoint_dir and resume:
+            self._preempt_resume(preempt_checkpoint_dir)
         metrics = None
         last_saved = -1
-        while self.step < steps:
-            step = self.step
-            metrics = self.run(batch_fn(step))
-            done = self.step
-            if log_every and done % log_every == 0:
-                logging.info("step %d: %s", done,
-                             self._metrics_log_str(metrics))
-            if saver and save_every and done % save_every == 0:
-                saver.save(checkpoint_path)
-                last_saved = done
-        if saver and self.step != last_saved and metrics is not None:
+        with PreemptionGuard() if preempt_checkpoint_dir else \
+                contextlib.nullcontext() as guard:
+            while self.step < steps:
+                step = self.step
+                metrics = self.run(batch_fn(step))
+                done = self.step
+                if log_every and done % log_every == 0:
+                    logging.info("step %d: %s", done,
+                                 self._metrics_log_str(metrics))
+                if guard is not None and guard.requested:
+                    self._preempt_save(preempt_checkpoint_dir)
+                    break
+                if saver and save_every and done % save_every == 0:
+                    saver.save(checkpoint_path)
+                    last_saved = done
+        if (saver and self.step != last_saved and metrics is not None
+                and not self.preempted):
             saver.save(checkpoint_path)
         self.finalize_telemetry()
         return metrics

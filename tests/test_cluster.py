@@ -42,6 +42,7 @@ def test_worker_env_contract():
     assert env["AUTODIST_PROCESS_ID"] == "1"
     assert env["AUTODIST_NUM_PROCESSES"] == "2"
     assert env["AUTODIST_COORDINATOR"] == "10.0.0.1:15501"
+    assert env["AUTODIST_EPOCH"] == "0"  # membership epoch rides the contract
     assert env["LD_LIBRARY_PATH"] == "/lib"  # ssh shared_envs forwarded
 
 

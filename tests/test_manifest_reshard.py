@@ -1,0 +1,264 @@
+"""Checkpoint manifests + topology-resharding restore (docs/elasticity.md).
+
+The acceptance matrix of ISSUE 7's satellite: same-R bitwise resume of the
+update-space (no-gather) layout, R->R' reshard for sgd/adam x
+replicated/sharded update x FLAT/TWO_LEVEL (params AND the 1/R flat
+opt-state shards, exact), and the regression guard that restoring a
+sharded-update checkpoint onto mismatched R WITHOUT reshard refuses with a
+clear error instead of training on garbage.
+"""
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.checkpoint.manifest import (build_manifest,
+                                              geometry_matches,
+                                              load_manifest, manifest_path)
+from autodist_tpu.checkpoint.reshard import reshard_restore
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce, PS
+
+SPEC8 = ResourceSpec.from_num_chips(8)
+SPEC4 = ResourceSpec.from_num_chips(4)
+SPEC_2x4 = ResourceSpec(resource_info={
+    "nodes": [{"address": "localhost", "chips": list(range(8))}],
+    "mesh": {AXIS_REPLICA_DCN: 2, AXIS_REPLICA_ICI: 4}})
+
+_OPTS = {"sgd": lambda: optax.sgd(0.1), "adam": lambda: optax.adam(0.05)}
+
+_R = np.random.RandomState(0)
+BATCH = {"x": _R.randn(16, 12).astype(np.float32),
+         "y": _R.randn(16, 3).astype(np.float32)}
+BATCH_SHAPES = jax.tree.map(
+    lambda a: (np.shape(a), np.asarray(a).dtype), BATCH)
+
+
+def _loss(p, b):
+    return jnp.mean((b["x"] @ p["w"] + p["b"] - b["y"]) ** 2)
+
+
+def _params():
+    r = np.random.RandomState(7)
+    return {"w": jnp.asarray(r.randn(12, 3), jnp.float32),
+            "b": jnp.zeros((3,), jnp.float32)}
+
+
+def _session(spec, opt="adam", sharded="replicated", hierarchy="auto"):
+    ad = AutoDist(resource_spec=spec, strategy_builder=AllReduce(
+        sharded_update=sharded, hierarchy=hierarchy))
+    return ad.distribute(_loss, _params(), _OPTS[opt]())
+
+
+def _exact(a, b):
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)), a, b)
+
+
+# -- manifest sidecar -------------------------------------------------------
+
+def test_canonical_save_writes_manifest(tmp_path):
+    sess = _session(SPEC8, sharded="sharded")
+    sess.run(BATCH)
+    path = Saver(sess).save(str(tmp_path / "c"), epoch=3)
+    m = load_manifest(path)
+    assert m["layout"] == "canonical"
+    assert m["schema"] == 1
+    assert m["epoch"] == 3
+    assert m["num_replicas"] == 8
+    assert m["sharded_update"] is True
+    assert m["strategy_id"] == sess._t.strategy.id
+    # the padding plan: w is 36 elements -> ceil(36/8)*8 = 40 flat slots
+    assert m["vars"]["w"]["update_shape"] == [40]
+    assert m["vars"]["w"]["flat_update"] is True
+    # sidecar is plain JSON next to the checkpoint dir
+    assert os.path.exists(manifest_path(path))
+    json.load(open(manifest_path(path)))
+
+
+def test_geometry_matches_self_and_mismatch():
+    s8 = _session(SPEC8, sharded="sharded")
+    s4 = _session(SPEC4, sharded="sharded")
+    m8 = build_manifest(s8._t, step=0, layout="update_space")
+    ok, reasons = geometry_matches(s8._t, m8)
+    assert ok and not reasons
+    ok, reasons = geometry_matches(s4._t, m8)
+    assert not ok
+    assert any("num_replicas" in r for r in reasons)
+
+
+def test_update_space_manifest_two_level_records_factorization(tmp_path):
+    sess = _session(SPEC_2x4, sharded="sharded", hierarchy="two_level")
+    sess.run(BATCH)
+    path = Saver(sess).save_sharded(str(tmp_path / "t"))
+    m = load_manifest(path)
+    assert m["layout"] == "update_space"
+    assert m["hierarchy"] == "two_level"
+    assert m["mesh"]["axis_names"] == [AXIS_REPLICA_DCN, AXIS_REPLICA_ICI]
+    assert m["mesh"]["axis_sizes"] == [2, 4]
+
+
+# -- same-R bitwise resume (the preemption-fast path) -----------------------
+
+@pytest.mark.parametrize("hierarchy,spec", [("auto", SPEC8),
+                                            ("two_level", SPEC_2x4)])
+def test_update_space_same_geometry_resume_bitwise(tmp_path, hierarchy, spec):
+    sess = _session(spec, opt="adam", sharded="sharded", hierarchy=hierarchy)
+    for _ in range(3):
+        sess.run(BATCH)
+    path = Saver(sess).save_sharded(str(tmp_path / "u"))
+    saved_params = jax.device_get(sess.state["params"])
+    saved_opt = jax.device_get(sess.state["opt_state"])
+    sess.run(BATCH)
+    after4 = sess.params()
+
+    sess2 = _session(spec, opt="adam", sharded="sharded", hierarchy=hierarchy)
+    Saver(sess2).restore(path)
+    assert sess2.step == 3
+    # bitwise: the update-space layout round-trips without canonicalize —
+    # storage params AND the 1/R flat opt-state shards are byte-identical
+    _exact(jax.device_get(sess2.state["params"]), saved_params)
+    _exact(jax.device_get(sess2.state["opt_state"]), saved_opt)
+    sess2.run(BATCH)
+    _exact(sess2.params(), after4)
+
+
+# -- R -> R' reshard matrix -------------------------------------------------
+
+@pytest.mark.parametrize("opt", sorted(_OPTS))
+@pytest.mark.parametrize("sharded", ["replicated", "sharded"])
+@pytest.mark.parametrize("hierarchy", ["flat", "two_level"])
+def test_reshard_matrix(tmp_path, opt, sharded, hierarchy):
+    """R=8 (flat or dcn x ici factored) -> R=4 flat: canonical params and
+    the resharded opt state are EXACT (unpad/repad moves bytes, no
+    arithmetic), and the restored session takes a finite step."""
+    spec = SPEC_2x4 if hierarchy == "two_level" else SPEC8
+    hier = "two_level" if hierarchy == "two_level" else "auto"
+    sess = _session(spec, opt=opt, sharded=sharded, hierarchy=hier)
+    for _ in range(3):
+        sess.run(BATCH)
+    want = sess.params()
+    want_opt = jax.device_get(sess._t.canonicalize_opt_state(
+        sess.state["opt_state"]))
+    path = Saver(sess).save_sharded(str(tmp_path / "m"))
+
+    sess2 = _session(SPEC4, opt=opt, sharded=sharded)
+    report = reshard_restore(sess2, path, batch_shapes=BATCH_SHAPES)
+    assert sess2.step == 3
+    assert not report.errors  # Y/X verification gate ran clean
+    _exact(sess2.params(), want)
+    got_opt = jax.device_get(sess2._t.canonicalize_opt_state(
+        sess2.state["opt_state"]))
+    _exact(got_opt, want_opt)
+    m = sess2.run(BATCH)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_reshard_grow_back(tmp_path):
+    """R' > R also works (capacity returning): 4 -> 8."""
+    sess = _session(SPEC4, opt="adam", sharded="sharded")
+    for _ in range(2):
+        sess.run(BATCH)
+    want = sess.params()
+    path = Saver(sess).save_sharded(str(tmp_path / "g"))
+    sess2 = _session(SPEC8, opt="adam", sharded="sharded")
+    reshard_restore(sess2, path, batch_shapes=BATCH_SHAPES)
+    _exact(sess2.params(), want)
+    sess2.run(BATCH)
+
+
+def test_reshard_canonical_checkpoint_dispatches_to_saver(tmp_path):
+    """A canonical-layout manifest checkpoint restores through the plain
+    Saver path (R-independent) — same entry point, no reshard program."""
+    sess = _session(SPEC8, opt="adam", sharded="sharded")
+    for _ in range(2):
+        sess.run(BATCH)
+    want = sess.params()
+    path = Saver(sess).save(str(tmp_path / "c"))
+    sess2 = _session(SPEC4, opt="adam", sharded="sharded")
+    report = reshard_restore(sess2, path, batch_shapes=BATCH_SHAPES)
+    assert not report.errors
+    _exact(sess2.params(), want)
+
+
+def test_reshard_cross_update_mode(tmp_path):
+    """Sharded-update checkpoint restores onto a REPLICATED-update
+    session (and the other way): the canonical intermediate decouples
+    the two layouts."""
+    sess = _session(SPEC8, opt="adam", sharded="sharded")
+    for _ in range(2):
+        sess.run(BATCH)
+    want = sess.params()
+    path = Saver(sess).save_sharded(str(tmp_path / "x"))
+    sess2 = _session(SPEC4, opt="adam", sharded="replicated")
+    reshard_restore(sess2, path, batch_shapes=BATCH_SHAPES)
+    _exact(sess2.params(), want)
+
+    sess3 = _session(SPEC4, opt="adam", sharded="replicated")
+    for _ in range(2):
+        sess3.run(BATCH)
+    p3 = Saver(sess3).save_sharded(str(tmp_path / "y"))
+    sess4 = _session(SPEC8, opt="adam", sharded="sharded")
+    reshard_restore(sess4, p3, batch_shapes=BATCH_SHAPES)
+    _exact(sess4.params(), sess3.params())
+
+
+def test_ps_flat_shard_reshard(tmp_path):
+    """The PS family's weight-update sharding (flat 1/R shards since the
+    seed) reshards through the same path."""
+    ad = AutoDist(resource_spec=SPEC8, strategy_builder=PS())
+    sess = ad.distribute(_loss, _params(), optax.adam(0.05))
+    for _ in range(2):
+        sess.run(BATCH)
+    want = sess.params()
+    path = Saver(sess).save_sharded(str(tmp_path / "p"))
+    ad2 = AutoDist(resource_spec=SPEC4, strategy_builder=PS())
+    sess2 = ad2.distribute(_loss, _params(), optax.adam(0.05))
+    reshard_restore(sess2, path, batch_shapes=BATCH_SHAPES)
+    _exact(sess2.params(), want)
+
+
+# -- the regression guard ---------------------------------------------------
+
+def test_mismatched_r_without_reshard_raises(tmp_path):
+    """Restoring an R=8 sharded-update (update-space) checkpoint onto an
+    R=4 session WITHOUT reshard must refuse with a clear error naming the
+    reshard entry point — not restore garbage, not crash obscurely."""
+    sess = _session(SPEC8, opt="adam", sharded="sharded")
+    sess.run(BATCH)
+    path = Saver(sess).save_sharded(str(tmp_path / "r"))
+    sess2 = _session(SPEC4, opt="adam", sharded="sharded")
+    with pytest.raises(ValueError) as e:
+        Saver(sess2).restore(path)
+    msg = str(e.value)
+    assert "reshard_restore" in msg
+    assert "num_replicas 8 != 4" in msg
+
+
+def test_hierarchy_change_without_reshard_raises(tmp_path):
+    """Same R but a different mesh factorization/hierarchy also refuses:
+    the EF-residual and shard layouts are factorization-bound."""
+    sess = _session(SPEC_2x4, opt="adam", sharded="sharded",
+                    hierarchy="two_level")
+    sess.run(BATCH)
+    path = Saver(sess).save_sharded(str(tmp_path / "h"))
+    sess2 = _session(SPEC8, opt="adam", sharded="sharded")
+    with pytest.raises(ValueError, match="reshard_restore"):
+        Saver(sess2).restore(path)
+
+
+def test_reshard_requires_manifest(tmp_path):
+    sess = _session(SPEC8)
+    sess.run(BATCH)
+    path = Saver(sess).save(str(tmp_path / "n"))
+    os.remove(manifest_path(path))
+    sess2 = _session(SPEC4)
+    with pytest.raises(FileNotFoundError, match="manifest"):
+        reshard_restore(sess2, path)

@@ -1,0 +1,263 @@
+"""CI gate: fault-injection (chaos) drills on the CPU mesh (``make chaos``,
+wired into ``make check``; docs/elasticity.md).
+
+Asserts the elastic-training acceptance contract end to end, no TPU needed:
+
+1. **kill-one-worker / resume-shrunk** — a 2-node (8-way) run loses a
+   worker mid-training via the ``AUTODIST_CHAOS`` contract; the trainer
+   drains, writes a manifest checkpoint, re-plans via AutoStrategy on the
+   surviving 4-way topology, reshards the checkpoint (params AND the 1/R
+   flat sharded-update optimizer state, across a two_level -> flat
+   hierarchy change), passes the Y/X verification gate before the new
+   epoch's first step, and continues with the loss continuous across the
+   boundary.
+2. **preempt / resume-unchanged** — a subprocess training run is SIGTERMed
+   mid-run; it drains, writes a preemption manifest checkpoint and exits 0;
+   a resume on the identical topology restores it bitwise and finishes
+   with parameters exactly equal to an uninterrupted run.
+3. **delay (straggler) injection** — an injected host stall must not
+   perturb the run's membership (no spurious re-plan).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+# CPU mesh, no real accelerator needed — must precede any jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+TOTAL_STEPS = 6
+KILL_AT = 3
+
+_CHILD_SCRIPT = """
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+sys.path.insert(0, {repo!r})
+import numpy as np, jax.numpy as jnp, optax
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce
+
+def loss(p, b):
+    return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+def params():
+    r = np.random.RandomState(7)
+    return {{"w": jnp.asarray(r.randn(12, 3), jnp.float32)}}
+
+marker = {marker!r}
+def batch_fn(step):
+    if step >= 2 and not os.path.exists(marker):
+        open(marker, "w").write(str(step))
+    time.sleep(0.05)  # widen the window a SIGTERM can land in
+    r = np.random.RandomState(step)
+    return {{"x": r.randn(16, 12).astype(np.float32),
+            "y": r.randn(16, 3).astype(np.float32)}}
+
+ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(8),
+              strategy_builder=AllReduce(sharded_update="sharded"))
+sess = ad.distribute(loss, params(), optax.adam(0.05))
+sess.fit(batch_fn, steps=1000, preempt_checkpoint_dir={ckpt_dir!r})
+print("CHILD_DONE preempted=%s step=%d" % (sess.preempted, sess.step))
+"""
+
+
+def check_kill_one_worker():
+    """Scenario 1: worker death -> shrink -> re-plan -> reshard -> verify
+    -> loss-continuous resume."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.checkpoint.manifest import load_manifest
+    from autodist_tpu.elastic import ElasticTrainer
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    spec = ResourceSpec(resource_info={"nodes": [
+        {"address": "10.0.0.1", "chips": [0, 1, 2, 3], "chief": True,
+         "network_bandwidth": 100},
+        {"address": "10.0.0.2", "chips": [0, 1, 2, 3],
+         "network_bandwidth": 100}]})
+
+    def loss(p, b):
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - b["y"]) ** 2)
+
+    r = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(r.randn(24, 16), jnp.float32),
+              "b1": jnp.zeros((16,), jnp.float32),
+              "w2": jnp.asarray(r.randn(16, 4), jnp.float32)}
+
+    def batch_fn(step):
+        rr = np.random.RandomState(step)
+        return {"x": rr.randn(32, 24).astype(np.float32),
+                "y": rr.randn(32, 4).astype(np.float32)}
+
+    with tempfile.TemporaryDirectory() as d:
+        builder = AutoStrategy(candidates=[
+            AllReduce(sharded_update="sharded"),
+            AllReduce(hierarchy="two_level", sharded_update="sharded"),
+            AllReduce()], flops_per_example=1e6)
+        trainer = ElasticTrainer(
+            spec, builder, loss, params, optax.adam(0.05),
+            checkpoint_dir=d, chaos=f"kill_worker@{KILL_AT}")
+        sess = trainer.fit(batch_fn, steps=TOTAL_STEPS)
+
+        assert trainer.replans == 1, trainer.replans
+        assert trainer.epoch == 1, trainer.epoch
+        assert sess.step == TOTAL_STEPS, sess.step
+        # the shrunk session really runs on half the devices
+        assert sess._t.num_replicas == 4, sess._t.num_replicas
+        # the epoch-boundary checkpoint carried the manifest + sharded
+        # opt state of the OLD topology
+        m = load_manifest(os.path.join(d, "elastic_ckpt"))
+        assert m["layout"] == "update_space" and m["num_replicas"] == 8, m
+        assert m["sharded_update"] is True, m
+        # loss continuity across the epoch boundary: the resharded state
+        # continues the SAME descent (no re-init cliff)
+        losses = {(e, s): l for e, s, l in trainer.history}
+        pre = losses[(0, KILL_AT)]
+        post = losses[(1, KILL_AT + 1)]
+        assert np.isfinite(pre) and np.isfinite(post), (pre, post)
+        assert abs(post - pre) <= max(0.5 * abs(pre), 1.0), (pre, post)
+        return {"replans": trainer.replans, "epoch": trainer.epoch,
+                "saved_R": m["num_replicas"], "restored_R": 4,
+                "loss_pre": pre, "loss_post": post}
+
+
+def check_preempt_resume():
+    """Scenario 2: SIGTERM a training subprocess mid-run; it must write a
+    manifest checkpoint and exit 0; a same-topology resume is bitwise."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.checkpoint.manifest import load_manifest
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    def params():
+        r = np.random.RandomState(7)
+        return {"w": jnp.asarray(r.randn(12, 3), jnp.float32)}
+
+    def batch_fn(step):
+        r = np.random.RandomState(step)
+        return {"x": r.randn(16, 12).astype(np.float32),
+                "y": r.randn(16, 3).astype(np.float32)}
+
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "ready")
+        script = os.path.join(d, "train_child.py")
+        with open(script, "w") as f:
+            f.write(_CHILD_SCRIPT.format(repo=_REPO, marker=marker,
+                                         ckpt_dir=d))
+        env = dict(os.environ)
+        env.pop("AUTODIST_CHAOS", None)
+        child = subprocess.Popen([sys.executable, script], env=env)
+        deadline = time.monotonic() + 180
+        while not os.path.exists(marker):
+            if child.poll() is not None or time.monotonic() > deadline:
+                raise AssertionError(
+                    f"chaos child never reached step 2 (exit "
+                    f"{child.poll()})")
+            time.sleep(0.05)
+        child.send_signal(signal.SIGTERM)
+        rc = child.wait(timeout=120)
+        assert rc == 0, f"preempted child exited {rc}, want 0 (clean drain)"
+        ckpt = os.path.join(d, "preempt_ckpt")
+        m = load_manifest(ckpt)
+        assert m is not None and m["layout"] == "update_space", m
+        k = int(m["step"])
+        assert k >= 2, k
+
+        # resume on the identical topology: fit() picks the preemption
+        # checkpoint up itself and the restore is bitwise
+        total = k + 3
+        ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(8),
+                      strategy_builder=AllReduce(sharded_update="sharded"))
+        resumed = ad.distribute(loss, params(), optax.adam(0.05))
+        resumed.fit(batch_fn, steps=total, preempt_checkpoint_dir=d)
+        assert resumed.step == total
+
+        ad2 = AutoDist(resource_spec=ResourceSpec.from_num_chips(8),
+                       strategy_builder=AllReduce(sharded_update="sharded"))
+        reference = ad2.distribute(loss, params(), optax.adam(0.05))
+        reference.fit(batch_fn, steps=total)
+        got, want = resumed.params(), reference.params()
+        for key in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[key]), np.asarray(want[key]),
+                err_msg=f"{key}: preempt-resume is not bit-compatible")
+        return {"preempted_at": k, "resumed_to": total, "bitwise": True}
+
+
+def check_delay_injection():
+    """Scenario 3: an injected straggler stall must not change
+    membership (no re-plan, epoch stays 0) and the run completes."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.elastic import ElasticTrainer
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    r = np.random.RandomState(7)
+    params = {"w": jnp.asarray(r.randn(12, 3), jnp.float32)}
+
+    def batch_fn(step):
+        rr = np.random.RandomState(step)
+        return {"x": rr.randn(16, 12).astype(np.float32),
+                "y": rr.randn(16, 3).astype(np.float32)}
+
+    with tempfile.TemporaryDirectory() as d:
+        trainer = ElasticTrainer(
+            ResourceSpec.from_num_chips(8), AllReduce(), loss, params,
+            optax.sgd(0.05), checkpoint_dir=d, chaos="delay@2:0.05")
+        sess = trainer.fit(batch_fn, steps=4)
+        assert trainer.replans == 0 and trainer.epoch == 0
+        assert sess.step == 4
+        return {"steps": 4, "replans": 0}
+
+
+def main():
+    t0 = time.monotonic()
+    results = {}
+    for name, fn in (("kill_one_worker", check_kill_one_worker),
+                     ("preempt_resume", check_preempt_resume),
+                     ("delay_injection", check_delay_injection)):
+        t = time.monotonic()
+        results[name] = fn()
+        print(f"chaos_check: {name} OK ({time.monotonic() - t:.1f}s) -> "
+              f"{results[name]}")
+    print(f"chaos_check: ALL SCENARIOS OK ({time.monotonic() - t0:.1f}s)")
+    print(json.dumps(results, indent=1, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,14 @@ codebase:
         compiles WITHOUT the engine's compiler options.  Scoped to
         ``autodist_tpu/`` and ``tools/``; ``kernel/xla_options.py``
         itself (the blessed probe site) is exempt.
+  AD02  bare ``subprocess`` call in ``autodist_tpu/`` outside
+        ``cluster.py``: worker-process management must route through the
+        Cluster layer (launch retry/backoff, TERM->KILL escalation,
+        monitor reaping, membership epochs — docs/elasticity.md); a bare
+        Popen elsewhere leaks zombies on interrupted runs and bypasses
+        the fault-tolerance telemetry.  Non-process-management uses
+        (e.g. a build helper shelling out to make) carry ``# noqa``
+        with a justification.
 
 Exit code 1 when any finding is reported.
 """
@@ -45,6 +53,16 @@ def _ad01_applies(path):
         and p.name != _AD01_EXEMPT
 
 
+# AD02 applies inside the package only; cluster.py IS the process-
+# management layer (tools/ and tests drive subprocesses legitimately)
+_AD02_EXEMPT = "cluster.py"
+
+
+def _ad02_applies(path):
+    p = Path(path)
+    return "autodist_tpu" in p.parts and p.name != _AD02_EXEMPT
+
+
 class Checker(ast.NodeVisitor):
     def __init__(self, path, source):
         self.path = path
@@ -54,6 +72,7 @@ class Checker(ast.NodeVisitor):
         self.source = source
         self._depth = 0        # function nesting: local imports aren't tracked
         self._all_names = set()  # strings listed in __all__
+        self._subprocess_names = set()  # names imported from subprocess
 
     def add(self, lineno, code, msg):
         self.findings.append((self.path, lineno, code, msg))
@@ -76,6 +95,8 @@ class Checker(ast.NodeVisitor):
         for a in node.names:
             if a.name == "*":
                 continue
+            if node.module == "subprocess":  # AD02 tracks the aliases
+                self._subprocess_names.add(a.asname or a.name)
             self._record_import(a.asname or a.name, node.lineno)
 
     def visit_Name(self, node):
@@ -182,6 +203,20 @@ class Checker(ast.NodeVisitor):
                      "through kernel/xla_options.py (compile_lowered / "
                      "compiler_options_for) so the engine's compiler "
                      "options apply")
+        # AD02: subprocess.<fn>(...) or a name imported FROM subprocess
+        if _ad02_applies(self.path):
+            bare = (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "subprocess")
+            from_import = (isinstance(f, ast.Name)
+                           and f.id in self._subprocess_names)
+            if bare or from_import:
+                self.add(node.lineno, "AD02",
+                         "bare subprocess call outside cluster.py: "
+                         "worker-process management must route through "
+                         "the Cluster layer (retry/backoff, TERM->KILL "
+                         "escalation, monitor reaping); '# noqa' with a "
+                         "justification for non-process-management uses")
         self.generic_visit(node)
 
     def visit_Compare(self, node):
