@@ -64,14 +64,19 @@ verify:
 	$(PY) tools/verify_strategy.py records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --selftest
 
-# HLO communication audit (docs/analysis.md "HLO audit"): lower every
-# recorded strategy's step and diff the REALIZED collective schedule
-# against the strategy's plan (X-codes) — an implicit-reshard all_to_all
-# or a dropped sync collective fails the gate; the seeded reshard case
-# (--selftest) must be caught as X001
+# HLO audits (docs/analysis.md): lower every recorded strategy's step
+# and diff the REALIZED program against the strategy's plan — the
+# communication audit (X-codes: an implicit-reshard all_to_all or a
+# dropped sync collective fails the gate; the seeded reshard case must
+# be caught as X001) and the compute audit (F-codes: every target must
+# emit its F006 FLOP table with zero F001 realized-FLOP blowups; the
+# seeded remat case must be caught as F002, the seeded dropped-donation
+# case as F004)
 audit:
 	$(PY) tools/verify_strategy.py --hlo records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --hlo --selftest
+	$(PY) tools/verify_strategy.py --compute records/cpu_mesh/*.json
+	$(PY) tools/verify_strategy.py --compute --selftest
 
 # live telemetry gate (docs/observability.md): a 5-step CPU-mesh session
 # with telemetry on must emit a schema-valid JSONL manifest with per-step

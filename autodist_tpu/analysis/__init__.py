@@ -13,6 +13,10 @@ pluggable passes producing a severity-ranked :class:`Report`:
 - ``hlo-audit``    — LOWERED tier: the realized collective schedule of
   the step's StableHLO lowering diffed against the strategy's intended
   plan (implicit reshards, missing syncs, per-hop byte drift — X-codes)
+- ``compute-audit`` — LOWERED tier: the realized FLOP table of the same
+  lowering diffed against the jaxpr's model FLOPs (recompute, bf16
+  eligibility, dropped donations, elementwise share, predicted MFU
+  ceiling — F-codes)
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on

@@ -18,6 +18,12 @@ EXPECTED_ERROR_CODES = ("C001", "S011", "H001")
 # the implicit-reshard case (build_reshard_case) must be caught by the
 # LOWERED tier — the HLO communication audit — as exactly this code
 EXPECTED_AUDIT_ERROR_CODE = "X001"
+# the remat-everything case (build_recompute_case) is clean under every
+# other pass and caught ONLY by the compute audit as this code; the
+# bf16-stats case (build_dropped_donation_case) must fire the lowered
+# donation check
+EXPECTED_RECOMPUTE_CODE = "F002"
+EXPECTED_DONATION_CODE = "F004"
 
 
 def build_rejected_case(num_chips=8):
@@ -96,6 +102,103 @@ def build_reshard_case(num_chips=8):
             jnp.sum(jnp.square(x)) for x in jax.tree.leaves(p))
 
     item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 16, d), "float32")},
+        hbm_bytes_per_device=16 * 1024 ** 3,
+    )
+
+
+def build_recompute_case(num_chips=8):
+    """The seeded RECOMPUTE case for the HLO compute audit
+    (``tools/verify_strategy.py --compute --selftest``).
+
+    A small MLP trained under a remat-everything policy
+    (``jax.checkpoint`` around the whole forward): the backward re-runs
+    both matmuls, so the lowering carries each forward dot TWICE with an
+    identical signature.  Everything else is deliberately clean — the
+    contractions run in bf16 under a master-weight policy (no F003), the
+    batch is large enough that contraction FLOPs dominate the optimizer
+    epilogue (no F005), the sync plan matches (no X-codes), donations
+    all realize (no F004/D-codes), and ``jaxpr_flops`` counts the remat
+    sub-jaxprs so realized == model (no F001).  ONLY the duplicated-
+    signature detector sees the waste: ``F002``
+    (:data:`EXPECTED_RECOMPUTE_CODE`), with the remat multiplicity and
+    the HBM-saved-vs-FLOPs-paid estimate.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    d = 256
+    params = {"w1": jnp.zeros((d, d)), "w2": jnp.zeros((d, d))}
+
+    @jax.checkpoint   # remat-everything: nothing saved, everything re-run
+    def forward(p, x):
+        h = jnp.tanh(x.astype(jnp.bfloat16) @ p["w1"].astype(jnp.bfloat16))
+        return jnp.tanh(h @ p["w2"].astype(jnp.bfloat16))
+
+    def loss_fn(p, batch):
+        y = forward(p, batch["x"]).astype(jnp.float32)
+        return jnp.mean(jnp.square(y))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 16, d), "float32")},
+        hbm_bytes_per_device=16 * 1024 ** 3,
+    )
+
+
+def build_dropped_donation_case(num_chips=8):
+    """The seeded DROPPED-DONATION case for the HLO compute audit's
+    lowered-level donation check.
+
+    The model keeps running statistics in ``mutable_state`` (f32) but
+    the loss updates them in bf16 — the classic mixed-precision slip.
+    The engine donates the whole state (``donate_argnums=(0,)``), yet
+    XLA's ``input_output_alias`` needs matching shape+dtype, so the
+    stats buffer's donation can never be realized: a full copy per
+    step.  The jaxpr-tier donation pass sees the same shape mismatch as
+    a D002 WARNING; the lowered tier proves it from the module text —
+    a ``jax.buffer_donor`` arg with no type-compatible output — as
+    ``F004`` (:data:`EXPECTED_DONATION_CODE`).
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    d = 256
+    params = {"w": jnp.zeros((d, d))}
+    mutable = {"ema": jnp.zeros((7,), jnp.float32)}
+
+    def loss_fn(p, mut, batch):
+        h = jnp.tanh(batch["x"].astype(jnp.bfloat16)
+                     @ p["w"].astype(jnp.bfloat16))
+        # the bug: stats updated in bf16 while the state slot is f32 —
+        # the donated f32 buffer has no bf16-typed output to alias
+        new_ema = (0.9 * mut["ema"]
+                   + 0.1 * jnp.mean(h).astype(jnp.float32)
+                   ).astype(jnp.bfloat16)
+        return jnp.mean(jnp.square(h.astype(jnp.float32))), {"ema": new_ema}
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3),
+                     mutable_state=mutable)
     spec = ResourceSpec.from_num_chips(num_chips)
     strategy = AllReduce().build(item, spec)
     return dict(

@@ -179,19 +179,38 @@ def _parse_op(kind, buf, trailer_line) -> Optional[CollectiveOp]:
     return op
 
 
-def extract_collectives(text: str) -> List[CollectiveOp]:
-    """Parse every collective out of a lowered StableHLO module.
+@dataclasses.dataclass
+class RawOp:
+    """One matched op straight off the module text, before any
+    kind-specific parsing: the shared currency between this module's
+    collective audit and the compute audit
+    (:mod:`autodist_tpu.analysis.compute_audit`)."""
+
+    kind: str
+    text: str           # the op's full text (regions included)
+    trailer: str        # the line carrying the trailing function type
+    function: str = ""
+    in_loop: bool = False     # executes inside a while (scan) body
+    count: float = 1.0        # static multiplicity (call sites x trips)
+
+
+def walk_module_ops(text: str, op_re,
+                    single_line_kinds=frozenset()) -> List[RawOp]:
+    """Walk a lowered StableHLO module and return every op matching
+    ``op_re`` (group 1 = the op kind) with its loop/call-graph placement.
 
     Handles the generic-form ops JAX emits (attributes in ``<{...}>``,
-    reduction regions for ``all_reduce``/``reduce_scatter``), recovers
-    ``replica_groups`` / ``source_target_pairs``, per-op operand/result
-    bytes from the trailing function type, and loop placement: scan
-    bodies are OUTLINED into private functions called from
-    ``stablehlo.while`` regions, so a call graph is built and each op's
-    static multiplicity is the product of its call-site counts and the
-    enclosing loops' trip counts (trip counts read best-effort from the
-    canonical ``compare LT iterArg, <const>`` loop condition; unknown
+    reduction regions for ``all_reduce``/``reduce_scatter``) and loop
+    placement: scan bodies are OUTLINED into private functions called
+    from ``stablehlo.while`` regions, so a call graph is built and each
+    op's static multiplicity is the product of its call-site counts and
+    the enclosing loops' trip counts (trip counts read best-effort from
+    the canonical ``compare LT iterArg, <const>`` loop condition; unknown
     trips count as 1 but still set ``in_loop``).
+
+    ``single_line_kinds``: op kinds whose pretty form carries a bare
+    ``: tensor<...>`` type (elementwise ops — no `` -> `` arrow), parsed
+    from their own line instead of waiting for an arrowed trailer.
     """
     funcs: Dict[str, dict] = {}
     order: List[str] = []
@@ -223,10 +242,11 @@ def extract_collectives(text: str) -> List[CollectiveOp]:
             pending["buf"].append(line)
             pending["depth"] += opens - closes
             if pending["depth"] <= 0 and " -> " in line:
-                op = _parse_op(pending["kind"], "\n".join(pending["buf"]),
-                               line)
-                if op is not None:
-                    pending["attach"](op)
+                fn = pending["fn"]
+                fn["ops"].append(RawOp(
+                    kind=pending["kind"], text="\n".join(pending["buf"]),
+                    trailer=line, function=fn["name"],
+                    in_loop=pending["in_loop"], count=pending["mult"]))
                 pending = None
             depth += opens - closes
             continue
@@ -245,26 +265,19 @@ def extract_collectives(text: str) -> List[CollectiveOp]:
                     t = int(tm.group(1))
                     whiles[-1]["trip"] = max(whiles[-1]["trip"] or 0, t)
 
-        om = _OP_RE.search(line)
+        om = op_re.search(line)
         if om and cur is not None:
-            in_loop = bool(whiles)
-            mult = loop_mult()
-            fn = cur
-
-            def attach(op, fn=fn, in_loop=in_loop, mult=mult):
-                op.function = fn["name"]
-                op.in_loop = in_loop
-                op.count = mult
-                fn["ops"].append(op)
-
+            kind = om.group(1)
             net = opens - closes
-            if net <= 0 and " -> " in line:
-                op = _parse_op(om.group(1), line, line)
-                if op is not None:
-                    attach(op)
+            if kind in single_line_kinds or (net <= 0 and " -> " in line):
+                cur["ops"].append(RawOp(
+                    kind=kind, text=line, trailer=line,
+                    function=cur["name"], in_loop=bool(whiles),
+                    count=loop_mult()))
             else:
-                pending = {"kind": om.group(1), "buf": [line],
-                           "depth": net, "attach": attach}
+                pending = {"kind": kind, "buf": [line], "depth": net,
+                           "fn": cur, "in_loop": bool(whiles),
+                           "mult": loop_mult()}
         elif cur is not None:
             cm = _CALL_RE.search(line)
             if cm:
@@ -310,6 +323,23 @@ def extract_collectives(text: str) -> List[CollectiveOp]:
             op.count = op.count * max(1.0, m)
             op.in_loop = op.in_loop or looped[name]
             ops.append(op)
+    return ops
+
+
+def extract_collectives(text: str) -> List[CollectiveOp]:
+    """Parse every collective out of a lowered StableHLO module (the
+    shared walker :func:`walk_module_ops` + ``replica_groups`` /
+    ``source_target_pairs`` recovery and per-op operand/result bytes
+    from the trailing function type)."""
+    ops = []
+    for raw in walk_module_ops(text, _OP_RE):
+        op = _parse_op(raw.kind, raw.text, raw.trailer)
+        if op is None:
+            continue
+        op.function = raw.function
+        op.in_loop = raw.in_loop
+        op.count = raw.count
+        ops.append(op)
     return ops
 
 

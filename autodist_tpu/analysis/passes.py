@@ -69,12 +69,28 @@ Finding codes (stable; tests and tools match on them):
                says once-per-step
   X006 INFO    realized-vs-intended wire-byte summary (carries the
                machine-readable table in Finding.data)
+  F000 INFO    compute audit skipped (no lowered module / no trace)
+  F001 ERROR   realized contraction FLOPs exceed the model FLOPs
+               (jaxpr count) beyond tolerance, with attribution table
+  F002 WARNING duplicated expensive-op signature (recompute): remat
+               multiplicity + HBM-saved-vs-FLOPs-paid estimate
+  F003 WARNING f32 contractions eligible for bf16 under a master-weight
+               policy (mixed-precision recipe)
+  F004 WARNING donation declared but not realized at lowering (no
+               input_output_alias-eligible attribute / no
+               type-compatible output for the deferred donor)
+  F005 WARNING batch-stats/elementwise share of the realized work above
+               threshold (MXU idles through HBM-bound epilogues)
+  F006 INFO    machine-readable compute table + predicted MFU ceiling
+               (carried in Finding.data)
   T001 ERROR   tracing the strategy's train step failed
   T002 INFO    trace skipped (trace passes did not run)
 
-The X-codes form the LOWERED tier (:mod:`autodist_tpu.analysis.hlo_audit`):
-they run over the StableHLO text of the transformed step's lowering — the
-realized collective schedule — rather than the jaxpr.
+The X-codes and F-codes form the LOWERED tier
+(:mod:`autodist_tpu.analysis.hlo_audit` — the realized collective
+schedule — and :mod:`autodist_tpu.analysis.compute_audit` — the realized
+FLOPs + MFU ceiling): they run over the StableHLO text of the
+transformed step's lowering rather than the jaxpr.
 """
 import numpy as np
 
@@ -724,6 +740,15 @@ def hlo_audit_pass(ctx):
     return _run(ctx)
 
 
+def compute_audit_pass(ctx):
+    """Lowered-tier pass: realized FLOPs vs model FLOPs, recompute /
+    precision / donation-realization audit, and the predicted MFU
+    ceiling (:mod:`autodist_tpu.analysis.compute_audit`)."""
+    from autodist_tpu.analysis.compute_audit import compute_audit_pass as _run
+
+    return _run(ctx)
+
+
 PASS_REGISTRY = {
     "sharding": sharding_pass,
     "hierarchy": hierarchy_pass,
@@ -732,11 +757,13 @@ PASS_REGISTRY = {
     "donation": donation_pass,
     "hbm-traced": hbm_traced_pass,
     "hlo-audit": hlo_audit_pass,
+    "compute-audit": compute_audit_pass,
 }
 
 STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
 TRACE_PASSES = ("collectives", "donation", "hbm-traced")
 # passes over the LOWERED StableHLO module (the realized collective
-# schedule); opt-in via verify_strategy(passes=...), the CLI's --hlo, the
-# AOT verify gate, and AutoStrategy's top-candidate audit
-LOWERED_PASSES = ("hlo-audit",)
+# schedule + the realized compute table); opt-in via
+# verify_strategy(passes=...), the CLI's --hlo/--compute, the AOT verify
+# gate, and AutoStrategy's top-candidate audit
+LOWERED_PASSES = ("hlo-audit", "compute-audit")

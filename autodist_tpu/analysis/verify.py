@@ -57,6 +57,9 @@ class AnalysisContext:
     lowered_source: str = ""
     predicted_comm_bytes: Optional[dict] = None
     audit_summary: Optional[dict] = None
+    # the compute audit's machine-readable table (the F006 payload:
+    # model/realized FLOPs, per-region attribution, predicted MFU ceiling)
+    compute_summary: Optional[dict] = None
 
 
 def _mesh_info(strategy, resource_spec, mesh):

@@ -147,6 +147,56 @@ def _jaxpr_of(j):
     return j.jaxpr if hasattr(j, "jaxpr") and not hasattr(j, "eqns") else j
 
 
+# -- single-source FLOP accounting -----------------------------------------
+# Every FLOP number in the engine routes through these three rules
+# (tools/lint.py AD03 rejects ad-hoc shape-product FLOP arithmetic
+# elsewhere): the jaxpr counter below and the HLO-level counter
+# (analysis/compute_audit.py) share them, which is what makes their
+# realized-vs-model comparison meaningful.
+
+
+def dot_flops(out_shape, contract_size):
+    """Matmul rule: ``2 * prod(out) * K`` multiply-accumulates for a
+    contraction of size ``K`` (batch dims ride in ``out_shape``)."""
+    n = 1.0
+    for d in out_shape:
+        n *= int(d)
+    return 2.0 * n * float(max(1, contract_size))
+
+
+def conv_flops(out_shape, in_channels, kernel_spatial):
+    """Convolution rule: ``2 * prod(out) * C_in_per_group * prod(kernel)``
+    (``in_channels`` is the rhs 'i' dim — already per feature group)."""
+    k = 1.0
+    for d in kernel_spatial:
+        k *= int(d)
+    n = 1.0
+    for d in out_shape:
+        n *= int(d)
+    return 2.0 * n * float(max(1, in_channels)) * k
+
+
+def elementwise_flops(out_shape):
+    """One op per output element — the F005 batch-stats/elementwise
+    share's unit (NOT part of the model-FLOPs MFU numerator)."""
+    n = 1.0
+    for d in out_shape:
+        n *= int(d)
+    return n
+
+
+def predicted_mfu_ceiling(model_flops, realized_flops,
+                          mxu_eff=DEFAULT_MXU_EFF):
+    """Best MFU the lowered program can reach: the calibrated MXU
+    efficiency discounted by the lowering's FLOP overhead — MFU counts
+    MODEL flops, the chip executes REALIZED flops, so
+    ``ceiling = mxu_eff * model / realized``.  With no contraction work
+    (or no model count) the ceiling is the raw efficiency."""
+    if not model_flops or not realized_flops or realized_flops <= 0:
+        return float(mxu_eff)
+    return float(mxu_eff) * min(1.0, float(model_flops) / float(realized_flops))
+
+
 def jaxpr_flops(jaxpr):
     """Conservative FLOP count of a (closed) jaxpr: matmul + convolution
     math, control flow folded in structurally (``scan`` multiplies by its
@@ -159,8 +209,6 @@ def jaxpr_flops(jaxpr):
     carries per-device shapes — so the returned count is per-device work
     per step (forward + backward both appear in a grad-traced program).
     """
-    import numpy as np
-
     j = _jaxpr_of(jaxpr)
     total = 0.0
     for eqn in j.eqns:
@@ -172,8 +220,7 @@ def jaxpr_flops(jaxpr):
             contract = 1
             for d in lc:
                 contract *= lhs[d]
-            total += 2.0 * float(np.prod(out)) * contract if out \
-                else 2.0 * contract
+            total += dot_flops(out, contract)
         elif name == "conv_general_dilated":
             rhs = eqn.invars[1].aval.shape
             out = eqn.outvars[0].aval.shape
@@ -184,7 +231,7 @@ def jaxpr_flops(jaxpr):
                 spatial = [rhs[d] for d in rhs_spec[2:]]
             else:  # fallback: assume OIHW-style (out, in, *spatial)
                 in_ch, spatial = rhs[1], rhs[2:]
-            total += 2.0 * float(np.prod(out)) * in_ch * float(np.prod(spatial))
+            total += conv_flops(out, in_ch, spatial)
         elif name == "scan":
             total += float(eqn.params.get("length", 1)) * \
                 jaxpr_flops(eqn.params["jaxpr"])

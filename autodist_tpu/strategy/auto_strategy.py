@@ -81,7 +81,12 @@ class AutoStrategy(StrategyBuilder):
         audited instead — and the winner's realized-vs-intended byte
         table lands in ``last_audit`` (+ telemetry gauges
         ``auto_strategy.audit_{realized,intended}_bytes``) so reports can
-        show intended vs realized vs measured side by side.
+        show intended vs realized vs measured side by side.  The compute
+        audit rides the same lowering: the winner's F006 FLOP table lands
+        in ``last_compute_audit`` and its predicted MFU ceiling in the
+        ``auto_strategy.predicted_mfu_ceiling`` gauge
+        (``tools/telemetry_report.py --compute`` joins it against the
+        measured achieved MFU).
         """
         self._candidates = candidates
         self._flops = flops_per_example
@@ -107,6 +112,7 @@ class AutoStrategy(StrategyBuilder):
         self.last_rejected = None
         self.last_prediction_error = None
         self.last_audit = None
+        self.last_compute_audit = None
 
     def _screen(self, cands, model_item, resource_spec):
         """Verifier feasibility gate: (feasible builders, rejected list)."""
@@ -163,7 +169,13 @@ class AutoStrategy(StrategyBuilder):
         the plan (:mod:`autodist_tpu.analysis.hlo_audit`).  A candidate
         realizing unplanned communication (X001) or dropping planned sync
         (X002) is demoted and the next one audited.  Returns the ranking
-        with demoted candidates removed (raises when none survive)."""
+        with demoted candidates removed (raises when none survive).
+
+        The compute audit rides along on the same lowering: the winner's
+        F006 table lands in ``last_compute_audit`` and its predicted MFU
+        ceiling in the ``auto_strategy.predicted_mfu_ceiling`` gauge, so
+        the screening pipeline prices realized-FLOP waste (recompute,
+        lowering-added work) before a single step runs."""
         from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
                                            StrategyVerificationError,
                                            verify_strategy)
@@ -180,7 +192,18 @@ class AutoStrategy(StrategyBuilder):
             bad = {"X001", "X002"} & set(report.error_codes())
             audit = next((f.data for f in report.findings
                           if f.code == "X006"), None)
+            compute = next((f.data for f in report.findings
+                            if f.code == "F006"), None)
             if not bad:
+                if compute is not None:
+                    from autodist_tpu import telemetry
+
+                    compute = dict(compute)
+                    compute["strategy"] = name
+                    self.last_compute_audit = compute
+                    telemetry.gauge(
+                        "auto_strategy.predicted_mfu_ceiling",
+                        compute["predicted_mfu_ceiling"], strategy=name)
                 if audit is not None:
                     from autodist_tpu.simulator.cost_model import (
                         predicted_comm_bytes)

@@ -533,7 +533,7 @@ def _cpu_proxy(steps=8):
     raw_ms = raw_dt * 1e3
     eng_ms = engine_ms()
     shard_ms = engine_ms(sharded_update="sharded")
-    return {
+    out = {
         "metric": CPU_PROXY_METRIC,
         "value": round(eng_ms / max(raw_ms, 1e-9), 3),
         "unit": "engine_step / raw_jit_step (cpu mesh)",
@@ -546,6 +546,29 @@ def _cpu_proxy(steps=8):
         "note": ("CPU-mesh pipeline proxy — engine dispatch/transform "
                  "overhead only, never a hardware throughput claim"),
     }
+    # the HLO compute audit of the same step (F006: model vs realized
+    # FLOPs + predicted MFU ceiling) — priced from the lowering alone, so
+    # the record keeps a hardware-independent compute story between
+    # hardware windows; best-effort, never fails the proxy
+    try:
+        from autodist_tpu.analysis import verify_strategy
+        from autodist_tpu.model_item import ModelItem
+
+        item = ModelItem(loss, params, opt)
+        spec = ResourceSpec.from_num_chips(n)
+        report = verify_strategy(
+            AllReduce().build(item, spec), item, spec,
+            batch_shapes={"x": ((B, D), "float32"),
+                          "y": ((B, D), "float32")},
+            passes=("compute-audit",))
+        table = next((f.data for f in report.findings
+                      if f.code == "F006"), None)
+        if table:
+            out["compute_audit"] = table
+            out["predicted_mfu_ceiling"] = table["predicted_mfu_ceiling"]
+    except Exception as e:  # the proxy record is the priority
+        out["compute_audit_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 # --------------------------------------------------------------- parent --

@@ -31,6 +31,17 @@ codebase:
         the fault-tolerance telemetry.  Non-process-management uses
         (e.g. a build helper shelling out to make) carry ``# noqa``
         with a justification.
+  AD03  ad-hoc FLOP arithmetic in engine/tool code: a ``prod`` call
+        (``math.prod``/``np.prod``/``jnp.prod``) over tensor ``.shape``s
+        inside a flops-named function or assignment.  FLOP accounting
+        must route through ``simulator/cost_model.py`` (``dot_flops`` /
+        ``conv_flops`` / ``elementwise_flops`` / ``jaxpr_flops``) so the
+        jaxpr-tier model and the HLO-tier compute audit
+        (``analysis/compute_audit.py``) can never drift apart — a local
+        shape-product re-derivation is exactly how a silent 2x slips
+        into an MFU claim.  Scoped to ``autodist_tpu/`` and ``tools/``;
+        ``simulator/cost_model.py`` (the blessed accounting site) is
+        exempt.
 
 Exit code 1 when any finding is reported.
 """
@@ -63,6 +74,17 @@ def _ad02_applies(path):
     return "autodist_tpu" in p.parts and p.name != _AD02_EXEMPT
 
 
+# AD03 shares AD01's engine+tool scope; simulator/cost_model.py IS the
+# single-source FLOP accounting site
+_AD03_EXEMPT = "cost_model.py"
+
+
+def _ad03_applies(path):
+    p = Path(path)
+    return any(part in _AD01_PARTS for part in p.parts) \
+        and p.name != _AD03_EXEMPT
+
+
 class Checker(ast.NodeVisitor):
     def __init__(self, path, source):
         self.path = path
@@ -73,6 +95,7 @@ class Checker(ast.NodeVisitor):
         self._depth = 0        # function nesting: local imports aren't tracked
         self._all_names = set()  # strings listed in __all__
         self._subprocess_names = set()  # names imported from subprocess
+        self._flop_ctx = 0     # AD03: inside a flops-named def/assign
 
     def add(self, lineno, code, msg):
         self.findings.append((self.path, lineno, code, msg))
@@ -120,8 +143,11 @@ class Checker(ast.NodeVisitor):
     def visit_FunctionDef(self, node):
         self._check_defaults(node)
         self._check_unused_locals(node)
+        flop_fn = _ad03_applies(self.path) and "flop" in node.name.lower()
         self._depth += 1
+        self._flop_ctx += flop_fn
         self.generic_visit(node)
+        self._flop_ctx -= flop_fn
         self._depth -= 1
 
     def visit_AsyncFunctionDef(self, node):
@@ -178,7 +204,32 @@ class Checker(ast.NodeVisitor):
                 isinstance(t, ast.Name) for t in node.targets):
             self.add(node.lineno, "E731",
                      "lambda assigned to a name (use 'def')")
+        flop_target = _ad03_applies(self.path) and any(
+            "flop" in getattr(t, "id", "").lower() for t in node.targets)
+        self._flop_ctx += flop_target
         self.generic_visit(node)
+        self._flop_ctx -= flop_target
+
+    # -- AD03: ad-hoc FLOP arithmetic --------------------------------------
+
+    @staticmethod
+    def _is_prod_call(node):
+        """``prod(...)``, ``math.prod(...)``, ``np/jnp/numpy.prod(...)``."""
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "prod":
+            return True
+        return (isinstance(f, ast.Attribute) and f.attr == "prod"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("math", "np", "jnp", "numpy"))
+
+    @staticmethod
+    def _has_shape_operand(call):
+        """Any ``.shape`` attribute anywhere in the call's arguments."""
+        return any(isinstance(n, ast.Attribute) and n.attr == "shape"
+                   for a in call.args + [kw.value for kw in call.keywords]
+                   for n in ast.walk(a))
 
     # -- AD01: bare jax.jit(...).lower() chains ----------------------------
 
@@ -217,6 +268,16 @@ class Checker(ast.NodeVisitor):
                          "the Cluster layer (retry/backoff, TERM->KILL "
                          "escalation, monitor reaping); '# noqa' with a "
                          "justification for non-process-management uses")
+        # AD03: a shape-product inside flops-named code re-derives FLOP
+        # accounting that must come from simulator/cost_model.py
+        if (self._flop_ctx and self._is_prod_call(node)
+                and self._has_shape_operand(node)):
+            self.add(node.lineno, "AD03",
+                     "ad-hoc FLOP arithmetic (shape-product): route FLOP "
+                     "accounting through simulator/cost_model.py "
+                     "(dot_flops/conv_flops/elementwise_flops/"
+                     "jaxpr_flops) so the jaxpr model and the HLO "
+                     "compute audit cannot drift")
         self.generic_visit(node)
 
     def visit_Compare(self, node):

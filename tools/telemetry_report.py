@@ -18,7 +18,14 @@ absent; schema in ``autodist_tpu/telemetry/schema.py``) and reports:
   --json`` output, or an ``AutoStrategy.last_audit`` dump): the HLO
   communication audit's INTENDED vs REALIZED wire bytes per phase, next
   to the cost model's PREDICTED bytes and the run's MEASURED walls — the
-  full plan -> lowering -> hardware chain in one table.
+  full plan -> lowering -> hardware chain in one table,
+- with ``--compute <report.json>`` (the ``tools/verify_strategy.py
+  --compute --json`` output, or an ``AutoStrategy.last_compute_audit``
+  dump): the HLO compute audit's F006 table — model vs realized FLOPs,
+  per-region attribution, recompute — with the PREDICTED MFU ceiling
+  joined against the run's MEASURED achieved MFU: a measured MFU close
+  to the ceiling means the gap is structural (recompute, lowering-added
+  work), not a launch/overlap problem.
 """
 import argparse
 import json
@@ -215,6 +222,79 @@ def load_audit(path):
     return out
 
 
+def load_compute(path):
+    """Extract F006 compute tables from a compute-audit artifact: a
+    ``verify_strategy --compute --json`` report (F006 findings carry the
+    table in ``data``) or a bare ``AutoStrategy.last_compute_audit``
+    dict dump.  Returns ``[(name, table), ...]``."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "realized_flops" in doc:
+        return [(doc.get("strategy", os.path.basename(path)), doc)]
+    out = []
+    for name, report in (doc.items() if isinstance(doc, dict) else []):
+        for finding in report.get("findings", []):
+            if finding.get("code") == "F006" and finding.get("data"):
+                out.append((os.path.basename(name), finding["data"]))
+    return out
+
+
+def _fmt_flops(x):
+    for unit, div in (("TFLOP", 1e12), ("GFLOP", 1e9), ("MFLOP", 1e6),
+                      ("kFLOP", 1e3)):
+        if x >= div:
+            return f"{x / div:.2f}{unit}"
+    return f"{x:.0f}FLOP"
+
+
+def render_compute(computes, summary=None):
+    """Model vs realized FLOPs with the predicted MFU ceiling, joined
+    against the run's measured achieved MFU when a manifest summary is
+    at hand."""
+    lines = []
+    for name, table in computes:
+        model = table.get("model_flops")
+        realized = table.get("realized_flops", 0)
+        ratio = table.get("flop_ratio")
+        lines.append(
+            f"compute audit — {name} "
+            f"({table.get('n_contractions', '?')} contraction(s), "
+            f"{table.get('source', 'lowered module')}):")
+        row = f"  realized {_fmt_flops(realized)}"
+        if model is not None:
+            row += f"  model {_fmt_flops(model)}"
+        if ratio is not None:
+            row += f"  ratio {ratio:.2f}x"
+        lines.append(row)
+        per_region = table.get("per_region") or {}
+        if per_region:
+            lines.append("  per-region: " + ", ".join(
+                f"{r} {_fmt_flops(v)}"
+                for r, v in sorted(per_region.items())))
+        for rc in table.get("recompute", []):
+            lines.append(
+                f"  recompute x{rc.get('multiplicity', '?')}: "
+                f"{rc.get('signature', '?')} "
+                f"(+{_fmt_flops(rc.get('flops_paid', 0))}/step)")
+        ceiling = table.get("predicted_mfu_ceiling")
+        if ceiling is not None:
+            row = (f"  predicted MFU ceiling: {ceiling:.2%} "
+                   f"(mxu_eff {table.get('mxu_eff', 0):.0%} x "
+                   f"model/realized)")
+            if summary and summary.get("mfu_p50") is not None:
+                measured = summary["mfu_p50"]
+                row += f"  — measured MFU p50 {measured:.2%}"
+                if ceiling > 0:
+                    verdict = ("the gap is launch/overlap, not compute"
+                               if measured / ceiling < 0.8 else
+                               "the remaining gap is structural — fix "
+                               "the F-codes, not the schedule")
+                    row += (f" ({measured / ceiling:.0%} of ceiling: "
+                            f"{verdict})")
+            lines.append(row)
+    return "\n".join(lines)
+
+
 def render_audit(audits, summary=None):
     """Intended (plan) vs realized (lowered HLO) vs predicted (cost
     model) wire bytes, next to the measured step wall when a manifest
@@ -260,6 +340,12 @@ def main(argv=None):
                          "output or an AutoStrategy.last_audit dump): show "
                          "intended vs realized vs predicted wire bytes "
                          "next to the measured walls")
+    ap.add_argument("--compute", default=None,
+                    help="compute-audit artifact (verify_strategy "
+                         "--compute --json output or an "
+                         "AutoStrategy.last_compute_audit dump): show the "
+                         "F006 FLOP table and join the predicted MFU "
+                         "ceiling against the measured achieved MFU")
     args = ap.parse_args(argv)
     records = load_manifest(args.path)
     if not records:
@@ -269,12 +355,17 @@ def main(argv=None):
     audits = load_audit(args.audit) if args.audit else []
     if audits:
         summary["hlo_audit"] = {name: table for name, table in audits}
+    computes = load_compute(args.compute) if args.compute else []
+    if computes:
+        summary["compute_audit"] = {name: table for name, table in computes}
     if args.json:
         print(json.dumps(summary, indent=2))
     else:
         print(render(summary))
         if audits:
             print(render_audit(audits, summary))
+        if computes:
+            print(render_compute(computes, summary))
     return 0
 
 

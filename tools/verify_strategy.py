@@ -19,11 +19,18 @@ Targets:
   (:mod:`autodist_tpu.analysis.cases`): asserts the verifier still
   produces its three distinct ERROR findings (C001 deadlock, S011 bad
   mesh axis, H001 HBM overflow).
-- ``--hlo`` — additionally run the lowered-tier HLO communication audit
-  (``make audit``): every target's step is lowered and its REALIZED
-  collective schedule diffed against the strategy's plan (X-codes —
-  implicit reshards are X001 ERRORs); with ``--selftest``, the seeded
-  implicit-reshard case must be caught as X001.
+- ``--hlo`` — additionally run the lowered-tier audits (``make audit``):
+  every target's step is lowered and its REALIZED collective schedule
+  diffed against the strategy's plan (X-codes — implicit reshards are
+  X001 ERRORs) plus the compute audit below; with ``--selftest``, the
+  seeded implicit-reshard case must be caught as X001.
+- ``--compute`` — run the lowered-tier HLO COMPUTE audit (F-codes): the
+  realized FLOP table of each target's lowering is diffed against the
+  jaxpr's model FLOPs — recompute, bf16-eligible f32 contractions,
+  dropped donations, elementwise share, and the predicted MFU ceiling
+  (the F006 table every target must emit); with ``--selftest``, the
+  seeded remat-everything case must be caught as F002 and the seeded
+  dropped-donation case as F004.
 
 Exit status: 0 when every target is free of ERROR findings (and the
 selftest, when requested, fires correctly); 1 otherwise.
@@ -111,9 +118,15 @@ def main(argv=None):
     ap.add_argument("--static-only", action="store_true",
                     help="skip the trace passes (no devices needed at all)")
     ap.add_argument("--hlo", action="store_true",
-                    help="also run the lowered-tier HLO communication "
-                         "audit (X-codes): diff each strategy's realized "
-                         "collective schedule against its plan")
+                    help="also run the lowered-tier HLO audits (X-codes "
+                         "and F-codes): diff each strategy's realized "
+                         "collective schedule and FLOP table against its "
+                         "plan")
+    ap.add_argument("--compute", action="store_true",
+                    help="also run the lowered-tier HLO compute audit "
+                         "(F-codes): realized-vs-model FLOPs, recompute, "
+                         "dtype and donation checks, predicted MFU "
+                         "ceiling; every target must emit its F006 table")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write all reports as JSON to this path")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -124,12 +137,16 @@ def main(argv=None):
     from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
                                        TRACE_PASSES, verify_strategy)
     from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
+                                             EXPECTED_DONATION_CODE,
                                              EXPECTED_ERROR_CODES,
+                                             EXPECTED_RECOMPUTE_CODE,
+                                             build_dropped_donation_case,
+                                             build_recompute_case,
                                              build_rejected_case,
                                              build_reshard_case)
 
-    if args.hlo and args.static_only:
-        ap.error("--hlo needs the traced step; drop --static-only")
+    if (args.hlo or args.compute) and args.static_only:
+        ap.error("--hlo/--compute need the traced step; drop --static-only")
 
     hbm_bytes = int(args.hbm_gib * 1024 ** 3)
     if args.device_kind:
@@ -144,8 +161,13 @@ def main(argv=None):
         passes = STATIC_PASSES
     elif args.hlo:
         passes = STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+    elif args.compute:
+        passes = STATIC_PASSES + TRACE_PASSES + ("compute-audit",)
     else:
         passes = None
+    # with a lowered compute pass selected, every record target must
+    # produce its machine-readable F006 compute table
+    want_f006 = bool(passes) and "compute-audit" in passes
     results = {}
     failed = False
 
@@ -171,6 +193,27 @@ def main(argv=None):
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
         failed = failed or not report.ok
+        if want_f006:
+            f6 = next((f for f in report.findings if f.code == "F006"),
+                      None)
+            if f6 is None:
+                print(f"[ERROR] {os.path.basename(path)}: compute audit "
+                      f"produced no F006 table")
+                failed = True
+            else:
+                # the reconciliation contract: the HLO-level total agrees
+                # with jaxpr_flops within the documented tolerance
+                from autodist_tpu.analysis.compute_audit import (
+                    FLOPS_ABS_SLACK, FLOPS_TOL)
+
+                model = f6.data["model_flops"] or 0.0
+                if abs(f6.data["realized_flops"] - model) > \
+                        model * FLOPS_TOL + FLOPS_ABS_SLACK:
+                    print(f"[ERROR] {os.path.basename(path)}: realized "
+                          f"FLOPs {f6.data['realized_flops']} diverge "
+                          f"from jaxpr model FLOPs {model} beyond "
+                          f"tolerance")
+                    failed = True
 
     for path in args.case:
         case = _load_case_file(path)
@@ -208,6 +251,30 @@ def main(argv=None):
             else:
                 print(f"audit selftest passed: the implicit reshard is "
                       f"{EXPECTED_AUDIT_ERROR_CODE}")
+        if args.compute or args.hlo:
+            # the seeded remat-everything case: clean under every other
+            # pass, caught ONLY by the compute audit as F002 — and the
+            # seeded bf16-stats case, whose dropped donation is F004
+            for label, build, want in (
+                    ("recompute", build_recompute_case,
+                     EXPECTED_RECOMPUTE_CODE),
+                    ("donation", build_dropped_donation_case,
+                     EXPECTED_DONATION_CODE)):
+                report = verify_strategy(passes=passes, **build())
+                results[f"<{label}-selftest>"] = report
+                _print_report(f"compute selftest (expected {want})",
+                              report, args.verbose)
+                got = {f.code for f in report.findings
+                       if int(f.severity) > 0}
+                if want not in got or report.errors:
+                    print(f"[ERROR] compute selftest ({label}): expected "
+                          f"{want} as a WARNING did not fire cleanly "
+                          f"(got {sorted(got)}, "
+                          f"{len(report.errors)} error(s))")
+                    failed = True
+                else:
+                    print(f"compute selftest passed: the {label} case "
+                          f"is {want}")
 
     if args.json_out:
         with open(args.json_out, "w") as f:
