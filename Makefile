@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check chaos check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check chaos check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -85,6 +85,17 @@ audit:
 telemetry-check:
 	$(PY) tools/telemetry_check.py
 
+# runtime timeline gate (docs/observability.md): every records/cpu_mesh
+# strategy runs 5 live CPU-mesh steps with the last captured under
+# jax.profiler.trace and audited by the RUNTIME tier — every strategy
+# must emit its T006 three-way table with zero T001 (exposed comm); the
+# golden fixtures must fire T001 (exposed-comm trace), T002 (skewed
+# two-worker pair) and reconcile the overlapped trace with
+# CostEstimate.overlapped_s (--runtime --selftest)
+timeline-check:
+	$(PY) tools/timeline_check.py
+	$(PY) tools/verify_strategy.py --runtime --selftest
+
 # fault-injection gate (docs/elasticity.md): CPU-mesh chaos drills —
 # kill-one-worker (drain -> manifest checkpoint -> AutoStrategy re-plan on
 # the shrunk topology -> R->R' reshard incl. sharded opt state -> Y/X
@@ -94,9 +105,10 @@ chaos:
 	$(PY) tools/chaos_check.py
 
 # the pre-merge gate: lint + strategy verification + HLO audit + live
-# telemetry + chaos drills (tests/test_analysis.py + test_telemetry.py +
-# test_elastic.py run the same chains, so tier-1 exercises it)
-check: lint verify audit telemetry-check chaos
+# telemetry + runtime timeline + chaos drills (tests/test_analysis.py +
+# test_telemetry.py + test_timeline.py + test_elastic.py run the same
+# chains, so tier-1 exercises it)
+check: lint verify audit telemetry-check timeline-check chaos
 
 clean:
 	$(MAKE) -C native clean

@@ -17,6 +17,10 @@ pluggable passes producing a severity-ranked :class:`Report`:
   lowering diffed against the jaxpr's model FLOPs (recompute, bf16
   eligibility, dropped donations, elementwise share, predicted MFU
   ceiling — F-codes)
+- ``runtime-audit`` — RUNTIME (measured) tier: a ``jax.profiler``
+  chrome-trace capture joined to the intended channels and the cost
+  estimate (exposed comm, unrealized overlap, per-hop measured
+  bandwidth) plus cross-worker straggler skew — T-codes
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on
@@ -26,6 +30,7 @@ See ``docs/analysis.md``.
 from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
                                           StrategyVerificationError)
 from autodist_tpu.analysis.passes import (LOWERED_PASSES, PASS_REGISTRY,  # noqa: F401
-                                          STATIC_PASSES, TRACE_PASSES)
+                                          RUNTIME_PASSES, STATIC_PASSES,
+                                          TRACE_PASSES)
 from autodist_tpu.analysis.verify import (AnalysisContext, verify_strategy,  # noqa: F401
                                           verify_transformer)

@@ -83,14 +83,30 @@ Finding codes (stable; tests and tools match on them):
                threshold (MXU idles through HBM-bound epilogues)
   F006 INFO    machine-readable compute table + predicted MFU ceiling
                (carried in Finding.data)
-  T001 ERROR   tracing the strategy's train step failed
-  T002 INFO    trace skipped (trace passes did not run)
+  T000 INFO    runtime audit skipped (no trace capture available)
+  T001 ERROR   measured exposed-comm fraction beyond the predicted
+               exposure + tolerance (the promised overlap is not
+               happening on the device timeline)
+  T002 ERROR   straggler worker: cross-worker step-wall skew above
+               threshold (names the worker address)
+  T003 WARNING measured per-hop (ICI/DCN) bandwidth below the spec's
+               ``bw`` beyond tolerance
+  T004 WARNING overlap credit priced but not realized in the capture
+  T005 WARNING codec wire savings not realized on the DCN hop
+  T006 INFO    machine-readable predicted-vs-realized-vs-measured table
+               (carried in Finding.data)
+  TR001 ERROR  tracing the strategy's train step failed
+  TR002 INFO   trace skipped (trace passes did not run)
 
 The X-codes and F-codes form the LOWERED tier
 (:mod:`autodist_tpu.analysis.hlo_audit` — the realized collective
 schedule — and :mod:`autodist_tpu.analysis.compute_audit` — the realized
 FLOPs + MFU ceiling): they run over the StableHLO text of the
-transformed step's lowering rather than the jaxpr.
+transformed step's lowering rather than the jaxpr.  The T-codes form the
+RUNTIME (measured) tier (:mod:`autodist_tpu.analysis.runtime_audit`):
+they run over a ``jax.profiler`` chrome-trace capture and the aggregated
+cross-worker manifests, closing the predicted -> statically-realized ->
+measured loop.
 """
 import numpy as np
 
@@ -749,6 +765,17 @@ def compute_audit_pass(ctx):
     return _run(ctx)
 
 
+def runtime_audit_pass(ctx):
+    """Runtime-tier pass: the measured timeline of a ``jax.profiler``
+    capture vs the intended channels and the cost estimate, plus
+    cross-worker straggler skew from the aggregated manifests
+    (:mod:`autodist_tpu.analysis.runtime_audit`)."""
+    from autodist_tpu.analysis.runtime_audit import \
+        runtime_audit_pass as _run
+
+    return _run(ctx)
+
+
 PASS_REGISTRY = {
     "sharding": sharding_pass,
     "hierarchy": hierarchy_pass,
@@ -758,6 +785,7 @@ PASS_REGISTRY = {
     "hbm-traced": hbm_traced_pass,
     "hlo-audit": hlo_audit_pass,
     "compute-audit": compute_audit_pass,
+    "runtime-audit": runtime_audit_pass,
 }
 
 STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
@@ -767,3 +795,7 @@ TRACE_PASSES = ("collectives", "donation", "hbm-traced")
 # verify_strategy(passes=...), the CLI's --hlo/--compute, the AOT verify
 # gate, and AutoStrategy's top-candidate audit
 LOWERED_PASSES = ("hlo-audit", "compute-audit")
+# passes over a MEASURED jax.profiler capture + aggregated manifests;
+# opt-in via verify_strategy(passes=..., trace_dir=...), the CLI's
+# --runtime, and the watchdog's post-capture auto-analysis
+RUNTIME_PASSES = ("runtime-audit",)

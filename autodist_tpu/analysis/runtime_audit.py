@@ -1,0 +1,437 @@
+"""Runtime audit: the MEASURED tier (T-codes) of the verification stack.
+
+The jaxpr tier checks what we *emit*, the lowered tier (X/F) what XLA
+*realizes*; this pass closes the loop with what the hardware *measured*.
+It reduces a ``jax.profiler`` chrome-trace capture to the timeline model
+(:mod:`autodist_tpu.telemetry.timeline`), best-fit matches the measured
+collective events against the same intended-channel table the HLO audit
+diffs (X006 — :func:`hlo_audit.channels_from_plan`), and prices the
+result against the cost model's :class:`CostEstimate`:
+
+  T000 INFO    runtime audit skipped (no trace capture available)
+  T001 ERROR   measured exposed-comm fraction beyond the predicted
+               exposure + tolerance (the overlap the schedule promised
+               did not happen on the device timeline)
+  T002 ERROR   straggler worker: cross-worker step-wall skew above
+               threshold, names the worker address
+  T003 WARNING measured per-hop bandwidth below the spec's ``bw`` beyond
+               tolerance (the link underperforms what the estimate
+               priced)
+  T004 WARNING overlap credit priced but not realized: the schedule
+               says "overlap" yet the measured overlap fraction falls
+               short of the priced hiding
+  T005 WARNING codec wire savings not realized on the DCN hop (measured
+               bytes exceed the compressed intent)
+  T006 INFO    machine-readable predicted-vs-realized-vs-measured table
+               (``Finding.data``; consumed by ``tools/telemetry_report.py
+               --timeline`` and ``cost_model.calibrate_bandwidths``)
+
+Host-only captures (CPU meshes: the profiler emits no device lanes) are
+handled explicitly: event classification still runs, the T006 table is
+still emitted (flagged ``host_only``), but the hardware comparisons
+T001/T003/T004/T005 are suppressed — a host lane's overlap math is not
+hardware truth, and a CPU wall measured against TPU-spec bandwidth would
+always "fail".  Straggler attribution (T002) needs only the aggregated
+manifests, so it runs even without a capture.
+
+Measured per-hop bandwidth uses time-ratio scaling: the estimate prices
+hop ``h`` at ``spec_gbps[h]`` taking ``predicted_s[h]``; the same bytes
+measured at ``measured_s[h]`` imply ``measured_gbps = spec_gbps x
+predicted_s / measured_s`` — which cancels the ring/gather step factors
+without re-deriving them here.
+"""
+import dataclasses
+from typing import List
+
+from autodist_tpu.analysis.hlo_audit import (BYTES_TOL, _fmt_bytes,
+                                             channels_from_plan)
+from autodist_tpu.analysis.report import Finding, Severity
+from autodist_tpu.telemetry import timeline
+
+# measured exposed-comm fraction may exceed the predicted fraction by
+# this much (absolute) before T001 fires — scheduling jitter and trace
+# quantization eat a few percent; beyond this the overlap schedule is
+# genuinely not happening
+EXPOSED_FRAC_TOL = 0.25
+# measured hop wall may exceed the predicted hop wall by this relative
+# tolerance before T003 declares the link slower than spec
+BW_TOL = 0.30
+# measured overlap fraction may fall this far (absolute) below the
+# priced hiding before T004 fires
+OVERLAP_TOL = 0.25
+# an overlap schedule must promise at least this much hiding before T004
+# is worth checking (barrier-ish estimates have nothing to lose)
+MIN_OVERLAP_CREDIT = 0.25
+# T002 straggler thresholds (relative to the fastest worker's median,
+# with an absolute floor so microsecond steps don't trip it)
+SKEW_REL = 0.25
+SKEW_ABS_S = 0.05
+# acceptance tolerance for measured-total vs CostEstimate reconciliation
+# (pinned by the overlapped golden fixture test)
+RECONCILE_TOL = 0.15
+
+
+def _f(sev, code, msg, subject="", data=None):
+    return Finding(Severity(sev), code, "runtime-audit", msg, subject,
+                   data=data)
+
+
+@dataclasses.dataclass
+class RuntimeChannel:
+    """One intended channel accumulating the measured events the matcher
+    assigns to it."""
+
+    label: str
+    kinds: tuple
+    bytes: float
+    phase: str = "flat"
+    measured_us: float = 0.0
+    measured_bytes: float = 0.0
+    events: int = 0
+
+    @property
+    def remaining(self):
+        return max(0.0, self.bytes - self.measured_bytes)
+
+
+def runtime_channels(plan_entries) -> List[RuntimeChannel]:
+    """Intended-plan dicts -> measured-side channels (reusing the HLO
+    audit's normalization so both tiers see the same table)."""
+    return [RuntimeChannel(label=c.label, kinds=c.kinds, bytes=c.bytes,
+                           phase=c.phase)
+            for c in channels_from_plan(plan_entries)]
+
+
+def match_events(tsummary, channels):
+    """Best-fit match the capture's per-name collective aggregates onto
+    the intended channels.
+
+    By kind first; among kind-compatible channels a byte hint picks the
+    channel whose intended volume is closest, otherwise the channel with
+    the most unassigned intended bytes (a trace usually names collectives
+    opaquely — ``all-reduce.17`` — so bytes, when the profiler stamps
+    them, are the only join key beyond the op kind).  Returns the names
+    of measured collectives matching no channel."""
+    unmatched = []
+    order = sorted(tsummary.collectives.items(),
+                   key=lambda kv: -(kv[1]["bytes"] or kv[1]["us"]))
+    for name, g in order:
+        cands = [c for c in channels if g["kind"] in c.kinds]
+        if not cands:
+            unmatched.append(name)
+            continue
+        if g["bytes"] > 0:
+            best = min(cands, key=lambda c: abs(c.bytes - g["bytes"]))
+        else:
+            best = max(cands, key=lambda c: c.remaining)
+        best.measured_us += g["us"]
+        best.measured_bytes += g["bytes"] if g["bytes"] > 0 else \
+            min(best.remaining, best.bytes)
+        best.events += g["count"]
+    return unmatched
+
+
+def _phase_measured_s(channels):
+    out = {}
+    for c in channels:
+        out[c.phase] = out.get(c.phase, 0.0) + c.measured_us / 1e6
+    return out
+
+
+def _hop_table(est, phase_meas_s, hw=True):
+    """Per-hop spec/predicted/measured rows.  Two-level strategies carry
+    explicit ICI/DCN hop predictions (``hier_*_s``); a flat single-slice
+    ring rides the ICI fabric, so with no hierarchical hop the flat phase
+    is attributed to ICI.  ``hw=False`` (host-only capture) keeps the
+    measured walls but never infers a bandwidth from them — a host-lane
+    wall is not a link measurement, and a bogus ``measured_gbps`` would
+    poison ``cost_model.calibrate_bandwidths``."""
+    b = est.breakdown
+    flat_pred_s = (b.get("flat_ar_s", 0.0) + b.get("sharded_scatter_s", 0.0)
+                   + b.get("sharded_gather_s", 0.0))
+    hops = {}
+    if b.get("hier_ici_bytes", 0.0) > 0:
+        hops["ici"] = {"phase": "ici_hop",
+                       "spec_gbps": float(b.get("ici_gbps", 0.0)),
+                       "predicted_s": float(b.get("hier_ici_s", 0.0)),
+                       "measured_s": phase_meas_s.get("ici_hop", 0.0)}
+        hops["dcn"] = {"phase": "dcn_hop",
+                       "spec_gbps": float(b.get("dcn_gbps", 0.0)),
+                       "predicted_s": float(b.get("hier_dcn_s", 0.0)),
+                       "measured_s": phase_meas_s.get("dcn_hop", 0.0)}
+    elif flat_pred_s > 0:
+        hops["ici"] = {"phase": "flat",
+                       "spec_gbps": float(b.get("ici_gbps", 0.0)),
+                       "predicted_s": flat_pred_s,
+                       "measured_s": phase_meas_s.get("flat", 0.0)}
+    for h in hops.values():
+        pred, meas = h["predicted_s"], h["measured_s"]
+        if hw and pred > 0 and meas > 0 and h["spec_gbps"] > 0:
+            h["measured_gbps"] = h["spec_gbps"] * pred / meas
+            h["rel_error"] = (meas - pred) / pred
+        else:
+            h["measured_gbps"] = None
+            h["rel_error"] = None
+    return hops
+
+
+def runtime_audit(tsummary, plan_entries=None, est=None,
+                  manifest_records=None, *,
+                  source="trace") -> List[Finding]:
+    """Price a measured timeline against the intended plan + estimate.
+
+    Every argument is optional; the audit degrades to whatever subset the
+    inputs support (capture-less manifests still get T002, plan-less
+    captures still get the measured half of T006)."""
+    findings = []
+    skew = timeline.step_skew(manifest_records, rel_threshold=SKEW_REL,
+                              abs_threshold_s=SKEW_ABS_S) \
+        if manifest_records else None
+
+    if skew and skew["straggler"] is not None:
+        w = skew["straggler"]
+        findings.append(_f(
+            Severity.ERROR, "T002",
+            f"straggler worker {w} ({skew['straggler_addr']}): median "
+            f"step wall {skew['per_worker_median_s'][w] * 1e3:.1f} ms vs "
+            f"fastest {skew['fastest_s'] * 1e3:.1f} ms — skew "
+            f"{skew['skew_s'] * 1e3:.1f} ms exceeds the "
+            f"{skew['threshold_s'] * 1e3:.1f} ms threshold; the whole "
+            f"mesh steps at the straggler's pace",
+            skew["straggler_addr"], data=skew))
+
+    if tsummary is None or tsummary.n_events == 0:
+        findings.append(_f(
+            Severity.INFO, "T000",
+            "runtime audit skipped: no trace capture available — the "
+            "measured timeline was not checked"
+            + ("" if skew else " (and no aggregated manifests)")))
+        return findings
+
+    channels = runtime_channels(plan_entries) if plan_entries else []
+    unmatched = match_events(tsummary, channels) if channels else \
+        list(tsummary.collectives)
+    phase_meas_s = _phase_measured_s(channels)
+    hw = not tsummary.host_only
+
+    meas = {
+        "total_s": tsummary.total_us / 1e6,
+        "compute_s": tsummary.compute_us / 1e6,
+        "collective_s": tsummary.collective_us / 1e6,
+        "overlap_s": tsummary.overlap_us / 1e6,
+        "exposed_s": tsummary.exposed_us / 1e6,
+        "exposed_frac": tsummary.exposed_frac,
+        "overlap_frac": tsummary.overlap_frac,
+    }
+
+    pred = None
+    hops = {}
+    if est is not None:
+        pred_exposed_s = max(0.0, est.total_s - est.compute_s)
+        pred = {
+            "total_s": est.total_s, "compute_s": est.compute_s,
+            "comm_s": est.comm_s, "schedule": est.schedule,
+            "exposed_s": pred_exposed_s,
+            "exposed_frac": pred_exposed_s / est.total_s
+            if est.total_s else 0.0,
+            "hidden_frac": 1.0 - pred_exposed_s / est.comm_s
+            if est.comm_s else 0.0,
+        }
+        hops = _hop_table(est, phase_meas_s, hw=hw)
+
+        if hw and tsummary.n_collective_events:
+            if meas["exposed_frac"] > pred["exposed_frac"] \
+                    + EXPOSED_FRAC_TOL:
+                findings.append(_f(
+                    Severity.ERROR, "T001",
+                    f"exposed communication beyond prediction: "
+                    f"{meas['exposed_frac']:.0%} of the measured step "
+                    f"({meas['exposed_s'] * 1e3:.2f} ms) is collective "
+                    f"time with no compute to hide behind, vs "
+                    f"{pred['exposed_frac']:.0%} predicted "
+                    f"(+{EXPOSED_FRAC_TOL:.0%} tolerance) — the "
+                    f"schedule's overlap is not happening on the device "
+                    f"timeline"))
+            if est.schedule == "overlap" \
+                    and pred["hidden_frac"] >= MIN_OVERLAP_CREDIT \
+                    and meas["overlap_frac"] < pred["hidden_frac"] \
+                    - OVERLAP_TOL:
+                findings.append(_f(
+                    Severity.WARNING, "T004",
+                    f"overlap credit priced but not realized: the "
+                    f"estimate hides {pred['hidden_frac']:.0%} of comm "
+                    f"behind compute, the capture shows "
+                    f"{meas['overlap_frac']:.0%} of collective time "
+                    f"under concurrent compute "
+                    f"(tolerance {OVERLAP_TOL:.0%})"))
+        if hw:
+            for hop, h in hops.items():
+                if h["rel_error"] is not None and \
+                        h["rel_error"] > BW_TOL:
+                    findings.append(_f(
+                        Severity.WARNING, "T003",
+                        f"measured {hop.upper()} hop bandwidth "
+                        f"{h['measured_gbps']:.0f} Gbit/s is below the "
+                        f"spec's {h['spec_gbps']:.0f} Gbit/s beyond "
+                        f"tolerance (hop wall "
+                        f"{h['measured_s'] * 1e3:.2f} ms measured vs "
+                        f"{h['predicted_s'] * 1e3:.2f} ms predicted, "
+                        f"+{h['rel_error']:.0%} > {BW_TOL:.0%})", hop))
+
+    if hw:
+        for c in channels:
+            if c.phase == "dcn_hop" and c.measured_bytes > 0 \
+                    and c.measured_bytes > c.bytes * (1.0 + BYTES_TOL):
+                findings.append(_f(
+                    Severity.WARNING, "T005",
+                    f"codec wire savings not realized on the DCN hop: "
+                    f"'{c.label}' measured "
+                    f"{_fmt_bytes(c.measured_bytes)} on the wire vs "
+                    f"{_fmt_bytes(c.bytes)} compressed intent "
+                    f"(+{(c.measured_bytes / max(c.bytes, 1.0) - 1) * 100:.0f}%"
+                    f", tolerance {BYTES_TOL:.0%}) — the slow hop pays "
+                    f"uncompressed bytes", c.label))
+
+    measured_bw = {}
+    if hops.get("ici", {}).get("measured_gbps"):
+        measured_bw["ici_gbps"] = hops["ici"]["measured_gbps"]
+    if hops.get("dcn", {}).get("measured_gbps"):
+        measured_bw["dcn_gbps"] = hops["dcn"]["measured_gbps"]
+
+    reconcile = None
+    if est is not None and meas["total_s"] > 0 and est.total_s > 0:
+        reconcile = {
+            "measured_total_s": meas["total_s"],
+            "predicted_total_s": est.total_s,
+            "rel_error": (meas["total_s"] - est.total_s) / est.total_s,
+        }
+
+    data = {
+        "source": source,
+        "host_only": tsummary.host_only,
+        "n_events": tsummary.n_events,
+        "n_collective_events": tsummary.n_collective_events,
+        "measured": {k: round(v, 9) for k, v in meas.items()},
+        "predicted": {k: (round(v, 9) if isinstance(v, float) else v)
+                      for k, v in pred.items()} if pred else None,
+        "phases": {"measured_s": {k: round(v, 9)
+                                  for k, v in phase_meas_s.items()}},
+        "hops": hops,
+        "measured_bandwidths": measured_bw,
+        "skew": skew,
+        "channels": [{"label": c.label, "phase": c.phase,
+                      "kinds": list(c.kinds),
+                      "intended_bytes": round(c.bytes, 1),
+                      "measured_bytes": round(c.measured_bytes, 1),
+                      "measured_s": round(c.measured_us / 1e6, 9),
+                      "events": c.events} for c in channels],
+        "unmatched_events": unmatched,
+        "reconcile": reconcile,
+    }
+    host_note = " [host-only capture: hardware comparisons skipped]" \
+        if tsummary.host_only else ""
+    meas_txt = (f"measured step {meas['total_s'] * 1e3:.2f} ms "
+                f"(compute {meas['compute_s'] * 1e3:.2f} ms, collective "
+                f"{meas['collective_s'] * 1e3:.2f} ms, exposed "
+                f"{meas['exposed_frac']:.0%})")
+    pred_txt = (f"; predicted {pred['total_s'] * 1e3:.2f} ms "
+                f"({pred['schedule']}, exposed "
+                f"{pred['exposed_frac']:.0%})") if pred else ""
+    bw_txt = "".join(
+        f"; measured {k.split('_')[0].upper()} {v:.0f} Gbit/s"
+        for k, v in measured_bw.items())
+    findings.append(_f(
+        Severity.INFO, "T006",
+        f"predicted-vs-realized-vs-measured ({source}, "
+        f"{tsummary.n_collective_events} collective event(s)): "
+        + meas_txt + pred_txt + bw_txt + host_note,
+        "summary", data=data))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points: the registered pass and the fixture/CLI path
+# ---------------------------------------------------------------------------
+
+
+def _best_effort_estimate(ctx):
+    """The cost model's estimate for the audited strategy, or None —
+    runtime prices are a bonus, never a blocker."""
+    try:
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.simulator.cost_model import estimate
+
+        spec = ctx.resource_spec or \
+            ResourceSpec.from_num_chips(max(1, ctx.num_replicas))
+        return estimate(ctx.strategy, ctx.model_item, spec)
+    except Exception:
+        return None
+
+
+def runtime_audit_pass(ctx) -> List[Finding]:
+    """PASS_REGISTRY entry (the measured tier): summarize the capture at
+    ``ctx.trace_dir``, join it to the transformer's intended channels and
+    the cost model's estimate, and check the aggregated manifests
+    (``ctx.manifest_records``) for straggler skew."""
+    tsummary = None
+    source = "trace"
+    if getattr(ctx, "trace_dir", None):
+        tsummary = timeline.summarize_trace(ctx.trace_dir)
+        source = f"trace {ctx.trace_dir}"
+    records = getattr(ctx, "manifest_records", None)
+    if tsummary is None and not records:
+        return [_f(Severity.INFO, "T000",
+                   "runtime audit skipped: no trace capture attached "
+                   "(pass trace_dir=) and no aggregated manifests — the "
+                   "measured timeline was not checked")]
+    plan = None
+    transformer = getattr(ctx, "transformer", None)
+    if transformer is not None:
+        try:
+            plan = transformer.intended_collectives()
+        except Exception:
+            plan = None
+    est = _best_effort_estimate(ctx) \
+        if ctx.model_item is not None else None
+    findings = runtime_audit(tsummary, plan, est, records, source=source)
+    ctx.runtime_summary = next(
+        (f.data for f in findings if f.code == "T006"), None)
+    return findings
+
+
+def estimate_from_json(d) -> "CostEstimate":
+    """Rebuild a :class:`CostEstimate` from its ``to_json()`` dict (the
+    golden fixtures pin estimates this way)."""
+    from autodist_tpu.simulator.cost_model import CostEstimate
+
+    known = ("compute_s", "comm_s", "total_s", "schedule", "serialized_s",
+             "overlapped_s")
+    breakdown = {k: v for k, v in d.items() if k not in known}
+    return CostEstimate(compute_s=float(d["compute_s"]),
+                        comm_s=float(d["comm_s"]), breakdown=breakdown,
+                        schedule=d.get("schedule", "barrier"))
+
+
+def audit_fixture(trace_path=None, plan_path=None, manifest_dir=None):
+    """Run the audit over a golden fixture: a chrome-trace file, a
+    ``plan.json`` (``{"channels": [...], "estimate": {...}}``), and/or a
+    worker-manifest directory.  Returns the findings list (the
+    ``--runtime --selftest`` and fixture tests drive this)."""
+    import json
+
+    tsummary = timeline.summarize_trace(trace_path) if trace_path else None
+    plan = est = None
+    if plan_path:
+        with open(plan_path) as f:
+            d = json.load(f)
+        plan = d.get("channels")
+        if d.get("estimate"):
+            est = estimate_from_json(d["estimate"])
+    records = None
+    if manifest_dir:
+        from autodist_tpu.telemetry import aggregate
+
+        records = aggregate.load_manifest(manifest_dir)
+    src = trace_path or (manifest_dir and f"manifests {manifest_dir}") \
+        or "fixture"
+    return runtime_audit(tsummary, plan, est, records, source=str(src))

@@ -20,7 +20,8 @@ import dataclasses
 from typing import Any, Dict, Optional
 
 from autodist_tpu.analysis.passes import (LOWERED_PASSES, PASS_REGISTRY,
-                                          STATIC_PASSES, TRACE_PASSES)
+                                          RUNTIME_PASSES, STATIC_PASSES,
+                                          TRACE_PASSES)
 from autodist_tpu.analysis.report import Report, Severity
 from autodist_tpu.utils import logging
 
@@ -60,6 +61,12 @@ class AnalysisContext:
     # the compute audit's machine-readable table (the F006 payload:
     # model/realized FLOPs, per-region attribution, predicted MFU ceiling)
     compute_summary: Optional[dict] = None
+    # runtime (measured) tier: a jax.profiler capture directory for the
+    # timeline audit, aggregated manifest records for straggler skew,
+    # and the audit's machine-readable T006 table
+    trace_dir: Optional[str] = None
+    manifest_records: Optional[list] = None
+    runtime_summary: Optional[dict] = None
 
 
 def _mesh_info(strategy, resource_spec, mesh):
@@ -107,7 +114,7 @@ def _build_transformer(ctx, mesh, report):
     if mesh is None:
         devices = jax.devices()
         if len(devices) < ctx.num_replicas:
-            report.add(Severity.INFO, "T002", "trace",
+            report.add(Severity.INFO, "TR002", "trace",
                        f"trace skipped: mesh needs {ctx.num_replicas} "
                        f"devices, process has {len(devices)} — trace "
                        f"passes did not run")
@@ -125,7 +132,7 @@ def _build_transformer(ctx, mesh, report):
             **ctx.transformer_kwargs)
         return ctx.transformer
     except Exception as e:
-        report.add(Severity.ERROR, "T001", "trace",
+        report.add(Severity.ERROR, "TR001", "trace",
                    f"building the graph transformer failed: "
                    f"{type(e).__name__}: {e}")
         return None
@@ -133,7 +140,7 @@ def _build_transformer(ctx, mesh, report):
 
 def _run_trace(ctx, report, transformer, rng):
     """Trace the step devicelessly (the AOT abstract-eval path); any
-    failure becomes a T001 ERROR finding rather than an exception."""
+    failure becomes a TR001 ERROR finding rather than an exception."""
     import jax
 
     try:
@@ -141,7 +148,7 @@ def _run_trace(ctx, report, transformer, rng):
         traced = transformer.trace_step(ctx.batch_shapes, donate=ctx.donate,
                                         rng=rng, state_avals=state_avals)
     except Exception as e:  # surface as a finding, not a crash
-        report.add(Severity.ERROR, "T001", "trace",
+        report.add(Severity.ERROR, "TR001", "trace",
                    f"tracing the train step failed: {type(e).__name__}: {e}")
         return None
     attach_traced(ctx, traced, n_state_leaves=len(jax.tree.leaves(state_avals)))
@@ -161,17 +168,20 @@ def attach_traced(ctx, traced, n_state_leaves):
 
 def verify_transformer(transformer, batch_shapes, *, donate=True,
                        hbm_bytes_per_device=None, rng=None,
-                       passes=None) -> Report:
+                       passes=None, trace_dir=None,
+                       manifest_records=None) -> Report:
     """Verify an already-built :class:`GraphTransformer` (the engine's
-    in-session entry: the runner's ``verify=`` knob and ``aot_compile``
-    reuse the transformer they already hold instead of rebuilding one)."""
+    in-session entry: the runner's ``verify=`` knob, ``aot_compile``, and
+    the watchdog's post-capture analysis reuse the transformer they
+    already hold instead of rebuilding one)."""
     ctx = AnalysisContext(
         strategy=transformer.strategy, model_item=transformer.model_item,
         num_replicas=transformer.num_replicas,
         axis_names=tuple(transformer.mesh.axis_names),
         axis_sizes=dict(transformer.mesh.shape),
         batch_shapes=batch_shapes, donate=donate,
-        hbm_bytes_per_device=hbm_bytes_per_device)
+        hbm_bytes_per_device=hbm_bytes_per_device,
+        trace_dir=trace_dir, manifest_records=manifest_records)
     ctx.transformer = transformer
     report = Report(strategy_id=getattr(transformer.strategy, "id", ""))
     selected = tuple(passes) if passes is not None else \
@@ -181,19 +191,23 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
             report.extend(PASS_REGISTRY[name](ctx))
     trace_selected = [p for p in selected if p in TRACE_PASSES]
     lowered_selected = [p for p in selected if p in LOWERED_PASSES]
+    runtime_selected = [p for p in selected if p in RUNTIME_PASSES]
     if trace_selected or lowered_selected:
         _run_trace(ctx, report, transformer, rng)
         for name in trace_selected:
             report.extend(PASS_REGISTRY[name](ctx))
         for name in lowered_selected:
             report.extend(PASS_REGISTRY[name](ctx))
+    for name in runtime_selected:
+        report.extend(PASS_REGISTRY[name](ctx))
     return report
 
 
 def verify_strategy(strategy, model_item=None, resource_spec=None, *,
                     mesh=None, batch_shapes=None, param_specs=None,
                     donate=True, hbm_bytes_per_device=None, passes=None,
-                    rng=None, **transformer_kwargs) -> Report:
+                    rng=None, trace_dir=None, manifest_records=None,
+                    **transformer_kwargs) -> Report:
     """Statically verify a strategy before any compile.
 
     Args:
@@ -212,6 +226,11 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         ``aot.HBM_BY_DEVICE_KIND["TPU v5 lite"]``); ``None`` skips the
         budget comparison but still reports the footprint.
       passes: iterable of pass names to run (default: all applicable).
+      trace_dir: a ``jax.profiler`` capture directory — enables the
+        runtime (measured) tier when ``"runtime-audit"`` is selected.
+      manifest_records: aggregated cross-worker manifest records
+        (:func:`autodist_tpu.telemetry.aggregate.load_manifest`) for the
+        runtime tier's straggler-skew check.
       transformer_kwargs: forwarded to :class:`GraphTransformer`
         (``data_axes``, ``batch_spec``, ``accum_steps``, ...).
 
@@ -225,7 +244,8 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         axis_names=axis_names, axis_sizes=axis_sizes,
         param_specs=param_specs, batch_shapes=batch_shapes, donate=donate,
         hbm_bytes_per_device=hbm_bytes_per_device,
-        transformer_kwargs=transformer_kwargs)
+        transformer_kwargs=transformer_kwargs,
+        trace_dir=trace_dir, manifest_records=manifest_records)
     report = Report(strategy_id=getattr(strategy, "id", ""))
 
     selected = tuple(passes) if passes is not None else \
@@ -251,7 +271,7 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
     lowered_selected = [p for p in selected if p in LOWERED_PASSES]
     if trace_selected or lowered_selected:
         if batch_shapes is None or model_item is None:
-            report.add(Severity.INFO, "T002", "trace",
+            report.add(Severity.INFO, "TR002", "trace",
                        "trace skipped: no batch_shapes/model given — trace "
                        "passes did not run")
         else:
@@ -264,6 +284,13 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         # namespaced program-evolution dump) and diffs the realized
         # collective schedule against the transformer's intended plan
         for name in lowered_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+
+    # runtime (measured) tier: needs no trace of its own — it consumes
+    # the profiler capture / manifests attached to the context, plus the
+    # transformer's intended channels when the trace tier built one
+    for name in selected:
+        if name in RUNTIME_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
 
     logging.debug("verify_strategy(%s): %d findings (%d errors)",

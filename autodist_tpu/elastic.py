@@ -117,11 +117,21 @@ class ElasticTrainer:
         env); parsed events inject failures at step boundaries.
       max_replans: hard cap on topology changes per run (a flapping
         cluster must not re-plan forever).
+      on_straggler: optional callback ``(skew_dict) -> None`` invoked when
+        a persistent straggler signal arrives via :meth:`note_straggler`
+        (the runtime audit's T002).  Hook only — the default trainer
+        takes NO re-plan action on stragglers; wiring the callback to a
+        re-plan is the caller's policy decision.
     """
+
+    # consecutive T002 signals before the straggler is considered
+    # persistent (one captured slow step must not fire the hook)
+    STRAGGLER_PERSISTENCE = 2
 
     def __init__(self, resource_spec, strategy_builder, loss_fn, params,
                  optimizer, *, checkpoint_dir, distribute_kwargs=None,
-                 verify_restore=True, chaos=None, max_replans=8):
+                 verify_restore=True, chaos=None, max_replans=8,
+                 on_straggler=None):
         from autodist_tpu.autodist import AutoDist
         from autodist_tpu.cluster import Cluster
 
@@ -142,8 +152,43 @@ class ElasticTrainer:
         self.replans = 0
         self.history = []        # (epoch, step, loss) across the whole run
         self.session = None
+        self.on_straggler = on_straggler
+        self._straggler_streak = {}   # addr -> consecutive T002 signals
+        self.straggler_signals = 0
 
     # -- membership signals -------------------------------------------------
+
+    def note_straggler(self, skew):
+        """Consume one runtime-audit T002 straggler signal (the skew dict
+        off the finding's ``data`` — ``straggler_addr``, ``skew_s``).
+
+        Counts consecutive signals per address; once an address persists
+        for :data:`STRAGGLER_PERSISTENCE` signals the ``on_straggler``
+        callback fires (if set).  Returns True when the callback fired.
+        No default policy: a straggler is a re-plan *signal*, not a
+        worker death — deciding to shrink around a slow-but-alive host
+        belongs to the operator, not the trainer."""
+        from autodist_tpu import telemetry
+
+        addr = (skew or {}).get("straggler_addr")
+        if not addr:
+            self._straggler_streak.clear()
+            return False
+        self.straggler_signals += 1
+        telemetry.counter("elastic.straggler_signals", addr=addr)
+        self._straggler_streak = {
+            addr: self._straggler_streak.get(addr, 0) + 1}
+        if self._straggler_streak[addr] < self.STRAGGLER_PERSISTENCE:
+            return False
+        logging.warning(
+            "ElasticTrainer: persistent straggler %s (skew %.3fs over %d "
+            "signals)%s", addr, skew.get("skew_s", 0.0),
+            self._straggler_streak[addr],
+            "" if self.on_straggler else " — no on_straggler hook set")
+        if self.on_straggler is not None:
+            self.on_straggler(dict(skew))
+            return True
+        return False
 
     def _note_worker_exit(self, addr, code):
         """Cluster monitor callback (monitor thread): queue the death for

@@ -483,7 +483,8 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
     # hide behind; one bucket's share of the AR time stays exposed
     shard_scatter_s = _gather_time(shard_scatter_bytes, R, bw)
     shard_gather_s = _gather_time(shard_gather_bytes, R, bw)
-    ar_ring_s = (_ring_time(ar_bytes, R, bw) + hier_ici_s + hier_dcn_s
+    flat_ar_s = _ring_time(ar_bytes, R, bw)
+    ar_ring_s = (flat_ar_s + hier_ici_s + hier_dcn_s
                  + shard_scatter_s + shard_gather_s)
     exposed_s = ar_ring_s / max(1, len(ar_bucket_keys))
     return CostEstimate(compute_s + update_s, comm_s, {
@@ -500,6 +501,10 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         "sharded_gather_s": shard_gather_s,
         "update_bytes": update_bytes, "update_s": update_s,
         "ar_buckets": len(ar_bucket_keys), "overlap_exposed_s": exposed_s,
+        # the bandwidth INPUTS the estimate priced with, recorded so the
+        # runtime audit can turn a measured hop wall back into a measured
+        # bandwidth (measured_gbps = spec_gbps x predicted_s/measured_s)
+        "flat_ar_s": flat_ar_s, "ici_gbps": ici_gbps, "dcn_gbps": dcn_gbps,
         "num_replicas": R},
         schedule="overlap" if ar_overlap else "barrier")
 
@@ -835,12 +840,51 @@ def rebuild_record_case(record, loss_fn=None):
     return Strategy(pb), item, max(1, R)
 
 
-def calibrate_from_records(records, resource_spec=None, **estimate_kw):
+def calibrate_bandwidths(measurements):
+    """Aggregate measured per-hop bandwidths into the ``ici_gbps`` /
+    ``dcn_gbps`` overrides :func:`estimate` accepts.
+
+    ``measurements``: dicts carrying ``ici_gbps`` and/or ``dcn_gbps``
+    (the runtime audit's T006 ``measured_bandwidths`` payload, or its
+    ``hops`` table — ``{"ici": {"measured_gbps": ...}, ...}`` is
+    unwrapped).  The per-hop MEDIAN is returned — one captured step with
+    a congested link must not drag the whole calibration — with hops
+    nobody measured absent from the result.  Feed the returned dict to
+    :func:`calibrate_from_records` (``measured_bandwidths=``) or splat it
+    into :func:`estimate` directly."""
+    per_hop = {"ici_gbps": [], "dcn_gbps": []}
+    for m in measurements:
+        if not m:
+            continue
+        if "ici" in m or "dcn" in m:    # a T006 hops table
+            m = {f"{hop}_gbps": (m.get(hop) or {}).get("measured_gbps")
+                 for hop in ("ici", "dcn")}
+        for key, vals in per_hop.items():
+            v = m.get(key)
+            if v:
+                vals.append(float(v))
+    out = {}
+    for key, vals in per_hop.items():
+        if vals:
+            vals.sort()
+            n = len(vals)
+            out[key] = vals[n // 2] if n % 2 else \
+                0.5 * (vals[n // 2 - 1] + vals[n // 2])
+    return out
+
+
+def calibrate_from_records(records, resource_spec=None,
+                           measured_bandwidths=None, **estimate_kw):
     """The measured-feedback loop closed from telemetry manifests: rebuild
     each :class:`RuntimeRecord`'s (strategy, model) case, price it with
     :func:`estimate`, and :func:`calibrate` against the measured step
     times.  ``records`` may be RuntimeRecord objects or paths to their
     JSON dumps.  Returns ``(calibration, pairs)``.
+
+    ``measured_bandwidths`` (a :func:`calibrate_bandwidths` dict) re-prices
+    every estimate at the MEASURED per-hop bandwidths instead of the spec
+    defaults, so the least-squares fit corrects schedule/overhead error
+    rather than re-learning a link speed the timeline already measured.
 
     Mixed-backend record sets raise: a CPU pipeline artifact averaged
     into TPU measurements would silently skew every coefficient (the
@@ -853,6 +897,10 @@ def calibrate_from_records(records, resource_spec=None, **estimate_kw):
         raise ValueError(
             f"refusing to calibrate across mixed backends {sorted(backends)}; "
             f"filter records to one backend first")
+    if measured_bandwidths:
+        for key in ("ici_gbps", "dcn_gbps"):
+            if measured_bandwidths.get(key) and key not in estimate_kw:
+                estimate_kw[key] = float(measured_bandwidths[key])
     pairs = []
     for rec in recs:
         strategy, item, R = rebuild_record_case(rec)

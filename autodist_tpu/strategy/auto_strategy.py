@@ -231,7 +231,8 @@ class AutoStrategy(StrategyBuilder):
             from ValueError(
                 "every ranked candidate failed the HLO communication audit")
 
-    def note_measured(self, measured_step_s, name=None):
+    def note_measured(self, measured_step_s, name=None,
+                      hop_bandwidths=None):
         """Close the predicted-vs-measured loop: compare a real step time
         (e.g. the telemetry manifest's ``step_time_p50_s``, or a
         RuntimeRecord's ``step_time_s``) against this builder's ranked
@@ -243,6 +244,14 @@ class AutoStrategy(StrategyBuilder):
         ``auto_strategy.prediction_error``; large errors are the signal
         to refit (``cost_model.calibrate_from_records``) and pass the
         result back in as ``calibration=``.
+
+        ``hop_bandwidths``: measured per-hop bandwidths from the runtime
+        audit (the T006 ``measured_bandwidths`` payload — ``ici_gbps`` /
+        ``dcn_gbps``).  Recorded as the ``sync.measured_ici_bw`` /
+        ``sync.measured_dcn_bw`` gauges and as a per-hop
+        predicted-vs-measured error (vs the cost model's spec defaults)
+        in ``last_prediction_error["hops"]`` — the measured half of
+        ``cost_model.calibrate_bandwidths``'s input.
         """
         if not self.last_ranking:
             raise RuntimeError("note_measured before build(): no ranking yet")
@@ -259,6 +268,23 @@ class AutoStrategy(StrategyBuilder):
         from autodist_tpu import telemetry
 
         telemetry.gauge("auto_strategy.prediction_error", err, strategy=name)
+        if hop_bandwidths:
+            from autodist_tpu.simulator.cost_model import (DEFAULT_DCN_GBPS,
+                                                           DEFAULT_ICI_GBPS)
+
+            hops = {}
+            for hop, spec, gauge in (
+                    ("ici", DEFAULT_ICI_GBPS, "sync.measured_ici_bw"),
+                    ("dcn", DEFAULT_DCN_GBPS, "sync.measured_dcn_bw")):
+                bw = hop_bandwidths.get(f"{hop}_gbps")
+                if not bw:
+                    continue
+                telemetry.gauge(gauge, float(bw))
+                hops[hop] = {"measured_gbps": float(bw),
+                             "spec_gbps": spec,
+                             "rel_error": (float(bw) - spec) / spec}
+            if hops:
+                self.last_prediction_error["hops"] = hops
         logging.info(
             "AutoStrategy %s: predicted %.4fms vs measured %.4fms/step "
             "(rel error %+.1f%%)%s", name, predicted * 1e3,

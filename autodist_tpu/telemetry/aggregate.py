@@ -6,6 +6,24 @@ already makes — ``AutoDist.launch`` docs); the chief merges them into
 ``manifest.jsonl``, time-ordered, each line still carrying its ``w``
 rank.  ``tools/telemetry_report.py`` and the schema validator consume
 either a single worker file or the merged manifest.
+
+Two hygiene properties the merge guarantees:
+
+- **Never raise.**  A missing worker file, a torn trailing line from a
+  crashed writer, or a duplicate step entry (a worker restarted and
+  replayed a step) is skipped AND counted — the ``aggregate.skipped_lines``
+  / ``aggregate.skipped_duplicates`` counters and the returned stats
+  carry the tally, so data loss is visible without poisoning the merge.
+- **Clock-offset correction.**  Workers stamp ``t`` with their own
+  wall clock; hosts drift (NTP slews, container clock namespaces), so
+  sorting on raw ``t`` interleaves records wrongly and — worse — any
+  cross-worker skew computed from raw timestamps measures the CLOCKS,
+  not the workers.  Step records of the same index are simultaneous up
+  to one collective (every worker leaves step ``k``'s barrier together),
+  so the per-worker clock offset is estimated as the median of
+  ``t_w[k] - t_ref[k]`` over shared step indices and subtracted before
+  ordering.  :func:`autodist_tpu.telemetry.timeline.step_skew` then sees
+  wall *durations* (offset-free) and the merge order reflects real time.
 """
 import glob
 import json
@@ -15,38 +33,124 @@ MANIFEST_NAME = "manifest.jsonl"
 WORKER_GLOB = "worker_*.jsonl"
 
 
+def _count(name, value=1.0):
+    """Facade counter, lazily — aggregate must import standalone."""
+    try:
+        from autodist_tpu import telemetry as _tel
+
+        _tel.counter(name, value)
+    except Exception:
+        pass
+
+
 def worker_manifest_paths(run_dir):
     return sorted(glob.glob(os.path.join(run_dir, WORKER_GLOB)))
 
 
 def _parse_lines(path):
-    records = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except ValueError:
-                # a torn final line from a crashed writer must not poison
-                # the merge; the validator reports it separately
-                continue
-    return records
+    """``(records, skipped)`` from one JSONL file.  A missing file or a
+    torn/undecodable line is skipped and counted, never raised — a
+    crashed writer must not poison the merge."""
+    records, skipped = [], 0
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return [], 1
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            skipped += 1
+    return records, skipped
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def estimate_clock_offsets(per_worker):
+    """Per-worker clock offset (seconds to SUBTRACT from ``t``) keyed on
+    step boundaries.
+
+    ``per_worker``: ``{rank: [records]}``.  The lowest rank present is
+    the reference clock (offset 0); every other worker's offset is the
+    median of ``t_w[k] - t_ref[k]`` over step indices both recorded.
+    Workers sharing no step index with the reference keep offset 0 —
+    better unadjusted than wrongly adjusted."""
+    if not per_worker:
+        return {}
+    ref = min(per_worker)
+    step_t = {}
+    for w, recs in per_worker.items():
+        step_t[w] = {r.get("step"): float(r["t"]) for r in recs
+                     if r.get("kind") == "step" and "t" in r
+                     and r.get("step") is not None}
+    offsets = {w: 0.0 for w in per_worker}
+    for w in per_worker:
+        if w == ref:
+            continue
+        shared = sorted(set(step_t[w]) & set(step_t[ref]))
+        if shared:
+            offsets[w] = _median([step_t[w][k] - step_t[ref][k]
+                                  for k in shared])
+    return offsets
+
+
+def merge_records(run_dir):
+    """All worker records under ``run_dir``, clock-offset corrected,
+    time-ordered, step-deduplicated.  Returns ``(records, stats)`` with
+    ``stats = {skipped_lines, skipped_duplicates, clock_offsets_s}``;
+    never raises."""
+    per_worker = {}
+    skipped_lines = 0
+    for i, p in enumerate(worker_manifest_paths(run_dir)):
+        recs, skipped = _parse_lines(p)
+        skipped_lines += skipped
+        # the filename rank is authoritative for grouping; records carry
+        # their own "w" for rendering
+        rank = recs[0].get("w", i) if recs else i
+        per_worker.setdefault(rank, []).extend(recs)
+
+    offsets = estimate_clock_offsets(per_worker)
+    records, seen_steps, dups = [], set(), 0
+    for w, recs in sorted(per_worker.items()):
+        off = offsets.get(w, 0.0)
+        for r in recs:
+            if r.get("kind") == "step":
+                key = (w, r.get("step"))
+                if key in seen_steps:
+                    dups += 1     # a restarted worker replayed this step
+                    continue
+                seen_steps.add(key)
+            if off and "t" in r:
+                r = dict(r)
+                r["t"] = float(r["t"]) - off
+                r["t_raw"] = float(r["t"]) + off
+            records.append(r)
+    # stable sort: equal timestamps keep per-worker file order
+    records.sort(key=lambda r: r.get("t", 0.0))
+    if skipped_lines:
+        _count("aggregate.skipped_lines", skipped_lines)
+    if dups:
+        _count("aggregate.skipped_duplicates", dups)
+    stats = {"skipped_lines": skipped_lines, "skipped_duplicates": dups,
+             "clock_offsets_s": offsets}
+    return records, stats
 
 
 def merge_worker_manifests(run_dir, out_path=None):
     """Merge every ``worker_*.jsonl`` under ``run_dir`` into one
     time-ordered ``manifest.jsonl``; returns the manifest path (or None
     when there is nothing to merge)."""
-    paths = worker_manifest_paths(run_dir)
-    if not paths:
+    if not worker_manifest_paths(run_dir):
         return None
-    records = []
-    for p in paths:
-        records.extend(_parse_lines(p))
-    # stable sort: equal timestamps keep per-worker file order
-    records.sort(key=lambda r: r.get("t", 0.0))
+    records, _ = merge_records(run_dir)
     out_path = out_path or os.path.join(run_dir, MANIFEST_NAME)
     with open(out_path, "w") as f:
         for r in records:
@@ -58,15 +162,12 @@ def load_manifest(path):
     """Load manifest records from a file or a run directory.
 
     A directory prefers its merged ``manifest.jsonl``; if absent, the
-    worker files are merged in memory (read-only — nothing is written).
+    worker files are merged in memory (read-only — nothing is written,
+    but the same offset correction and dedupe apply).
     """
     if os.path.isdir(path):
         merged = os.path.join(path, MANIFEST_NAME)
         if os.path.exists(merged):
-            return _parse_lines(merged)
-        records = []
-        for p in worker_manifest_paths(path):
-            records.extend(_parse_lines(p))
-        records.sort(key=lambda r: r.get("t", 0.0))
-        return records
-    return _parse_lines(path)
+            return _parse_lines(merged)[0]
+        return merge_records(path)[0]
+    return _parse_lines(path)[0]

@@ -73,6 +73,7 @@ class SessionTelemetry:
         self._mfus = []
         self._flops_per_device = None  # lazy; None = not yet / failed
         self._flops_failed = False
+        self._est = None               # CostEstimate (runtime-audit input)
         self.finalized = False
         self._write_meta()
 
@@ -136,6 +137,7 @@ class SessionTelemetry:
             R = len(list(self._t.mesh.devices.flat))
             est = estimate(self._t.strategy, self._t.model_item,
                            ResourceSpec.from_num_chips(R))
+            self._est = est     # the runtime audit prices captures with it
             return est.to_json()
         except Exception:
             return None
@@ -250,13 +252,61 @@ class SessionTelemetry:
                     "telemetry watchdog: step %d took %.3fs (> %.1fx rolling "
                     "median %.3fs); arming one-step profiler capture.",
                     s, w, self.watchdog.multiple, med)
+                # record WHY the capture armed into the metrics stream —
+                # a manifest reader can audit the trigger (median, wall,
+                # multiple), not just see that one happened
+                reason = self.watchdog.last_arm_reason
+                if reason is not None and reason.get("step") == s:
+                    self._writer.write({"kind": "watchdog_armed",
+                                        "t": time.time(), **reason})
+                    self.registry.counter("session.watchdog_armed")
         if watchdog_capture and trace_dir:
             self._writer.write({"kind": "watchdog", "t": time.time(),
                                 "step": step, "trace_dir": trace_dir})
             self.registry.counter("session.watchdog_captures")
+            self._analyze_capture(step, trace_dir)
+            if self.watchdog is not None:
+                self.watchdog.capture_finished()
         if step == 0 or (step + 1) % self._mem_every == 0:
             self._memory_snapshot(step)
         return rec
+
+    def _analyze_capture(self, step, trace_dir):
+        """Auto-run the runtime (measured-tier) analyzer over a watchdog
+        capture: T-code findings land in the metrics stream as
+        ``runtime_finding`` records + ``runtime_audit.<code>`` counters,
+        and measured per-hop bandwidths become ``sync.measured_*_bw``
+        gauges.  Best-effort — analysis must never break training."""
+        try:
+            from autodist_tpu.analysis.runtime_audit import runtime_audit
+            from autodist_tpu.telemetry import timeline
+
+            tsummary = timeline.summarize_trace(trace_dir)
+            if tsummary is None:
+                return
+            try:
+                plan = self._t.intended_collectives()
+            except Exception:
+                plan = None
+            findings = runtime_audit(tsummary, plan, self._est,
+                                     source=f"watchdog step {step}")
+            for f in findings:
+                self.registry.counter(f"runtime_audit.{f.code}")
+                rec = {"kind": "runtime_finding", "t": time.time(),
+                       "step": step, "code": f.code,
+                       "severity": str(f.severity), "message": f.message}
+                if f.code == "T006" and f.data:
+                    rec["data"] = f.data
+                    for hop, key in (("ici", "sync.measured_ici_bw"),
+                                     ("dcn", "sync.measured_dcn_bw")):
+                        bw = f.data["measured_bandwidths"].get(
+                            f"{hop}_gbps")
+                        if bw:
+                            self.registry.gauge(key, bw)
+                self._writer.write(rec)
+        except Exception as e:
+            logging.debug("telemetry: runtime audit of capture failed (%s)",
+                          e)
 
     def _memory_snapshot(self, step):
         if self._mem_fn is None:
@@ -305,6 +355,21 @@ class SessionTelemetry:
         rec_path = self._dump_runtime_record(ps[0.5])
         if rec_path:
             summary["runtime_record"] = rec_path
+        # chief: cross-worker step skew from the (clock-offset corrected)
+        # worker files, BEFORE the summary so the gauge lands in its
+        # aggregates; a persistent straggler here is the T002 signal
+        # ElasticTrainer.note_straggler consumes
+        if self.worker == 0:
+            try:
+                from autodist_tpu.telemetry import timeline
+                from autodist_tpu.telemetry.aggregate import merge_records
+
+                sk = timeline.step_skew(merge_records(self.run_dir)[0])
+                if sk is not None:
+                    self.registry.gauge("cluster.step_skew_s", sk["skew_s"])
+                    summary["step_skew"] = sk
+            except Exception:
+                pass
         span_records = self.spans.events()
         if span_records:
             summary["host_spans"] = dump_chrome_trace(

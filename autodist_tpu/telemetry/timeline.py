@@ -1,0 +1,331 @@
+"""Chrome-trace timeline model: the RUNTIME tier's measured event source.
+
+``jax.profiler.trace`` writes chrome-trace JSON (``*.trace.json.gz``
+under ``<dir>/plugins/profile/<run>/``); the telemetry layer's host spans
+share the same wall-clock-microsecond timebase.  This module is the one
+blessed chrome-trace parser inside the package (``tools/lint.py`` AD04
+rejects ad-hoc ``traceEvents`` parsing elsewhere; ``tools/trace_summary.py``
+re-exports the loaders below): it finds and loads a capture, filters the
+device lanes, classifies device events into **compute vs collective**,
+and reduces them to the interval algebra the runtime audit
+(:mod:`autodist_tpu.analysis.runtime_audit`) prices — measured device
+wall, measured collective wall, and the measured overlap/exposed-comm
+split that the cost model's ``CostEstimate.overlapped_s`` predicted
+analytically.
+
+Cross-worker: :func:`step_skew` turns an aggregated manifest
+(:mod:`autodist_tpu.telemetry.aggregate` — clock-offset corrected) into
+per-worker step-wall medians and a straggler attribution, the T002
+signal.
+
+Zero dependencies beyond the standard library: loading a trace must work
+on a CI host with no jax imported.
+"""
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import re
+
+# same device-lane convention tools/trace_summary.py established (TPU /
+# GPU lanes, "/device:..." process names, XLA op tracks)
+DEVICE_PAT = re.compile(r"TPU|/device:|XLA Op|Accelerator|GPU", re.I)
+
+# trace op names use dashes ("all-reduce.1", "all-gather-start.2");
+# fixture/host spellings may use underscores.  Keyed to the hlo_audit
+# COLLECTIVE_KINDS vocabulary so events join the X006 channel table.
+_COLLECTIVE_PATTERNS = (
+    ("reduce_scatter", re.compile(r"reduce[-_]scatter", re.I)),
+    ("all_reduce", re.compile(r"all[-_]reduce", re.I)),
+    ("all_gather", re.compile(r"all[-_]gather", re.I)),
+    ("all_to_all", re.compile(r"all[-_]to[-_]all", re.I)),
+    ("collective_permute", re.compile(r"collective[-_]permute", re.I)),
+    ("collective_broadcast", re.compile(r"collective[-_]broadcast", re.I)),
+)
+
+
+def collective_kind(name):
+    """Map a trace event name to its hlo_audit collective kind (or None
+    for compute/infeed/host events)."""
+    for kind, pat in _COLLECTIVE_PATTERNS:
+        if pat.search(name or ""):
+            return kind
+    return None
+
+
+def find_trace_file(trace_dir):
+    """Newest ``*.trace.json(.gz)`` under ``trace_dir`` (recursive — the
+    profiler nests captures under ``plugins/profile/<run>/``), or None."""
+    hits = []
+    for pat in ("*.trace.json.gz", "*.trace.json"):
+        hits.extend(glob.glob(os.path.join(trace_dir, "**", pat),
+                              recursive=True))
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def load_events(path):
+    """Chrome-trace events from a file or a capture directory (gzip
+    aware).  Returns ``[]`` for a missing/empty capture rather than
+    raising — a torn watchdog capture must not break analysis."""
+    if os.path.isdir(path):
+        path = find_trace_file(path)
+        if path is None:
+            return []
+    op = gzip.open if path.endswith(".gz") else open
+    try:
+        with op(path, "rt") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    return data.get("traceEvents", data if isinstance(data, list) else [])
+
+
+@dataclasses.dataclass
+class DeviceEvent:
+    """One complete ("X") event off a device lane, classified."""
+
+    name: str
+    ts: float                 # wall-clock µs
+    dur: float                # µs
+    pid: int = 0
+    tid: int = 0
+    collective: str = ""      # hlo_audit kind; "" = compute
+    bytes: float = 0.0        # wire-byte hint from args (0 = unknown)
+
+    @property
+    def kind(self):
+        return "collective" if self.collective else "compute"
+
+    @property
+    def end(self):
+        return self.ts + self.dur
+
+
+def process_names(events):
+    """pid -> process name from the trace's metadata events."""
+    return {e.get("pid"): e.get("args", {}).get("name", "")
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+
+
+def _bytes_hint(e):
+    args = e.get("args") or {}
+    for key in ("bytes", "bytes_transferred", "wire_bytes"):
+        v = args.get(key)
+        if v is not None:
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                pass
+    return 0.0
+
+
+def device_events(events):
+    """Classify a capture's complete events into :class:`DeviceEvent`\\ s.
+
+    Returns ``(devents, info)`` where ``info`` carries ``host_only``
+    (no recognizable device lane — the capture came from a backend whose
+    profiler emits no device tracks, e.g. a CPU mesh) and the track
+    names.  On a host-only trace every "X" event is kept so collective
+    TraceMes are still visible, but overlap/exposed math over such lanes
+    is NOT hardware truth — the runtime audit skips its comparisons and
+    says so (T006 ``host_only``)."""
+    pnames = process_names(events)
+    device_pids = {pid for pid, n in pnames.items()
+                   if DEVICE_PAT.search(n or "")}
+    xs = [e for e in events if e.get("ph") == "X"]
+    selected = [e for e in xs if e.get("pid") in device_pids] \
+        if device_pids else []
+    host_only = not selected
+    if host_only:
+        selected = xs
+    out = [DeviceEvent(
+        name=e.get("name", "?"), ts=float(e.get("ts", 0.0)),
+        dur=float(e.get("dur", 0.0)), pid=e.get("pid", 0),
+        tid=e.get("tid", 0),
+        collective=collective_kind(e.get("name", "")) or "",
+        bytes=_bytes_hint(e)) for e in selected]
+    info = {"host_only": host_only, "n_events": len(out),
+            "tracks": sorted({n for n in pnames.values() if n})}
+    return out, info
+
+
+# -- interval algebra --------------------------------------------------------
+
+
+def merge_intervals(intervals):
+    """Overlapping/touching (start, end) intervals -> disjoint union."""
+    out = []
+    for lo, hi in sorted(intervals):
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def interval_total(merged):
+    return sum(hi - lo for lo, hi in merged)
+
+
+def interval_intersection(a, b):
+    """Total length of the intersection of two DISJOINT interval lists."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+@dataclasses.dataclass
+class TimelineSummary:
+    """The measured quantities of one capture, in µs on the device
+    timebase.  ``collective_us`` is the UNION of collective intervals
+    (not a busy sum), so ``overlap_us + exposed_us == collective_us``."""
+
+    total_us: float = 0.0          # union of every device interval
+    compute_us: float = 0.0        # union of compute intervals
+    collective_us: float = 0.0     # union of collective intervals
+    overlap_us: float = 0.0        # collective time under concurrent compute
+    exposed_us: float = 0.0        # collective time with no compute to hide it
+    collectives: dict = dataclasses.field(default_factory=dict)
+    n_events: int = 0
+    n_collective_events: int = 0
+    host_only: bool = False
+    tracks: tuple = ()
+
+    @property
+    def exposed_frac(self):
+        return self.exposed_us / self.total_us if self.total_us else 0.0
+
+    @property
+    def overlap_frac(self):
+        """How much of the collective wall ran under concurrent compute
+        (the measured counterpart of the cost model's overlap credit)."""
+        return self.overlap_us / self.collective_us \
+            if self.collective_us else 0.0
+
+
+def summarize_timeline(devents, info=None):
+    """Reduce classified device events to a :class:`TimelineSummary`.
+
+    ``collectives`` aggregates per event name: ``{kind, us, count,
+    bytes}`` — the rows the runtime audit best-fit matches against the
+    X006 intended-channel table."""
+    comp = merge_intervals([(e.ts, e.end) for e in devents
+                            if not e.collective and e.dur > 0])
+    coll = merge_intervals([(e.ts, e.end) for e in devents
+                            if e.collective and e.dur > 0])
+    everything = merge_intervals(comp + coll)
+    coll_us = interval_total(coll)
+    overlap = interval_intersection(coll, comp)
+    groups = {}
+    for e in devents:
+        if not e.collective:
+            continue
+        g = groups.setdefault(e.name, {"kind": e.collective, "us": 0.0,
+                                       "count": 0, "bytes": 0.0})
+        g["us"] += e.dur
+        g["count"] += 1
+        g["bytes"] += e.bytes
+    info = info or {}
+    return TimelineSummary(
+        total_us=interval_total(everything), compute_us=interval_total(comp),
+        collective_us=coll_us, overlap_us=overlap,
+        exposed_us=max(0.0, coll_us - overlap), collectives=groups,
+        n_events=len(devents),
+        n_collective_events=sum(g["count"] for g in groups.values()),
+        host_only=bool(info.get("host_only", False)),
+        tracks=tuple(info.get("tracks", ())))
+
+
+def summarize_trace(path_or_dir):
+    """One-call convenience: capture path/dir -> :class:`TimelineSummary`
+    (None when no trace file exists)."""
+    events = load_events(path_or_dir)
+    if not events:
+        return None
+    devents, info = device_events(events)
+    return summarize_timeline(devents, info)
+
+
+# -- cross-worker straggler attribution --------------------------------------
+
+_MEDIAN_MIN_STEPS = 2   # need steady-state walls; step 0 carries compile
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    if not n:
+        return None
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def worker_step_walls(records):
+    """Manifest records -> ``{worker: [steady-state step walls]}``
+    (RTT-cancelled when recorded; step 0 dropped when a worker has more
+    than one step — its wall includes compile)."""
+    walls = {}
+    for r in records:
+        if r.get("kind") != "step":
+            continue
+        w = r.get("w", 0)
+        wall = r.get("wall_cancelled_s", r.get("wall_s"))
+        if wall is None:
+            continue
+        walls.setdefault(w, []).append((r.get("step", 0), float(wall)))
+    out = {}
+    for w, pairs in walls.items():
+        pairs.sort()
+        vals = [v for s, v in pairs if s > 0] if len(pairs) > 1 \
+            else [v for _, v in pairs]
+        out[w] = vals
+    return out
+
+
+def worker_addresses(records):
+    """Best-effort ``{worker: address}`` from manifest meta records (the
+    cluster stamps ``addr`` when it launched the worker); falls back to
+    ``worker <rank>``."""
+    addrs = {}
+    for r in records:
+        if r.get("kind") == "meta" and "addr" in r:
+            addrs[r.get("w", 0)] = r["addr"]
+    return addrs
+
+
+def step_skew(records, *, rel_threshold=0.25, abs_threshold_s=0.05):
+    """Per-worker step-wall skew from an aggregated manifest.
+
+    Returns ``None`` with fewer than two workers reporting enough steps;
+    otherwise a dict with per-worker medians, the fastest/slowest split
+    (``skew_s``), and — when the slowest worker exceeds the fastest by
+    more than ``max(rel_threshold x fastest, abs_threshold_s)`` — the
+    ``straggler`` (worker rank) and its address.  The thresholds are the
+    T002 contract (:mod:`autodist_tpu.analysis.runtime_audit`)."""
+    walls = {w: v for w, v in worker_step_walls(records).items()
+             if len(v) >= _MEDIAN_MIN_STEPS}
+    if len(walls) < 2:
+        return None
+    medians = {w: _median(v) for w, v in walls.items()}
+    fastest = min(medians.values())
+    slowest_w = max(medians, key=lambda w: medians[w])
+    skew = medians[slowest_w] - fastest
+    threshold = max(rel_threshold * fastest, abs_threshold_s)
+    addrs = worker_addresses(records)
+    out = {"per_worker_median_s": medians, "skew_s": skew,
+           "fastest_s": fastest, "threshold_s": threshold,
+           "straggler": None, "straggler_addr": None}
+    if skew > threshold:
+        out["straggler"] = slowest_w
+        out["straggler_addr"] = addrs.get(slowest_w,
+                                          f"worker {slowest_w}")
+    return out

@@ -36,6 +36,12 @@ class SlowStepWatchdog:
         self.captures = 0
         self.triggers = 0          # slow steps observed (armed or not)
         self.last_trigger = None   # (step, wall_s, median_s)
+        self.in_flight = False     # a capture is running; do not re-arm
+        # WHY the last capture armed (rolling median, observed wall, the
+        # multiple in force) — the session writes this into the metrics
+        # stream so a manifest reader can audit the trigger, not just
+        # see that one happened
+        self.last_arm_reason = None
 
     def rolling_median(self):
         if not self._times:
@@ -62,16 +68,31 @@ class SlowStepWatchdog:
         if slow:
             self.triggers += 1
             self.last_trigger = (int(step), float(wall_s), float(med))
-            if self.captures < self.max_captures:
+            # never re-arm while a capture is in flight: the analyzer
+            # has not consumed the current window yet, and a second
+            # profiler session over the first would corrupt both
+            if self.captures < self.max_captures and not self.in_flight:
                 self._armed = True
+                self.last_arm_reason = {
+                    "step": int(step), "wall_s": float(wall_s),
+                    "median_s": float(med), "multiple": self.multiple,
+                    "window": len(self._times),
+                }
         return slow
 
     def should_capture(self):
         """Consume the armed flag: True exactly once per trigger — the
-        caller wraps the NEXT step in a profiler window."""
-        if not self._armed:
+        caller wraps the NEXT step in a profiler window and calls
+        :meth:`capture_finished` once that window closes."""
+        if not self._armed or self.in_flight:
             return False
         self._armed = False
         self.captures += 1
+        self.in_flight = True
         self._cooldown_left = self.cooldown
         return True
+
+    def capture_finished(self):
+        """The profiler window closed (and any post-capture analysis
+        ran): arming is allowed again, subject to the cooldown."""
+        self.in_flight = False

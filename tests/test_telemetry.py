@@ -225,9 +225,14 @@ def test_jsonl_writer_and_merge(tmp_path):
     manifest = telemetry.merge_worker_manifests(str(tmp_path))
     records = telemetry.load_manifest(str(tmp_path))
     assert manifest.endswith("manifest.jsonl")
-    # time-ordered across workers, rank annotation preserved
-    assert [(r["w"], r["t"]) for r in records] == [(1, 5.0), (0, 10.0),
+    # clock-offset corrected (worker 1's clock runs 5s behind worker 0's
+    # — both step-0 records are simultaneous events, so the shared step
+    # index pins the offset) then time-ordered, rank annotation preserved
+    assert [(r["w"], r["t"]) for r in records] == [(0, 10.0), (1, 10.0),
                                                   (0, 20.0)]
+    # the raw stamp survives for forensics
+    w1_rec = next(r for r in records if r["w"] == 1)
+    assert w1_rec["t_raw"] == 5.0
     _, errors = telemetry.validate_manifest(manifest)
     assert errors == []
     # empty dir merges to None

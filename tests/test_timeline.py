@@ -1,0 +1,490 @@
+"""Runtime timeline tier (docs/observability.md "Runtime tier").
+
+Covers the measured third of the predicted -> statically-realized ->
+MEASURED loop: the chrome-trace event model and interval algebra
+(``autodist_tpu/telemetry/timeline.py``), the T-code runtime audit over
+the golden fixtures (``tests/data/trace/``), cross-worker clock-offset
+correction + merge hygiene (``telemetry/aggregate.py``), the watchdog's
+arm-reason/in-flight contract, measured-bandwidth calibration
+(``cost_model.calibrate_bandwidths`` / ``note_measured``), the
+ElasticTrainer straggler hook, and the AD04 lint rule.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import optax
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from autodist_tpu import telemetry
+from autodist_tpu.analysis.runtime_audit import (BW_TOL, RECONCILE_TOL,
+                                                 audit_fixture,
+                                                 estimate_from_json,
+                                                 runtime_audit)
+from autodist_tpu.telemetry import aggregate
+from autodist_tpu.telemetry.timeline import (DeviceEvent, collective_kind,
+                                             device_events,
+                                             interval_intersection,
+                                             interval_total, merge_intervals,
+                                             step_skew, summarize_timeline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "data", "trace")
+PLAN = os.path.join(FIXDIR, "plan.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state():
+    """Telemetry enablement is process-global; leave it as found (off)."""
+    yield
+    telemetry.disable()
+    telemetry._STATE["run_dir"] = None
+    telemetry.reset_registry()
+
+
+# -- event classification and interval algebra ------------------------------
+
+def test_collective_kind_classification():
+    # dash (trace) and underscore (fixture/host) spellings both classify;
+    # reduce-scatter must win over the all-reduce substring check
+    assert collective_kind("reduce-scatter.1") == "reduce_scatter"
+    assert collective_kind("reduce_scatter_fusion") == "reduce_scatter"
+    assert collective_kind("all-reduce-start.2") == "all_reduce"
+    assert collective_kind("all_gather.3") == "all_gather"
+    assert collective_kind("all-to-all.9") == "all_to_all"
+    assert collective_kind("collective-permute.4") == "collective_permute"
+    assert collective_kind("fusion.17") is None
+    assert collective_kind("") is None
+    assert collective_kind(None) is None
+
+
+def test_interval_algebra_exact():
+    merged = merge_intervals([(0, 10), (5, 20), (30, 40), (40, 45)])
+    assert merged == [(0, 20), (30, 45)]
+    assert interval_total(merged) == 35
+    # intersection of disjoint lists, partial overlaps on both ends
+    assert interval_intersection([(0, 20), (30, 45)],
+                                 [(10, 35), (44, 50)]) == 16
+    assert interval_intersection([], [(0, 5)]) == 0.0
+
+
+def test_summarize_timeline_overlap_plus_exposed_is_collective():
+    devents = [
+        DeviceEvent("fusion.1", ts=0, dur=100),
+        DeviceEvent("all-reduce.1", ts=50, dur=100,
+                    collective="all_reduce", bytes=64.0),
+        DeviceEvent("all-reduce.2", ts=200, dur=50,
+                    collective="all_reduce"),
+    ]
+    ts = summarize_timeline(devents)
+    assert ts.compute_us == 100.0
+    assert ts.collective_us == 150.0
+    assert ts.overlap_us == 50.0          # 50..100 under fusion.1
+    assert ts.exposed_us == 100.0         # 100..150 and 200..250
+    assert ts.overlap_us + ts.exposed_us == ts.collective_us
+    assert ts.total_us == 200.0           # union: 0..150 + 200..250
+    assert ts.n_collective_events == 2
+    row = ts.collectives["all-reduce.1"]
+    assert row["kind"] == "all_reduce" and row["bytes"] == 64.0
+
+
+def test_device_events_host_only_fallback():
+    # no metadata names a device lane -> every X event kept, host_only
+    events = [
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "python main"}},
+        {"ph": "X", "pid": 9, "tid": 1, "name": "all-reduce.1",
+         "ts": 0, "dur": 10},
+        {"ph": "B", "pid": 9, "tid": 1, "name": "begin", "ts": 0},
+    ]
+    devents, info = device_events(events)
+    assert info["host_only"] and len(devents) == 1
+    assert devents[0].collective == "all_reduce"
+
+
+# -- golden fixtures through the audit ---------------------------------------
+
+def test_overlapped_fixture_reconciles_within_tolerance():
+    findings = audit_fixture(
+        trace_path=os.path.join(FIXDIR, "overlapped.trace.json"),
+        plan_path=PLAN)
+    codes = [f.code for f in findings]
+    assert codes == ["T006"]              # clean capture: table only
+    data = findings[0].data
+    assert not data["host_only"]
+    rec = data["reconcile"]
+    assert abs(rec["rel_error"]) <= RECONCILE_TOL
+    # hop walls were designed to match the plan exactly: measured
+    # bandwidth comes back at spec, per-hop error 0
+    assert data["measured_bandwidths"]["ici_gbps"] == pytest.approx(1600.0)
+    assert data["measured_bandwidths"]["dcn_gbps"] == pytest.approx(100.0)
+    for hop in ("ici", "dcn"):
+        assert abs(data["hops"][hop]["rel_error"]) < 1e-9
+    # measured overlap reconciles with CostEstimate.overlapped_s: the
+    # capture hides every collective under compute (overlap_frac 1.0)
+    assert data["measured"]["overlap_frac"] == pytest.approx(1.0)
+    assert data["measured"]["exposed_frac"] == pytest.approx(0.0)
+
+
+def test_exposed_fixture_fires_t001_and_t004():
+    findings = audit_fixture(
+        trace_path=os.path.join(FIXDIR, "exposed_comm.trace.json"),
+        plan_path=PLAN)
+    by_code = {f.code: f for f in findings}
+    assert "T001" in by_code and int(by_code["T001"].severity) == 2
+    assert "T004" in by_code            # overlap credit priced, not realized
+    assert "T006" in by_code
+    assert by_code["T006"].data["measured"]["exposed_frac"] == \
+        pytest.approx(0.5)
+
+
+def test_skewed_pair_fires_t002_with_address():
+    findings = audit_fixture(
+        manifest_dir=os.path.join(FIXDIR, "skewed_pair"))
+    t2 = next(f for f in findings if f.code == "T002")
+    assert int(t2.severity) == 2
+    assert t2.subject == "host-b:8471"
+    assert "host-b:8471" in t2.message
+    skew = t2.data
+    assert skew["straggler"] == 1
+    assert skew["per_worker_median_s"][0] == pytest.approx(0.1)
+    assert skew["per_worker_median_s"][1] == pytest.approx(0.16)
+    assert skew["skew_s"] == pytest.approx(0.06)
+
+
+def test_host_only_capture_suppresses_hardware_codes():
+    # a CPU-mesh capture: collectives visible, no device lane — the
+    # audit must emit its T006 (host_only) but never price hardware
+    # comparisons (T001/T003/T004/T005) off host-lane timings
+    events = [
+        {"ph": "X", "pid": 9, "tid": 1, "name": "fusion.1",
+         "ts": 0, "dur": 100},
+        {"ph": "X", "pid": 9, "tid": 2, "name": "all-reduce.1",
+         "ts": 100, "dur": 900},
+    ]
+    devents, info = device_events(events)
+    tsummary = summarize_timeline(devents, info)
+    assert tsummary.host_only
+    with open(PLAN) as f:
+        plan_doc = json.load(f)
+    est = estimate_from_json(plan_doc["estimate"])
+    findings = runtime_audit(tsummary, plan_doc["channels"], est,
+                             source="host-only test")
+    codes = {f.code for f in findings}
+    assert "T006" in codes
+    assert not codes & {"T001", "T003", "T004", "T005"}
+    t6 = next(f for f in findings if f.code == "T006")
+    assert t6.data["host_only"]
+    # host-lane walls must never masquerade as link measurements — a
+    # bogus measured_gbps here would poison calibrate_bandwidths
+    assert t6.data["measured_bandwidths"] == {}
+    assert all(h["measured_gbps"] is None for h in t6.data["hops"].values())
+
+
+def test_t003_fires_when_hop_is_slower_than_spec():
+    # same plan, but the ICI phase measured 2x its predicted wall
+    with open(PLAN) as f:
+        plan_doc = json.load(f)
+    est = estimate_from_json(plan_doc["estimate"])
+    devents = [
+        DeviceEvent("fusion.1", ts=0, dur=4000),
+        DeviceEvent("reduce-scatter.1", ts=0, dur=1600,
+                    collective="reduce_scatter", bytes=8388608.0),
+        DeviceEvent("all-reduce.2", ts=1600, dur=400,
+                    collective="all_reduce", bytes=2097152.0),
+        DeviceEvent("all-gather.3", ts=2000, dur=1600,
+                    collective="all_gather", bytes=8388608.0),
+    ]
+    tsummary = summarize_timeline(devents, {"host_only": False})
+    findings = runtime_audit(tsummary, plan_doc["channels"], est,
+                             source="slow-ici test")
+    t3 = [f for f in findings if f.code == "T003"]
+    assert t3 and t3[0].subject == "ici"
+    t6 = next(f for f in findings if f.code == "T006")
+    ici = t6.data["hops"]["ici"]
+    assert ici["rel_error"] > BW_TOL
+    assert ici["measured_gbps"] == pytest.approx(800.0)  # half of spec
+
+
+# -- cross-worker aggregation -------------------------------------------------
+
+def test_skewed_pair_clock_offset_estimated_from_step_indices():
+    records, stats = aggregate.merge_records(
+        os.path.join(FIXDIR, "skewed_pair"))
+    # worker 1 writes t with a +100s injected clock offset; shared step
+    # indices pin it (median of t_w[k] - t_ref[k])
+    assert stats["clock_offsets_s"][0] == 0.0
+    assert stats["clock_offsets_s"][1] == pytest.approx(100.0, abs=1.0)
+    # corrected records interleave on real time and keep the raw stamp
+    w1 = [r for r in records if r.get("w") == 1 and r.get("kind") == "step"]
+    assert all("t_raw" in r and r["t_raw"] - r["t"] ==
+               pytest.approx(stats["clock_offsets_s"][1]) for r in w1)
+    # skew survives the correction: durations are offset-free
+    skew = step_skew(records)
+    assert skew["straggler"] == 1
+
+
+def test_merge_edge_cases_skip_and_count_never_raise(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    w0 = [{"kind": "meta", "w": 0, "t": 0.0},
+          {"kind": "step", "w": 0, "step": 0, "t": 1.0, "wall_s": 0.1},
+          {"kind": "step", "w": 0, "step": 1, "t": 2.0, "wall_s": 0.1},
+          # duplicate step: a restarted worker replayed it
+          {"kind": "step", "w": 0, "step": 1, "t": 2.5, "wall_s": 0.9}]
+    (run / "worker_0.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in w0) + "\n")
+    # torn trailing line from a crashed writer
+    (run / "worker_1.jsonl").write_text(
+        json.dumps({"kind": "step", "w": 1, "step": 0, "t": 1.0,
+                    "wall_s": 0.2}) + "\n" + '{"kind": "step", "w": 1, "st')
+    telemetry.reset_registry()
+    telemetry.enable(run_dir=str(tmp_path / "tel"))
+    records, stats = aggregate.merge_records(str(run))
+    assert stats["skipped_lines"] == 1
+    assert stats["skipped_duplicates"] == 1
+    steps = [(r["w"], r["step"]) for r in records if r["kind"] == "step"]
+    assert steps.count((0, 1)) == 1      # first write wins
+    assert (1, 0) in steps
+    # the counters made the data loss visible
+    reg = telemetry.get_registry()
+    assert reg.counter_value("aggregate.skipped_lines") == 1.0
+    assert reg.counter_value("aggregate.skipped_duplicates") == 1.0
+    # a missing worker file is skipped and counted, never raised
+    assert aggregate._parse_lines(str(run / "worker_9.jsonl")) == ([], 1)
+    # an empty run dir merges to nothing
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    records, stats = aggregate.merge_records(str(empty))
+    assert records == [] and stats["skipped_lines"] == 0
+
+
+def test_step_skew_needs_two_workers_with_steady_state():
+    assert step_skew([]) is None
+    one = [{"kind": "step", "w": 0, "step": s, "wall_s": 0.1}
+           for s in range(4)]
+    assert step_skew(one) is None
+    # balanced pair: no straggler attribution below the threshold
+    two = one + [{"kind": "step", "w": 1, "step": s, "wall_s": 0.11}
+                 for s in range(4)]
+    skew = step_skew(two)
+    assert skew["straggler"] is None and skew["straggler_addr"] is None
+
+
+# -- watchdog arm-reason + in-flight guard -----------------------------------
+
+def test_watchdog_arm_reason_and_in_flight_guard():
+    from autodist_tpu.telemetry.watchdog import SlowStepWatchdog
+
+    wd = SlowStepWatchdog(multiple=2.0, window=8, min_steps=3, cooldown=0,
+                          max_captures=4)
+    for i in range(5):
+        assert not wd.observe(i, 0.1)
+    assert wd.last_arm_reason is None
+    assert wd.observe(5, 1.0)
+    reason = wd.last_arm_reason
+    assert reason["step"] == 5 and reason["wall_s"] == 1.0
+    assert reason["median_s"] == pytest.approx(0.1)
+    assert reason["multiple"] == 2.0
+    assert wd.should_capture() and wd.in_flight
+    # while the capture is in flight a new outlier is OBSERVED but must
+    # not re-arm (a second profiler session would corrupt the first)
+    assert wd.observe(6, 1.0)
+    assert not wd.should_capture()
+    wd.capture_finished()
+    assert not wd.in_flight
+    assert wd.observe(7, 1.5)            # arming allowed again
+    assert wd.should_capture()
+    assert wd.captures == 2
+
+
+# -- live session: arm-reason record + capture auto-analysis ------------------
+
+def test_session_writes_arm_reason_and_runtime_findings(tmp_path):
+    import jax.numpy as jnp
+
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.telemetry.watchdog import SlowStepWatchdog
+
+    run_dir = str(tmp_path / "run")
+    telemetry.enable(run_dir=run_dir)
+
+    def loss(p, batch):
+        return jnp.mean((batch @ p["w"]) ** 2)
+
+    rs = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rs.randn(12, 3), jnp.float32)}
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(8),
+                  strategy_builder=AllReduce())
+    sess = ad.distribute(loss, params, optax.sgd(0.1))
+    sess._telemetry.watchdog = SlowStepWatchdog(
+        multiple=0.0, window=8, min_steps=1, cooldown=0, max_captures=1)
+    batch = rs.randn(16, 12).astype(np.float32)
+    sess.run_steps([batch] * 4)
+    records = telemetry.load_manifest(run_dir)
+
+    armed = [r for r in records if r["kind"] == "watchdog_armed"]
+    assert armed, "no watchdog_armed record: the trigger reason is lost"
+    assert {"step", "wall_s", "median_s", "multiple"} <= set(armed[0])
+
+    captured = [r for r in records if r["kind"] == "watchdog"]
+    assert len(captured) == 1
+    # the capture auto-ran the runtime analyzer: T-codes in the stream
+    rt = [r for r in records if r["kind"] == "runtime_finding"]
+    assert rt, "watchdog capture was not auto-analyzed"
+    t6 = [r for r in rt if r["code"] == "T006"]
+    assert t6 and t6[0]["data"]["host_only"]  # CPU capture: no device lane
+    assert not any(r["code"] == "T001" for r in rt)
+    # and the in-flight guard released after analysis
+    assert not sess._telemetry.watchdog.in_flight
+    reg = telemetry.get_registry()
+    assert reg.counter_value("runtime_audit.T006") >= 1.0
+
+
+# -- measured-bandwidth calibration ------------------------------------------
+
+def test_calibrate_bandwidths_median_and_hops_unwrap():
+    from autodist_tpu.simulator.cost_model import calibrate_bandwidths
+
+    cal = calibrate_bandwidths([
+        {"ici_gbps": 1200.0, "dcn_gbps": 80.0},
+        {"ici_gbps": 1400.0},
+        # a T006 hops table is unwrapped
+        {"ici": {"measured_gbps": 1000.0}, "dcn": {"measured_gbps": 90.0}},
+    ])
+    assert cal["ici_gbps"] == pytest.approx(1200.0)   # median of 3
+    assert cal["dcn_gbps"] == pytest.approx(85.0)     # median of 2
+    assert calibrate_bandwidths([]) == {}
+    assert calibrate_bandwidths([{}, None]) == {}
+
+
+def test_calibrate_from_records_accepts_measured_bandwidths():
+    from autodist_tpu.simulator.cost_model import calibrate_from_records
+
+    path = os.path.join(REPO, "records", "cpu_mesh",
+                        "gpt_tiny_AllReduce_two_level.json")
+    cal_spec, pairs_spec = calibrate_from_records([path])
+    cal_meas, pairs_meas = calibrate_from_records(
+        [path], measured_bandwidths={"ici_gbps": 800.0, "dcn_gbps": 50.0})
+    assert set(cal_meas) == {"compute_scale", "comm_scale", "overhead_s"}
+    # halved bandwidths re-price the estimate's comm time upward
+    assert pairs_meas[0][0].comm_s > pairs_spec[0][0].comm_s
+
+
+def test_note_measured_records_hop_bandwidths():
+    import jax.numpy as jnp
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.simulator.cost_model import DEFAULT_ICI_GBPS
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    def loss(p, batch):
+        return jnp.mean((batch @ p["w"]) ** 2)
+
+    rs = np.random.RandomState(3)
+    item = ModelItem(loss, {"w": jnp.asarray(rs.randn(12, 3), jnp.float32)},
+                     optax.sgd(0.1))
+    b = AutoStrategy(verify=False)
+    b.build(item, ResourceSpec.from_num_chips(8))
+    b.note_measured(0.01, hop_bandwidths={"ici_gbps": 800.0})
+    hops = b.last_prediction_error["hops"]
+    assert hops["ici"]["measured_gbps"] == 800.0
+    assert hops["ici"]["spec_gbps"] == DEFAULT_ICI_GBPS
+    assert hops["ici"]["rel_error"] == pytest.approx(
+        (800.0 - DEFAULT_ICI_GBPS) / DEFAULT_ICI_GBPS)
+    assert "dcn" not in hops
+
+
+# -- the ElasticTrainer straggler hook ---------------------------------------
+
+def test_note_straggler_persistence_gates_the_callback():
+    from autodist_tpu.elastic import ElasticTrainer
+
+    fired = []
+    tr = ElasticTrainer.__new__(ElasticTrainer)   # hook logic only
+    tr.on_straggler = fired.append
+    tr._straggler_streak = {}
+    tr.straggler_signals = 0
+    skew = {"straggler_addr": "host-b:8471", "skew_s": 0.06}
+    assert not tr.note_straggler(skew)            # 1st signal: below gate
+    assert tr.note_straggler(skew)                # 2nd consecutive: fires
+    assert fired == [skew]
+    # a clean audit (no straggler) resets the streak
+    assert not tr.note_straggler({"straggler_addr": None})
+    assert not tr.note_straggler(skew)
+    # switching address restarts the count
+    assert not tr.note_straggler({"straggler_addr": "host-c:8471"})
+    assert tr._straggler_streak == {"host-c:8471": 1}
+    assert tr.straggler_signals == 4
+
+
+# -- AD04 lint rule -----------------------------------------------------------
+
+def _lint_snippet(tmp_path, relpath, source):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [code for _p, _ln, code, _m in lint.lint_file(p)]
+
+
+def test_ad04_flags_adhoc_chrome_trace_parsing(tmp_path):
+    bad = ('import json\n'
+           'def load(p):\n'
+           '    with open(p) as f:\n'
+           '        return json.load(f)["traceEvents"]\n')
+    assert "AD04" in _lint_snippet(tmp_path, "autodist_tpu/x.py", bad)
+    assert "AD04" in _lint_snippet(tmp_path, "tools/y.py", bad)
+
+
+def test_ad04_exempts_the_blessed_parser_and_tests(tmp_path):
+    bad = 'EVENTS = {"traceEvents": []}\n'
+    assert "AD04" not in _lint_snippet(
+        tmp_path, "autodist_tpu/telemetry/timeline.py", bad)
+    assert "AD04" not in _lint_snippet(
+        tmp_path, "tools/trace_summary.py", bad)
+    assert "AD04" not in _lint_snippet(tmp_path, "tests/test_z.py", bad)
+
+
+# -- the verify pipeline runs the runtime tier --------------------------------
+
+def test_verify_strategy_runtime_pass_emits_t006():
+    from autodist_tpu.analysis import (LOWERED_PASSES, RUNTIME_PASSES,
+                                       STATIC_PASSES, TRACE_PASSES,
+                                       verify_strategy)
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.simulator.cost_model import (RuntimeRecord,
+                                                   rebuild_record_case)
+
+    assert RUNTIME_PASSES == ("runtime-audit",)
+    path = os.path.join(REPO, "records", "cpu_mesh",
+                        "gpt_tiny_AllReduce.json")
+    rec = RuntimeRecord.load(path)
+    strategy, item, R = rebuild_record_case(rec)
+    report = verify_strategy(
+        strategy, item, ResourceSpec.from_num_chips(R),
+        batch_shapes={"x": ((2 * R, 4), "float32")},
+        passes=STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+        + RUNTIME_PASSES,
+        trace_dir=os.path.join(FIXDIR))
+    assert report.ok, [str(f) for f in report.errors]
+    t6 = next(f for f in report.findings if f.code == "T006")
+    assert t6.data["measured"]["total_s"] > 0
+    # without a trace the tier degrades to the T000 skip marker
+    report = verify_strategy(
+        strategy, item, ResourceSpec.from_num_chips(R),
+        batch_shapes={"x": ((2 * R, 4), "float32")},
+        passes=STATIC_PASSES + TRACE_PASSES + RUNTIME_PASSES)
+    assert any(f.code == "T000" for f in report.findings)
+    assert report.ok

@@ -98,6 +98,43 @@ def test_host_span_join_pins_overlap():
     assert idle["device_us"] == 0.0 and idle["device_share"] == 0.0
 
 
+def test_main_host_only_trace_degrades_gracefully(tmp_path, capsys):
+    # a CPU/host-only capture has no device-pattern lane — the CLI must
+    # say so and summarize the host tracks instead of printing nothing
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    run_dir.mkdir(parents=True)
+    host_only = [
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "python host"}},
+        {"ph": "X", "pid": 2, "tid": 9, "name": "host_thing",
+         "ts": 0, "dur": 500},
+    ]
+    (run_dir / "host.trace.json").write_text(
+        json.dumps({"traceEvents": host_only}))
+    rc = trace_summary.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no device events — host-only trace" in out
+    assert "host_thing" in out
+
+
+def test_main_trace_without_complete_events(tmp_path, capsys):
+    # metadata only, zero 'X' events: still exits 0 with a clear message
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    run_dir.mkdir(parents=True)
+    meta_only = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "B", "pid": 1, "tid": 1, "name": "begin.only", "ts": 100},
+    ]
+    (run_dir / "host.trace.json").write_text(
+        json.dumps({"traceEvents": meta_only}))
+    rc = trace_summary.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no complete ('X') events" in out
+
+
 def test_main_with_host_spans(tmp_path, capsys):
     # spans live OUTSIDE the profile dir — find_trace_file globs every
     # *.trace.json under its argument and must not pick the span dump

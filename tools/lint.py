@@ -42,6 +42,15 @@ codebase:
         into an MFU claim.  Scoped to ``autodist_tpu/`` and ``tools/``;
         ``simulator/cost_model.py`` (the blessed accounting site) is
         exempt.
+  AD04  ad-hoc chrome-trace JSON parsing in engine/tool code: the
+        ``"traceEvents"`` key appearing outside the blessed parser
+        (``autodist_tpu/telemetry/`` — ``timeline.py`` owns the event
+        model — and ``tools/trace_summary.py``, its human-facing view).
+        A local trace loader silently diverges on the details the
+        runtime audit depends on (gzip handling, device-lane detection,
+        the ph=="X" filter); route parsing through
+        ``telemetry.timeline.load_events`` / ``summarize_trace``.
+        Scoped to ``autodist_tpu/`` and ``tools/``.
 
 Exit code 1 when any finding is reported.
 """
@@ -83,6 +92,20 @@ def _ad03_applies(path):
     p = Path(path)
     return any(part in _AD01_PARTS for part in p.parts) \
         and p.name != _AD03_EXEMPT
+
+
+# AD04 shares AD01's engine+tool scope; autodist_tpu/telemetry/ (the
+# blessed chrome-trace event model, timeline.py) and tools/
+# trace_summary.py (its human-facing view) are exempt
+_AD04_EXEMPT_NAME = "trace_summary.py"
+_AD04_EXEMPT_DIR = "telemetry"
+
+
+def _ad04_applies(path):
+    p = Path(path)
+    return any(part in _AD01_PARTS for part in p.parts) \
+        and _AD04_EXEMPT_DIR not in p.parts \
+        and p.name not in (_AD04_EXEMPT_NAME, "lint.py")
 
 
 class Checker(ast.NodeVisitor):
@@ -278,6 +301,18 @@ class Checker(ast.NodeVisitor):
                      "(dot_flops/conv_flops/elementwise_flops/"
                      "jaxpr_flops) so the jaxpr model and the HLO "
                      "compute audit cannot drift")
+        self.generic_visit(node)
+
+    # -- AD04: ad-hoc chrome-trace parsing ---------------------------------
+
+    def visit_Constant(self, node):
+        if node.value == "traceEvents" and _ad04_applies(self.path):
+            self.add(node.lineno, "AD04",
+                     "ad-hoc chrome-trace parsing ('traceEvents'): route "
+                     "trace loading through telemetry.timeline "
+                     "(load_events/summarize_trace) so gzip handling, "
+                     "device-lane detection and the runtime audit's "
+                     "event model cannot drift")
         self.generic_visit(node)
 
     def visit_Compare(self, node):

@@ -25,7 +25,14 @@ absent; schema in ``autodist_tpu/telemetry/schema.py``) and reports:
   per-region attribution, recompute — with the PREDICTED MFU ceiling
   joined against the run's MEASURED achieved MFU: a measured MFU close
   to the ceiling means the gap is structural (recompute, lowering-added
-  work), not a launch/overlap problem.
+  work), not a launch/overlap problem,
+- with ``--timeline [report.json]`` (the ``tools/verify_strategy.py
+  --runtime --json`` output, or a bare T006 ``data`` dump): the runtime
+  audit's three-way table — predicted vs statically-realized vs MEASURED
+  step decomposition, per-hop predicted-vs-measured bandwidth error,
+  worker skew, and the overlap reconciliation; with no artifact argument
+  the tables come from the manifest itself (the ``runtime_finding``
+  records a SlowStepWatchdog capture auto-writes).
 """
 import argparse
 import json
@@ -330,6 +337,87 @@ def render_audit(audits, summary=None):
     return "\n".join(lines)
 
 
+def load_timeline(path=None, records=None):
+    """Extract T006 three-way tables from a runtime-audit artifact
+    (``verify_strategy --runtime --json`` report, or a bare T006 ``data``
+    dump) and/or the manifest's own ``runtime_finding`` records (written
+    when a SlowStepWatchdog capture auto-runs the analyzer).  Returns
+    ``[(name, table), ...]``."""
+    out = []
+    if path:
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict) and "measured" in doc:
+            out.append((doc.get("source", os.path.basename(path)), doc))
+        else:
+            for name, report in (doc.items()
+                                 if isinstance(doc, dict) else []):
+                for finding in report.get("findings", []):
+                    if finding.get("code") == "T006" and finding.get("data"):
+                        out.append((os.path.basename(name),
+                                    finding["data"]))
+    for r in records or []:
+        if r.get("kind") == "runtime_finding" and r.get("code") == "T006" \
+                and r.get("data"):
+            out.append((f"watchdog step {r.get('step')}", r["data"]))
+    return out
+
+
+def render_timeline(timelines, summary=None):
+    """The three-way closing of the loop: predicted (cost model) vs
+    statically-realized (plan channels) vs MEASURED (device timeline)
+    step decomposition, per-hop bandwidth error, and worker skew."""
+    lines = []
+    for name, table in timelines:
+        meas = table.get("measured") or {}
+        host = " [host-only capture]" if table.get("host_only") else ""
+        lines.append(
+            f"runtime timeline — {name} "
+            f"({table.get('n_collective_events', 0)} collective "
+            f"event(s), {table.get('source', 'trace')}){host}:")
+        lines.append(
+            f"  measured  total {_fmt_s(meas.get('total_s'))}  compute "
+            f"{_fmt_s(meas.get('compute_s'))}  collective "
+            f"{_fmt_s(meas.get('collective_s'))}  exposed "
+            f"{meas.get('exposed_frac', 0.0):.0%}  overlap "
+            f"{meas.get('overlap_frac', 0.0):.0%}")
+        pred = table.get("predicted")
+        if pred:
+            lines.append(
+                f"  predicted total {_fmt_s(pred.get('total_s'))}  "
+                f"compute {_fmt_s(pred.get('compute_s'))}  comm "
+                f"{_fmt_s(pred.get('comm_s'))}  exposed "
+                f"{pred.get('exposed_frac', 0.0):.0%} "
+                f"({pred.get('schedule')} schedule)")
+        for hop, h in sorted((table.get("hops") or {}).items()):
+            row = (f"  {hop.upper():4s} hop  predicted "
+                   f"{_fmt_s(h.get('predicted_s'))}  measured "
+                   f"{_fmt_s(h.get('measured_s'))}")
+            if h.get("measured_gbps") is not None:
+                row += (f"  bw {h['measured_gbps']:.0f}/"
+                        f"{h.get('spec_gbps', 0):.0f} Gbit/s "
+                        f"(error {h['rel_error']:+.0%})")
+            lines.append(row)
+        skew = table.get("skew")
+        if skew:
+            who = skew.get("straggler_addr") or skew.get("straggler")
+            lines.append(
+                f"  worker skew {_fmt_s(skew.get('skew_s'))} "
+                f"(fastest {_fmt_s(skew.get('fastest_s'))}, threshold "
+                f"{_fmt_s(skew.get('threshold_s'))})"
+                + (f" — straggler {who}" if who is not None else ""))
+        rec = table.get("reconcile")
+        if rec and rec.get("rel_error") is not None:
+            lines.append(
+                f"  reconcile: measured {_fmt_s(rec.get('measured_total_s'))}"
+                f" vs predicted {_fmt_s(rec.get('predicted_total_s'))} "
+                f"({rec['rel_error']:+.1%})")
+    if summary and summary.get("step_time_p50_s") is not None:
+        lines.append(f"  measured step wall p50: "
+                     f"{_fmt_s(summary['step_time_p50_s'])}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("path", help="telemetry run dir or manifest.jsonl")
@@ -346,6 +434,14 @@ def main(argv=None):
                          "AutoStrategy.last_compute_audit dump): show the "
                          "F006 FLOP table and join the predicted MFU "
                          "ceiling against the measured achieved MFU")
+    ap.add_argument("--timeline", nargs="?", const="", default=None,
+                    metavar="REPORT_JSON",
+                    help="runtime-audit artifact (verify_strategy "
+                         "--runtime --json output or a bare T006 data "
+                         "dump; default: the manifest's own "
+                         "runtime_finding records): show the T006 "
+                         "three-way table with per-hop "
+                         "predicted-vs-measured bandwidth error")
     args = ap.parse_args(argv)
     records = load_manifest(args.path)
     if not records:
@@ -358,6 +454,15 @@ def main(argv=None):
     computes = load_compute(args.compute) if args.compute else []
     if computes:
         summary["compute_audit"] = {name: table for name, table in computes}
+    timelines = []
+    if args.timeline is not None:
+        timelines = load_timeline(args.timeline or None, records)
+        if not timelines:
+            print("no T006 timeline tables found (pass a verify_strategy "
+                  "--runtime --json artifact, or run with a watchdog "
+                  "capture in the manifest)", file=sys.stderr)
+        else:
+            summary["runtime_timeline"] = {n: t for n, t in timelines}
     if args.json:
         print(json.dumps(summary, indent=2))
     else:
@@ -366,6 +471,8 @@ def main(argv=None):
             print(render_audit(audits, summary))
         if computes:
             print(render_compute(computes, summary))
+        if timelines:
+            print(render_timeline(timelines, summary))
     return 0
 
 

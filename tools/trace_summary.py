@@ -9,6 +9,12 @@ aggregates complete events on device-side tracks (TPU/accelerator lanes)
 by event name — the quick "where do the milliseconds go" view for MFU work
 (STATUS.md round-3 item 2) without external profiler tooling.
 
+The chrome-trace event model (loaders, device-lane detection) lives in
+:mod:`autodist_tpu.telemetry.timeline` — the one blessed parser
+(``tools/lint.py`` AD04) — and is re-exported here for compatibility;
+this tool is the human-facing view, ``autodist_tpu/analysis/
+runtime_audit.py`` the machine-facing one.
+
 ``--host-spans`` joins the host-side span file the telemetry layer dumps
 (``host_spans_worker_<rank>.trace.json`` — same wall-clock-microsecond
 timebase) against the device lanes: per host span, how much device time
@@ -16,45 +22,39 @@ ran concurrently inside its window — the host/device overlap view for
 input-pipeline and dispatch-stall hunting (docs/observability.md).
 """
 import argparse
-import glob
-import gzip
-import json
 import os
-import re
 import sys
 from collections import defaultdict
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from autodist_tpu.telemetry import timeline  # noqa: E402
+from autodist_tpu.telemetry.timeline import (DEVICE_PAT,  # noqa: E402,F401
+                                             load_events, process_names)
+
+# compatibility alias: tests and older callers import the pattern under
+# its historical name
+_DEVICE_PAT = DEVICE_PAT
+
 
 def find_trace_file(trace_dir):
-    pats = [os.path.join(trace_dir, "**", "*.trace.json.gz"),
-            os.path.join(trace_dir, "**", "*.trace.json")]
-    hits = []
-    for p in pats:
-        hits.extend(glob.glob(p, recursive=True))
-    if not hits:
+    """Newest trace file under ``trace_dir``; exits with a clear message
+    when none exists (CLI contract — the library-side
+    :func:`timeline.find_trace_file` returns None instead)."""
+    path = timeline.find_trace_file(trace_dir)
+    if path is None:
         raise SystemExit(f"no *.trace.json(.gz) under {trace_dir}")
-    return max(hits, key=os.path.getmtime)
-
-
-def load_events(path):
-    op = gzip.open if path.endswith(".gz") else open
-    with op(path, "rt") as f:
-        data = json.load(f)
-    return data.get("traceEvents", data if isinstance(data, list) else [])
-
-
-_DEVICE_PAT = re.compile(r"TPU|/device:|XLA Op|Accelerator|GPU", re.I)
+    return path
 
 
 def summarize(events, device_only=True):
     """name -> (total_us, count), restricted to device tracks when the
     metadata allows telling them apart."""
-    # process-id -> process name from metadata events
-    pnames = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pnames[e.get("pid")] = e.get("args", {}).get("name", "")
-    device_pids = {pid for pid, n in pnames.items() if _DEVICE_PAT.search(n or "")}
+    pnames = process_names(events)
+    device_pids = {pid for pid, n in pnames.items()
+                   if DEVICE_PAT.search(n or "")}
     agg = defaultdict(lambda: [0.0, 0])
     total = 0.0
     for e in events:
@@ -73,11 +73,9 @@ def summarize(events, device_only=True):
 def device_intervals(events, pnames=None):
     """Complete events on device tracks as (start_us, end_us) intervals."""
     if pnames is None:
-        pnames = {e.get("pid"): e.get("args", {}).get("name", "")
-                  for e in events
-                  if e.get("ph") == "M" and e.get("name") == "process_name"}
+        pnames = process_names(events)
     device_pids = {pid for pid, n in pnames.items()
-                   if _DEVICE_PAT.search(n or "")}
+                   if DEVICE_PAT.search(n or "")}
     out = []
     for e in events:
         if e.get("ph") != "X":
@@ -144,10 +142,23 @@ def main(argv=None):
     path = find_trace_file(args.trace_dir)
     events = load_events(path)
     agg, total, pnames = summarize(events, device_only=not args.all_tracks)
-    if not agg:
-        # fall back to every track (some runs label devices differently)
+    device_pids = {pid for pid, n in pnames.items()
+                   if DEVICE_PAT.search(n or "")}
+    host_only = not device_pids
+    if not agg and not host_only:
+        # device lanes declared but empty: fall back to every track
         agg, total, pnames = summarize(events, device_only=False)
-        print("(no recognizable device track; showing all tracks)")
+        print("(device track declared but empty; showing all tracks)")
+    elif host_only and not args.all_tracks:
+        # no device lane at all (CPU-backend capture, host-side dump):
+        # summarize what exists instead of pretending lanes are there
+        print("no device events — host-only trace; summarizing host "
+              "tracks")
+    if not agg:
+        print(f"trace: {path}")
+        print("no complete ('X') events in this trace — nothing to "
+              "summarize")
+        return 0
     print(f"trace: {path}")
     print(f"tracks: {sorted(set(filter(None, pnames.values())))[:8]}")
     print(f"total event time: {total / 1e3:.2f} ms over {len(agg)} op names")

@@ -31,6 +31,16 @@ Targets:
   (the F006 table every target must emit); with ``--selftest``, the
   seeded remat-everything case must be caught as F002 and the seeded
   dropped-donation case as F004.
+- ``--runtime [TRACE_DIR]`` — run the RUNTIME audit tier (T-codes): a
+  ``jax.profiler`` chrome-trace capture is parsed, its collective
+  events matched against the strategy's intended channel table, and
+  the measured overlap / per-hop bandwidth / exposed-comm fraction
+  diffed against the cost model's prediction (T006 is the
+  machine-readable three-way table every target must emit); with
+  ``--selftest``, the golden trace fixtures under ``tests/data/trace``
+  must fire T001 on the exposed-comm step, T002 on the skewed
+  two-worker pair, and reconcile the overlapped step against
+  ``CostEstimate.overlapped_s`` within tolerance.
 
 Exit status: 0 when every target is free of ERROR findings (and the
 selftest, when requested, fires correctly); 1 otherwise.
@@ -127,6 +137,14 @@ def main(argv=None):
                          "(F-codes): realized-vs-model FLOPs, recompute, "
                          "dtype and donation checks, predicted MFU "
                          "ceiling; every target must emit its F006 table")
+    ap.add_argument("--runtime", nargs="?", const="", default=None,
+                    metavar="TRACE_DIR",
+                    help="also run the RUNTIME audit tier (T-codes) "
+                         "against a jax.profiler chrome-trace capture: "
+                         "measured overlap, per-hop bandwidth and "
+                         "exposed-comm fraction diffed against the "
+                         "prediction; every target must emit its T006 "
+                         "three-way table")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write all reports as JSON to this path")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -134,8 +152,9 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     _force_cpu_devices()
-    from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
-                                       TRACE_PASSES, verify_strategy)
+    from autodist_tpu.analysis import (LOWERED_PASSES, RUNTIME_PASSES,
+                                       STATIC_PASSES, TRACE_PASSES,
+                                       verify_strategy)
     from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
                                              EXPECTED_DONATION_CODE,
                                              EXPECTED_ERROR_CODES,
@@ -145,8 +164,10 @@ def main(argv=None):
                                              build_rejected_case,
                                              build_reshard_case)
 
-    if (args.hlo or args.compute) and args.static_only:
-        ap.error("--hlo/--compute need the traced step; drop --static-only")
+    if (args.hlo or args.compute or args.runtime is not None) \
+            and args.static_only:
+        ap.error("--hlo/--compute/--runtime need the traced step; "
+                 "drop --static-only")
 
     hbm_bytes = int(args.hbm_gib * 1024 ** 3)
     if args.device_kind:
@@ -165,9 +186,17 @@ def main(argv=None):
         passes = STATIC_PASSES + TRACE_PASSES + ("compute-audit",)
     else:
         passes = None
+    if args.runtime is not None:
+        base = passes if passes is not None else \
+            STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+        passes = base + RUNTIME_PASSES
+    trace_dir = args.runtime or None
     # with a lowered compute pass selected, every record target must
     # produce its machine-readable F006 compute table
     want_f006 = bool(passes) and "compute-audit" in passes
+    # with the runtime tier selected, every record target must produce
+    # its machine-readable T006 three-way table
+    want_t006 = bool(passes) and "runtime-audit" in passes
     results = {}
     failed = False
 
@@ -189,10 +218,17 @@ def main(argv=None):
             print(f"[ERROR] {path}: cannot load record: {e}")
             failed = True
             continue
-        report = verify_strategy(passes=passes, **case)
+        report = verify_strategy(passes=passes, trace_dir=trace_dir, **case)
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
         failed = failed or not report.ok
+        if want_t006:
+            t6 = next((f for f in report.findings if f.code == "T006"),
+                      None)
+            if t6 is None:
+                print(f"[ERROR] {os.path.basename(path)}: runtime audit "
+                      f"produced no T006 table")
+                failed = True
         if want_f006:
             f6 = next((f for f in report.findings if f.code == "F006"),
                       None)
@@ -218,6 +254,7 @@ def main(argv=None):
     for path in args.case:
         case = _load_case_file(path)
         case.setdefault("hbm_bytes_per_device", hbm_bytes)
+        case.setdefault("trace_dir", trace_dir)
         report = verify_strategy(passes=passes, **case)
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
@@ -275,6 +312,64 @@ def main(argv=None):
                 else:
                     print(f"compute selftest passed: the {label} case "
                           f"is {want}")
+        if args.runtime is not None:
+            # the golden trace fixtures (tests/data/trace): the
+            # exposed-comm step must be caught as T001, the skewed
+            # two-worker manifest pair as T002, and the overlapped step
+            # must reconcile with CostEstimate.overlapped_s
+            from autodist_tpu.analysis.report import Report
+            from autodist_tpu.analysis.runtime_audit import (
+                RECONCILE_TOL, audit_fixture)
+
+            fixdir = os.path.join(REPO, "tests", "data", "trace")
+            plan = os.path.join(fixdir, "plan.json")
+            checks = (
+                ("exposed", dict(
+                    trace_path=os.path.join(fixdir,
+                                            "exposed_comm.trace.json"),
+                    plan_path=plan), "T001"),
+                ("skew", dict(
+                    manifest_dir=os.path.join(fixdir, "skewed_pair")),
+                 "T002"),
+                ("overlapped", dict(
+                    trace_path=os.path.join(fixdir,
+                                            "overlapped.trace.json"),
+                    plan_path=plan), None),
+            )
+            for label, kw, want in checks:
+                findings = audit_fixture(**kw)
+                report = Report()
+                report.extend(findings)
+                results[f"<runtime-{label}-selftest>"] = report
+                _print_report(f"runtime selftest ({label})", report,
+                              args.verbose)
+                codes = {f.code for f in findings}
+                if want is not None:
+                    if want not in codes:
+                        print(f"[ERROR] runtime selftest ({label}): "
+                              f"expected {want} did not fire "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print(f"runtime selftest passed: the {label} "
+                              f"fixture fires {want}")
+                else:
+                    t6 = next((f for f in findings
+                               if f.code == "T006"), None)
+                    rel = (abs(t6.data["reconcile"]["rel_error"])
+                           if t6 is not None and t6.data.get("reconcile")
+                           else None)
+                    if "T001" in codes or rel is None \
+                            or rel > RECONCILE_TOL:
+                        print(f"[ERROR] runtime selftest (overlapped): "
+                              f"expected a clean T006 reconciling "
+                              f"within {RECONCILE_TOL:.0%} (got codes "
+                              f"{sorted(codes)}, rel_error {rel})")
+                        failed = True
+                    else:
+                        print(f"runtime selftest passed: overlapped "
+                              f"fixture reconciles within {rel:.1%} "
+                              f"(tol {RECONCILE_TOL:.0%})")
 
     if args.json_out:
         with open(args.json_out, "w") as f:
