@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check chaos check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check chaos perf-gate check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -104,11 +104,23 @@ timeline-check:
 chaos:
 	$(PY) tools/chaos_check.py
 
+# cross-run regression gate (docs/observability.md): the golden fixtures
+# must fire R001 (seeded slow manifest) and R002 (NaN manifest) with a
+# clean control (--selftest), then every records/cpu_mesh strategy is
+# re-measured on the CPU mesh and diffed against its blessed baseline in
+# records/baselines — every strategy must emit its R006 run-vs-baseline
+# table with zero R001/R004 (bless an intentional perf change with
+# --update-baseline and commit the rewritten files)
+perf-gate:
+	$(PY) tools/perf_gate.py --selftest
+	$(PY) tools/perf_gate.py
+
 # the pre-merge gate: lint + strategy verification + HLO audit + live
-# telemetry + runtime timeline + chaos drills (tests/test_analysis.py +
-# test_telemetry.py + test_timeline.py + test_elastic.py run the same
-# chains, so tier-1 exercises it)
-check: lint verify audit telemetry-check timeline-check chaos
+# telemetry + runtime timeline + chaos drills + the cross-run perf gate
+# (tests/test_analysis.py + test_telemetry.py + test_timeline.py +
+# test_elastic.py + test_regression_audit.py run the same chains, so
+# tier-1 exercises it)
+check: lint verify audit telemetry-check timeline-check chaos perf-gate
 
 clean:
 	$(MAKE) -C native clean

@@ -21,6 +21,9 @@ pluggable passes producing a severity-ranked :class:`Report`:
   chrome-trace capture joined to the intended channels and the cost
   estimate (exposed comm, unrealized overlap, per-hop measured
   bandwidth) plus cross-worker straggler skew — T-codes
+- ``regression-audit`` — CROSS-RUN tier: this analysis (F006 ceiling,
+  X006 bytes, manifest walls/health) diffed against the blessed
+  baseline in ``records/baselines`` — R-codes
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on
@@ -30,7 +33,7 @@ See ``docs/analysis.md``.
 from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
                                           StrategyVerificationError)
 from autodist_tpu.analysis.passes import (LOWERED_PASSES, PASS_REGISTRY,  # noqa: F401
-                                          RUNTIME_PASSES, STATIC_PASSES,
-                                          TRACE_PASSES)
+                                          REGRESSION_PASSES, RUNTIME_PASSES,
+                                          STATIC_PASSES, TRACE_PASSES)
 from autodist_tpu.analysis.verify import (AnalysisContext, verify_strategy,  # noqa: F401
                                           verify_transformer)

@@ -95,6 +95,16 @@ Finding codes (stable; tests and tools match on them):
   T005 WARNING codec wire savings not realized on the DCN hop
   T006 INFO    machine-readable predicted-vs-realized-vs-measured table
                (carried in Finding.data)
+  R000 INFO    regression audit skipped (no baseline blessed yet)
+  R001 ERROR   throughput / engine-overhead regression vs the blessed
+               baseline beyond tolerance
+  R002 ERROR   non-finite loss/grad observed in the run's health verdict
+  R003 WARNING loss-spike or grad-norm anomaly (rolling z-score)
+  R004 WARNING predicted_mfu_ceiling dropped vs baseline (structural
+               regression, caught before any chip)
+  R005 WARNING realized comm bytes grew vs baseline
+  R006 INFO    machine-readable run-vs-baseline table (carried in
+               Finding.data)
   TR001 ERROR  tracing the strategy's train step failed
   TR002 INFO   trace skipped (trace passes did not run)
 
@@ -106,7 +116,11 @@ transformed step's lowering rather than the jaxpr.  The T-codes form the
 RUNTIME (measured) tier (:mod:`autodist_tpu.analysis.runtime_audit`):
 they run over a ``jax.profiler`` chrome-trace capture and the aggregated
 cross-worker manifests, closing the predicted -> statically-realized ->
-measured loop.
+measured loop.  The R-codes form the CROSS-RUN tier
+(:mod:`autodist_tpu.analysis.regression_audit`): they diff any of the
+above — or a finalized run manifest — against the blessed baselines in
+``records/baselines`` (:mod:`autodist_tpu.telemetry.baseline`), so a
+regression is a ranked finding in the same Report as everything else.
 """
 import numpy as np
 
@@ -776,6 +790,16 @@ def runtime_audit_pass(ctx):
     return _run(ctx)
 
 
+def regression_audit_pass(ctx):
+    """Cross-run tier pass: diff this analysis (walls/health from
+    aggregated manifests, F006 ceiling, X006 bytes) against the blessed
+    baseline (:mod:`autodist_tpu.analysis.regression_audit`)."""
+    from autodist_tpu.analysis.regression_audit import \
+        regression_audit_pass as _run
+
+    return _run(ctx)
+
+
 PASS_REGISTRY = {
     "sharding": sharding_pass,
     "hierarchy": hierarchy_pass,
@@ -786,6 +810,7 @@ PASS_REGISTRY = {
     "hlo-audit": hlo_audit_pass,
     "compute-audit": compute_audit_pass,
     "runtime-audit": runtime_audit_pass,
+    "regression-audit": regression_audit_pass,
 }
 
 STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
@@ -799,3 +824,8 @@ LOWERED_PASSES = ("hlo-audit", "compute-audit")
 # opt-in via verify_strategy(passes=..., trace_dir=...), the CLI's
 # --runtime, and the watchdog's post-capture auto-analysis
 RUNTIME_PASSES = ("runtime-audit",)
+# the CROSS-RUN tier: diff whatever the earlier tiers produced (plus
+# caller-supplied current_metrics) against the blessed baseline; opt-in
+# via verify_strategy(passes=..., baseline=...), the CLI's --regression,
+# and tools/perf_gate.py
+REGRESSION_PASSES = ("regression-audit",)

@@ -1,0 +1,268 @@
+"""Regression audit: the CROSS-RUN tier (R-codes) of the verification
+stack.
+
+The static tier checks what we *emit*, the lowered tier what XLA
+*realizes*, the runtime tier what the hardware *measured* — all within
+one run.  This pass adds the missing axis: memory *across* runs.  It
+diffs a run — or a purely static lowering, no chip required — against
+its blessed baseline (:mod:`autodist_tpu.telemetry.baseline`):
+
+  R000 INFO    regression audit skipped (no baseline blessed yet)
+  R001 ERROR   throughput / engine-overhead regression beyond tolerance
+               (the machine-normalized ``cpu_mesh_engine_overhead``
+               ratio, plus wall-clock when both sides carry gateable
+               step walls)
+  R002 ERROR   non-finite loss/grad observed in the run's health verdict
+  R003 WARNING loss-spike or grad-norm anomaly (the HealthMonitor's
+               rolling z-score tripped during the run)
+  R004 WARNING ``predicted_mfu_ceiling`` dropped vs baseline — a
+               *structural* regression caught before any chip
+  R005 WARNING realized comm bytes (X006) grew vs baseline
+  R006 INFO    machine-readable run-vs-baseline table (``Finding.data``;
+               consumed by ``tools/perf_gate.py`` and
+               ``tools/telemetry_report.py --health``)
+
+Gating philosophy: committed baselines must not flake across hosts, so
+only machine-*normalized* quantities (the overhead ratio) and *static*
+quantities (ceiling, bytes) gate against ``records/baselines``;
+machine-dependent walls ride under the baseline's ``info`` subdict,
+reported but ungated.  Wall-clock gating applies only when both the run
+and its baseline carry a top-level ``step_time_p50_s`` (same-machine
+comparisons: the test fixtures, a local A/B).
+"""
+from typing import List
+
+from autodist_tpu.analysis.report import Finding, Severity
+
+# engine-overhead ratio (engine step / raw jit step on the same host) may
+# exceed the blessed ratio by this much relative + absolute slack before
+# R001 fires — the ratio cancels host speed, but scheduler noise on tiny
+# CPU-mesh steps is real
+OVERHEAD_TOL_REL = 0.75
+OVERHEAD_ABS_SLACK = 3.0
+# wall-clock gate (same-machine baselines only): p50 may grow this much
+STEP_TOL_REL = 0.50
+STEP_ABS_SLACK_S = 0.02
+# predicted_mfu_ceiling is deterministic arithmetic over the lowered
+# module — any drop beyond rounding is structural
+CEILING_TOL = 0.02
+# realized wire bytes are exact; allow padding-level growth only
+COMM_TOL_REL = 0.05
+COMM_ABS_SLACK = 1024.0
+
+
+def _f(sev, code, msg, subject="", data=None):
+    return Finding(Severity(sev), code, "regression-audit", msg, subject,
+                   data=data)
+
+
+def _health_counts(current):
+    h = (current or {}).get("health") or {}
+    return h.get("counts") or {}
+
+
+def _comm_total(side):
+    cb = (side or {}).get("comm_bytes")
+    if isinstance(cb, dict):
+        return float(sum(v for v in cb.values()
+                         if isinstance(v, (int, float))))
+    if isinstance(cb, (int, float)):
+        return float(cb)
+    return None
+
+
+def regression_audit(current, baseline=None) -> List[Finding]:
+    """Diff ``current`` run metrics against the blessed ``baseline``.
+
+    Both are plain dicts in the baseline schema
+    (:func:`autodist_tpu.telemetry.baseline.baseline_from_manifest`).
+    ``baseline=None`` still judges the run itself (R002/R003 need no
+    memory) and emits the R006 table with an R000 note."""
+    findings = []
+    current = current or {}
+    name = current.get("name") or (baseline or {}).get("name") or ""
+
+    # -- the run's own health verdict (no baseline needed) ------------------
+    counts = _health_counts(current)
+    if counts.get("nonfinite"):
+        h = current.get("health") or {}
+        at = h.get("first_nonfinite_step")
+        findings.append(_f(
+            Severity.ERROR, "R002",
+            f"non-finite loss/grad observed: {counts['nonfinite']} "
+            f"nonfinite health finding(s)"
+            + (f", first at step {at}" if at is not None else "")
+            + " — every later step is poisoned", name))
+    spikes = counts.get("loss_spike", 0) + counts.get("grad_norm_spike", 0)
+    if spikes:
+        findings.append(_f(
+            Severity.WARNING, "R003",
+            f"training anomaly: {counts.get('loss_spike', 0)} loss "
+            f"spike(s) + {counts.get('grad_norm_spike', 0)} grad-norm "
+            f"spike(s) beyond the rolling z-score threshold "
+            f"(see health_finding records for steps and magnitudes)",
+            name))
+
+    # -- the cross-run diffs ------------------------------------------------
+    diffs = {}
+    if baseline is None:
+        findings.append(_f(
+            Severity.INFO, "R000",
+            f"regression audit has no baseline for '{name or '?'}' — "
+            f"bless one with tools/perf_gate.py --update-baseline",
+            name))
+    else:
+        cur_ov = current.get("cpu_mesh_engine_overhead")
+        base_ov = baseline.get("cpu_mesh_engine_overhead")
+        if cur_ov is not None and base_ov is not None:
+            limit = base_ov * (1.0 + OVERHEAD_TOL_REL) + OVERHEAD_ABS_SLACK
+            diffs["cpu_mesh_engine_overhead"] = {
+                "current": cur_ov, "baseline": base_ov, "limit": limit}
+            if cur_ov > limit:
+                findings.append(_f(
+                    Severity.ERROR, "R001",
+                    f"engine-overhead regression: cpu_mesh ratio "
+                    f"{cur_ov:.2f}x vs blessed {base_ov:.2f}x "
+                    f"(limit {limit:.2f}x = +{OVERHEAD_TOL_REL:.0%} "
+                    f"+ {OVERHEAD_ABS_SLACK:.1f} slack) — the engine got "
+                    f"slower relative to a raw jit step on this host",
+                    name, data=diffs["cpu_mesh_engine_overhead"]))
+        cur_p50 = current.get("step_time_p50_s")
+        base_p50 = baseline.get("step_time_p50_s")
+        if cur_p50 and base_p50:
+            limit = base_p50 * (1.0 + STEP_TOL_REL) + STEP_ABS_SLACK_S
+            diffs["step_time_p50_s"] = {
+                "current": cur_p50, "baseline": base_p50, "limit": limit}
+            if cur_p50 > limit:
+                findings.append(_f(
+                    Severity.ERROR, "R001",
+                    f"throughput regression: step p50 "
+                    f"{cur_p50 * 1e3:.2f} ms vs blessed "
+                    f"{base_p50 * 1e3:.2f} ms (limit "
+                    f"{limit * 1e3:.2f} ms = +{STEP_TOL_REL:.0%} + "
+                    f"{STEP_ABS_SLACK_S * 1e3:.0f} ms slack)",
+                    name, data=diffs["step_time_p50_s"]))
+        cur_c = current.get("predicted_mfu_ceiling")
+        base_c = baseline.get("predicted_mfu_ceiling")
+        if cur_c is not None and base_c is not None:
+            diffs["predicted_mfu_ceiling"] = {
+                "current": cur_c, "baseline": base_c,
+                "limit": base_c - CEILING_TOL}
+            if cur_c < base_c - CEILING_TOL:
+                findings.append(_f(
+                    Severity.WARNING, "R004",
+                    f"structural regression: predicted_mfu_ceiling "
+                    f"dropped {base_c:.3f} -> {cur_c:.3f} "
+                    f"(tolerance {CEILING_TOL}) — the lowered step got "
+                    f"structurally more wasteful, caught before any chip",
+                    name, data=diffs["predicted_mfu_ceiling"]))
+        cur_b = _comm_total(current)
+        base_b = _comm_total(baseline)
+        if cur_b is not None and base_b is not None:
+            limit = base_b * (1.0 + COMM_TOL_REL) + COMM_ABS_SLACK
+            diffs["comm_bytes"] = {
+                "current": cur_b, "baseline": base_b, "limit": limit}
+            if cur_b > limit:
+                findings.append(_f(
+                    Severity.WARNING, "R005",
+                    f"realized comm bytes grew: {cur_b / 1e6:.2f} MB on "
+                    f"the wire vs blessed {base_b / 1e6:.2f} MB "
+                    f"(+{(cur_b / max(base_b, 1.0) - 1) * 100:.0f}%, "
+                    f"tolerance {COMM_TOL_REL:.0%})",
+                    name, data=diffs["comm_bytes"]))
+
+    data = {
+        "name": name,
+        "baseline": baseline,
+        "current": {k: v for k, v in current.items() if k != "name"},
+        "diffs": diffs,
+        "health_counts": counts,
+        "regressed": sorted({f.code for f in findings
+                             if f.code in ("R001", "R002", "R004", "R005")}),
+    }
+    verdict = "regressed: " + ", ".join(data["regressed"]) \
+        if data["regressed"] else "clean"
+    parts = []
+    for k, d in diffs.items():
+        parts.append(f"{k} {d['current']:.4g} vs {d['baseline']:.4g}")
+    findings.append(_f(
+        Severity.INFO, "R006",
+        f"run-vs-baseline ({name or '?'}): " + (
+            "; ".join(parts) if parts else "no comparable fields")
+        + f" — {verdict}", name or "summary", data=data))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points: the registered pass and the fixture/CLI path
+# ---------------------------------------------------------------------------
+
+
+def current_from_context(ctx):
+    """Assemble the ``current`` side from whatever the earlier tiers left
+    on the context: F006's ceiling, X006's realized bytes, the aggregated
+    manifests' walls/health, plus caller-supplied ``ctx.current_metrics``
+    (which wins on conflict)."""
+    from autodist_tpu.telemetry.baseline import baseline_from_manifest
+
+    name = getattr(getattr(ctx, "strategy", None), "id", "") or ""
+    records = getattr(ctx, "manifest_records", None)
+    current = baseline_from_manifest(records, name=name) if records \
+        else {"name": name}
+    cs = getattr(ctx, "compute_summary", None)
+    if cs and cs.get("predicted_mfu_ceiling") is not None:
+        current.setdefault("predicted_mfu_ceiling",
+                           cs["predicted_mfu_ceiling"])
+    asum = getattr(ctx, "audit_summary", None)
+    if asum and isinstance(asum.get("realized"), dict):
+        current.setdefault("comm_bytes", asum["realized"])
+    extra = getattr(ctx, "current_metrics", None)
+    if extra:
+        current.update({k: v for k, v in extra.items() if v is not None})
+    return current
+
+
+def regression_audit_pass(ctx) -> List[Finding]:
+    """PASS_REGISTRY entry (the cross-run tier): diff this analysis
+    against the blessed baseline.  ``ctx.baseline`` may be the baseline
+    dict, a baseline *name* to load from ``records/baselines``, or None
+    (load by strategy id, else R000)."""
+    from autodist_tpu.telemetry.baseline import load_baseline
+
+    current = current_from_context(ctx)
+    baseline = getattr(ctx, "baseline", None)
+    if isinstance(baseline, str):
+        baseline = load_baseline(baseline)
+    elif baseline is None and current.get("name"):
+        baseline = load_baseline(current["name"])
+    findings = regression_audit(current, baseline)
+    ctx.regression_summary = next(
+        (f.data for f in findings if f.code == "R006"), None)
+    return findings
+
+
+def audit_fixture(current_path=None, baseline_path=None,
+                  manifest_dir=None, *, name="fixture"):
+    """Run the audit over golden fixtures: a current-metrics JSON and/or
+    a worker-manifest directory, against a baseline JSON.  Returns the
+    findings list (``tools/perf_gate.py --selftest`` and the fixture
+    tests drive this)."""
+    import json
+
+    from autodist_tpu.telemetry.baseline import baseline_from_manifest
+
+    current = {}
+    if manifest_dir:
+        from autodist_tpu.telemetry import aggregate
+
+        current = baseline_from_manifest(
+            aggregate.load_manifest(manifest_dir), name=name)
+    if current_path:
+        with open(current_path) as f:
+            current.update(json.load(f))
+    current.setdefault("name", name)
+    baseline = None
+    if baseline_path:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    return regression_audit(current, baseline)

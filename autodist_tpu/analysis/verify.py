@@ -20,8 +20,8 @@ import dataclasses
 from typing import Any, Dict, Optional
 
 from autodist_tpu.analysis.passes import (LOWERED_PASSES, PASS_REGISTRY,
-                                          RUNTIME_PASSES, STATIC_PASSES,
-                                          TRACE_PASSES)
+                                          REGRESSION_PASSES, RUNTIME_PASSES,
+                                          STATIC_PASSES, TRACE_PASSES)
 from autodist_tpu.analysis.report import Report, Severity
 from autodist_tpu.utils import logging
 
@@ -67,6 +67,13 @@ class AnalysisContext:
     trace_dir: Optional[str] = None
     manifest_records: Optional[list] = None
     runtime_summary: Optional[dict] = None
+    # cross-run (regression) tier: the blessed baseline to diff against
+    # (a dict, a baseline name, or None to load by strategy id),
+    # caller-supplied current-side metrics (engine overhead etc.), and
+    # the audit's machine-readable R006 table
+    baseline: Any = None
+    current_metrics: Optional[dict] = None
+    regression_summary: Optional[dict] = None
 
 
 def _mesh_info(strategy, resource_spec, mesh):
@@ -169,7 +176,8 @@ def attach_traced(ctx, traced, n_state_leaves):
 def verify_transformer(transformer, batch_shapes, *, donate=True,
                        hbm_bytes_per_device=None, rng=None,
                        passes=None, trace_dir=None,
-                       manifest_records=None) -> Report:
+                       manifest_records=None, baseline=None,
+                       current_metrics=None) -> Report:
     """Verify an already-built :class:`GraphTransformer` (the engine's
     in-session entry: the runner's ``verify=`` knob, ``aot_compile``, and
     the watchdog's post-capture analysis reuse the transformer they
@@ -181,7 +189,8 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
         axis_sizes=dict(transformer.mesh.shape),
         batch_shapes=batch_shapes, donate=donate,
         hbm_bytes_per_device=hbm_bytes_per_device,
-        trace_dir=trace_dir, manifest_records=manifest_records)
+        trace_dir=trace_dir, manifest_records=manifest_records,
+        baseline=baseline, current_metrics=current_metrics)
     ctx.transformer = transformer
     report = Report(strategy_id=getattr(transformer.strategy, "id", ""))
     selected = tuple(passes) if passes is not None else \
@@ -200,6 +209,11 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
             report.extend(PASS_REGISTRY[name](ctx))
     for name in runtime_selected:
         report.extend(PASS_REGISTRY[name](ctx))
+    # cross-run tier last: it harvests whatever the earlier tiers left on
+    # the context (F006 ceiling, X006 bytes, manifest walls/health)
+    for name in selected:
+        if name in REGRESSION_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
     return report
 
 
@@ -207,6 +221,7 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
                     mesh=None, batch_shapes=None, param_specs=None,
                     donate=True, hbm_bytes_per_device=None, passes=None,
                     rng=None, trace_dir=None, manifest_records=None,
+                    baseline=None, current_metrics=None,
                     **transformer_kwargs) -> Report:
     """Statically verify a strategy before any compile.
 
@@ -231,6 +246,11 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
       manifest_records: aggregated cross-worker manifest records
         (:func:`autodist_tpu.telemetry.aggregate.load_manifest`) for the
         runtime tier's straggler-skew check.
+      baseline / current_metrics: cross-run (regression) tier inputs when
+        ``"regression-audit"`` is selected — the blessed baseline (dict,
+        name under ``records/baselines``, or None to load by strategy
+        id) and caller-measured current-side metrics
+        (``cpu_mesh_engine_overhead`` etc.).
       transformer_kwargs: forwarded to :class:`GraphTransformer`
         (``data_axes``, ``batch_spec``, ``accum_steps``, ...).
 
@@ -245,7 +265,8 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         param_specs=param_specs, batch_shapes=batch_shapes, donate=donate,
         hbm_bytes_per_device=hbm_bytes_per_device,
         transformer_kwargs=transformer_kwargs,
-        trace_dir=trace_dir, manifest_records=manifest_records)
+        trace_dir=trace_dir, manifest_records=manifest_records,
+        baseline=baseline, current_metrics=current_metrics)
     report = Report(strategy_id=getattr(strategy, "id", ""))
 
     selected = tuple(passes) if passes is not None else \
@@ -291,6 +312,13 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
     # transformer's intended channels when the trace tier built one
     for name in selected:
         if name in RUNTIME_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
+
+    # cross-run (regression) tier last: it diffs whatever the earlier
+    # tiers attached (F006 ceiling, X006 bytes, manifest walls/health,
+    # caller current_metrics) against the blessed baseline
+    for name in selected:
+        if name in REGRESSION_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
 
     logging.debug("verify_strategy(%s): %d findings (%d errors)",

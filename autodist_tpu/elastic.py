@@ -45,6 +45,10 @@ a semicolon-separated event list, each ``<kind>@<step>[:<arg>]``:
     before step N, stall the host for ``seconds`` (straggler injection).
 ``preempt@N``
     before step N, deliver SIGTERM to this process (preemption drill).
+``nan@N``
+    poison step N's batch with NaNs (numeric-anomaly drill: the health
+    monitor must flag the non-finite loss and the ``on_anomaly`` hook
+    must fire, with the verdict recorded in the telemetry manifest).
 """
 import os
 import time
@@ -56,7 +60,7 @@ from autodist_tpu.utils import logging
 
 
 class ChaosEvent:
-    KINDS = ("kill_worker", "delay", "preempt")
+    KINDS = ("kill_worker", "delay", "preempt", "nan")
 
     def __init__(self, kind, step, arg=None):
         if kind not in self.KINDS:
@@ -122,16 +126,26 @@ class ElasticTrainer:
         (the runtime audit's T002).  Hook only — the default trainer
         takes NO re-plan action on stragglers; wiring the callback to a
         re-plan is the caller's policy decision.
+      on_anomaly: optional callback ``(finding_dict) -> None`` invoked
+        when the trainer's own :class:`~autodist_tpu.telemetry.health.
+        HealthMonitor` flags the loss stream via :meth:`note_anomaly` —
+        immediately for a non-finite loss (R002 class), after
+        :data:`ANOMALY_PERSISTENCE` consecutive signals for spikes.
+        Mirrors ``on_straggler``: a hook, not a policy.
     """
 
     # consecutive T002 signals before the straggler is considered
     # persistent (one captured slow step must not fire the hook)
     STRAGGLER_PERSISTENCE = 2
+    # consecutive health signals of one check before on_anomaly fires
+    # (a single loss spike self-heals; nonfinite always fires at once —
+    # a poisoned update never heals)
+    ANOMALY_PERSISTENCE = 2
 
     def __init__(self, resource_spec, strategy_builder, loss_fn, params,
                  optimizer, *, checkpoint_dir, distribute_kwargs=None,
                  verify_restore=True, chaos=None, max_replans=8,
-                 on_straggler=None):
+                 on_straggler=None, on_anomaly=None):
         from autodist_tpu.autodist import AutoDist
         from autodist_tpu.cluster import Cluster
 
@@ -155,6 +169,13 @@ class ElasticTrainer:
         self.on_straggler = on_straggler
         self._straggler_streak = {}   # addr -> consecutive T002 signals
         self.straggler_signals = 0
+        from autodist_tpu.telemetry.health import HealthMonitor
+
+        self.on_anomaly = on_anomaly
+        self._health = HealthMonitor()  # trainer-side (works telemetry-off)
+        self._anomaly_streak = {}     # check -> consecutive signals
+        self.anomaly_signals = 0
+        self._poison_next = False     # armed by the nan@N chaos event
 
     # -- membership signals -------------------------------------------------
 
@@ -187,6 +208,37 @@ class ElasticTrainer:
             "" if self.on_straggler else " — no on_straggler hook set")
         if self.on_straggler is not None:
             self.on_straggler(dict(skew))
+            return True
+        return False
+
+    def note_anomaly(self, finding):
+        """Consume one health verdict (a :class:`HealthMonitor` finding
+        dict — ``check``, ``step``, ``value``, ``message``).
+
+        ``nonfinite`` fires ``on_anomaly`` immediately (the update is
+        already poisoned; persistence only loses recovery time); spike
+        and drift checks must persist for :data:`ANOMALY_PERSISTENCE`
+        consecutive signals of the same check.  Returns True when the
+        callback fired.  Like stragglers, no default policy: recovery
+        (LR rewind, checkpoint rollback, drain) is the caller's call."""
+        from autodist_tpu import telemetry
+
+        check = (finding or {}).get("check")
+        if not check:
+            self._anomaly_streak.clear()
+            return False
+        self.anomaly_signals += 1
+        telemetry.counter("elastic.anomaly_signals", check=check)
+        self._anomaly_streak[check] = self._anomaly_streak.get(check, 0) + 1
+        need = 1 if check == "nonfinite" else self.ANOMALY_PERSISTENCE
+        if self._anomaly_streak[check] < need:
+            return False
+        logging.warning(
+            "ElasticTrainer: health anomaly %s at step %s (%s)%s",
+            check, finding.get("step"), finding.get("message"),
+            "" if self.on_anomaly else " — no on_anomaly hook set")
+        if self.on_anomaly is not None:
+            self.on_anomaly(dict(finding))
             return True
         return False
 
@@ -237,6 +289,8 @@ class ElasticTrainer:
                 import signal
 
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif ev.kind == "nan":
+                self._poison_next = True
 
     # -- session lifecycle --------------------------------------------------
 
@@ -345,12 +399,27 @@ class ElasticTrainer:
                         "checkpoint written, exiting cleanly", sess.step)
                     sess.preempted = True
                     break
-                metrics = sess.run(batch_fn(step))
+                batch = batch_fn(step)
+                if self._poison_next:
+                    import jax
+
+                    self._poison_next = False
+                    batch = jax.tree.map(
+                        lambda a: np.full_like(np.asarray(a), np.nan),
+                        batch)
+                metrics = sess.run(batch)
                 loss = metrics.get("loss") if isinstance(metrics, dict) \
                     else None
+                loss_f = float(loss) if loss is not None else None
                 self.history.append(
                     (self.epoch, int(sess.step),
-                     float(loss) if loss is not None else float("nan")))
+                     loss_f if loss_f is not None else float("nan")))
+                # trainer-side health judgment on the host loss (works
+                # with telemetry off; the session writes the manifest
+                # records when telemetry is on)
+                if loss_f is not None:
+                    for hf in self._health.observe(step, loss=loss_f):
+                        self.note_anomaly(hf)
                 if log_every and sess.step % log_every == 0:
                     logging.info("epoch %d step %d: %s", self.epoch,
                                  sess.step, sess._metrics_log_str(metrics))

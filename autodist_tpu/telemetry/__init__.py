@@ -11,6 +11,11 @@ The observability layer of the stack (``docs/observability.md``):
   instrumentation (wall time, throughput, achieved MFU, memory
   snapshots, compile split) for :class:`DistributedSession`;
 - :mod:`~autodist_tpu.telemetry.watchdog` — slow-step auto-capture;
+- :mod:`~autodist_tpu.telemetry.health` — online NaN/Inf, loss-spike,
+  grad-norm and step-time-drift detectors (``health_finding`` records,
+  the ``ElasticTrainer.on_anomaly`` signal);
+- :mod:`~autodist_tpu.telemetry.baseline` — committed cross-run perf
+  baselines (``records/baselines``, the regression audit's memory);
 - :mod:`~autodist_tpu.telemetry.aggregate` — chief-side merge of
   per-worker manifests;
 - :mod:`~autodist_tpu.telemetry.schema` — the JSONL schema + validator
@@ -29,7 +34,9 @@ import os
 import time
 
 from autodist_tpu.telemetry.aggregate import (load_manifest,
+                                              load_manifest_with_stats,
                                               merge_worker_manifests)
+from autodist_tpu.telemetry.health import HealthMonitor
 from autodist_tpu.telemetry.metrics import (JsonlWriter, MetricsRegistry,
                                             percentiles)
 from autodist_tpu.telemetry.schema import validate_manifest
@@ -42,6 +49,7 @@ __all__ = [
     "MetricsRegistry", "JsonlWriter", "SpanRecorder", "SlowStepWatchdog",
     "SessionTelemetry", "dump_chrome_trace", "percentiles",
     "validate_manifest", "merge_worker_manifests", "load_manifest",
+    "load_manifest_with_stats", "HealthMonitor",
 ]
 
 _STATE = {

@@ -158,16 +158,29 @@ def merge_worker_manifests(run_dir, out_path=None):
     return out_path
 
 
-def load_manifest(path):
-    """Load manifest records from a file or a run directory.
+def load_manifest_with_stats(path):
+    """Load manifest records plus merge-hygiene stats from a file or a
+    run directory.
 
     A directory prefers its merged ``manifest.jsonl``; if absent, the
     worker files are merged in memory (read-only — nothing is written,
-    but the same offset correction and dedupe apply).
+    but the same offset correction and dedupe apply).  Returns
+    ``(records, stats)`` where ``stats`` always carries
+    ``skipped_lines`` / ``skipped_duplicates`` (a pre-merged file can
+    only count torn lines; duplicates were already dropped at merge).
     """
     if os.path.isdir(path):
         merged = os.path.join(path, MANIFEST_NAME)
         if os.path.exists(merged):
-            return _parse_lines(merged)[0]
-        return merge_records(path)[0]
-    return _parse_lines(path)[0]
+            records, skipped = _parse_lines(merged)
+            return records, {"skipped_lines": skipped,
+                             "skipped_duplicates": 0}
+        return merge_records(path)
+    records, skipped = _parse_lines(path)
+    return records, {"skipped_lines": skipped, "skipped_duplicates": 0}
+
+
+def load_manifest(path):
+    """Load manifest records from a file or a run directory (see
+    :func:`load_manifest_with_stats` for the hygiene counters)."""
+    return load_manifest_with_stats(path)[0]

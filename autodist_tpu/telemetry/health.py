@@ -1,0 +1,171 @@
+"""Online health detectors over the live metrics stream.
+
+Distinct from the :mod:`~autodist_tpu.telemetry.watchdog` — the watchdog
+judges step *walls* and only arms a one-step profiler capture; the
+:class:`HealthMonitor` judges metric *values* (the numbers training
+cares about) and emits structured verdicts:
+
+- **nonfinite** — a NaN/Inf loss or gradient norm.  The one check that
+  fires immediately: a non-finite value poisons every later step, so
+  waiting for persistence only loses recovery time.
+- **loss_spike** — the loss jumps beyond a rolling z-score threshold of
+  its recent window (divergence, a poisoned batch, an LR accident).
+- **grad_norm_spike** — same rolling z-score over the gradient norm,
+  when the session reports one.
+- **step_time_drift** — the recent step-wall median creeps above the
+  run's early median beyond tolerance (thermal throttle, a neighbor
+  stealing the host, a leaking dispatch path) — slow *drift* the
+  watchdog's single-step outlier multiple never trips on.
+
+Each verdict is a plain dict (``check`` / ``step`` / ``value`` /
+``severity`` / ``message``) so it can land verbatim as a
+``health_finding`` manifest record (schema.py), feed the regression
+audit's R002/R003 (:mod:`autodist_tpu.analysis.regression_audit`), and
+fire the :class:`~autodist_tpu.elastic.ElasticTrainer` ``on_anomaly``
+hook.  Pure stdlib — no jax import, values arrive as host floats.
+"""
+import math
+from collections import deque
+
+# rolling window for the z-score / drift statistics
+WINDOW = 32
+# observations required before spike/drift judgments (a cold window has
+# no distribution to be an outlier of)
+MIN_SAMPLES = 8
+# rolling z-score beyond which a loss / grad-norm value is a spike
+Z_SPIKE = 6.0
+# recent step-wall median may exceed the early-run median by this much
+# (relative) before drift fires, with an absolute floor so microsecond
+# CPU-mesh steps don't trip it
+DRIFT_REL = 0.75
+DRIFT_ABS_S = 0.005
+
+CHECKS = ("nonfinite", "loss_spike", "grad_norm_spike", "step_time_drift")
+
+
+def _std(xs, mean):
+    return math.sqrt(sum((x - mean) ** 2 for x in xs) / len(xs))
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+class HealthMonitor:
+    """Streaming detectors; feed one observation per step.
+
+    ``observe`` returns the list of finding dicts the step produced
+    (usually empty).  Every finding is also kept on :attr:`findings`
+    and counted in :attr:`counts` for the :meth:`summary` trailer.
+    """
+
+    def __init__(self, window=WINDOW, min_samples=MIN_SAMPLES,
+                 z_spike=Z_SPIKE, drift_rel=DRIFT_REL):
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.z_spike = float(z_spike)
+        self.drift_rel = float(drift_rel)
+        self._loss = deque(maxlen=self.window)
+        self._grad = deque(maxlen=self.window)
+        self._walls = deque(maxlen=self.window)
+        self._base_walls = []          # early-run reference for drift
+        self._drift_cooldown = -1      # step before which drift stays quiet
+        self.observed = 0
+        self.findings = []
+        self.counts = {}
+        self.first_nonfinite_step = None
+        self.max_loss_z = 0.0
+
+    # -- internals ---------------------------------------------------------
+
+    def _emit(self, check, step, value, severity, message):
+        f = {"check": check, "step": int(step), "value": value,
+             "severity": severity, "message": message}
+        self.findings.append(f)
+        self.counts[check] = self.counts.get(check, 0) + 1
+        return f
+
+    def _spike(self, series, check, label, step, x):
+        """Rolling z-score spike over ``series`` (judged BEFORE ``x``
+        joins the window, so the spike is an outlier of its *history*)."""
+        out = None
+        if len(series) >= self.min_samples:
+            mean = sum(series) / len(series)
+            std = _std(series, mean)
+            scale = max(std, 1e-12, abs(mean) * 1e-6)
+            z = (x - mean) / scale
+            if check == "loss_spike":
+                self.max_loss_z = max(self.max_loss_z, z)
+            if z > self.z_spike and x > mean:
+                out = self._emit(
+                    check, step, x, "WARNING",
+                    f"{label} {x:.6g} at step {step} is {z:.1f} sigma "
+                    f"above its rolling mean {mean:.6g} "
+                    f"(window {len(series)}, threshold "
+                    f"{self.z_spike:.1f})")
+        series.append(x)
+        return out
+
+    # -- the per-step hook -------------------------------------------------
+
+    def observe(self, step, loss=None, grad_norm=None, wall_s=None):
+        """Judge one step's metrics; returns the findings it produced."""
+        self.observed += 1
+        found = []
+        for label, x in (("loss", loss), ("grad norm", grad_norm)):
+            if x is None:
+                continue
+            x = float(x)
+            if not math.isfinite(x):
+                if self.first_nonfinite_step is None:
+                    self.first_nonfinite_step = int(step)
+                found.append(self._emit(
+                    "nonfinite", step, x, "ERROR",
+                    f"non-finite {label} ({x}) at step {step} — the "
+                    f"update poisons every later step"))
+            elif label == "loss":
+                f = self._spike(self._loss, "loss_spike", label, step, x)
+                if f:
+                    found.append(f)
+            else:
+                f = self._spike(self._grad, "grad_norm_spike", label,
+                                step, x)
+                if f:
+                    found.append(f)
+        if wall_s is not None and wall_s > 0:
+            self._walls.append(float(wall_s))
+            if len(self._base_walls) < self.min_samples:
+                self._base_walls.append(float(wall_s))
+            elif (len(self._walls) >= self.min_samples
+                  and step >= self._drift_cooldown):
+                base = _median(self._base_walls)
+                recent = _median(list(self._walls)[-self.min_samples:])
+                limit = base * (1.0 + self.drift_rel) + DRIFT_ABS_S
+                if recent > limit:
+                    # one verdict per window, not one per step — drift is
+                    # a condition, not an event
+                    self._drift_cooldown = int(step) + self.window
+                    found.append(self._emit(
+                        "step_time_drift", step, recent, "WARNING",
+                        f"step wall drift: recent median "
+                        f"{recent * 1e3:.2f} ms vs early-run median "
+                        f"{base * 1e3:.2f} ms "
+                        f"(+{(recent / base - 1) * 100:.0f}% > "
+                        f"{self.drift_rel:.0%} tolerance)"))
+        return found
+
+    # -- the run trailer ---------------------------------------------------
+
+    def summary(self):
+        """Aggregate verdict dict for the manifest's summary trailer and
+        the regression audit's ``current["health"]``."""
+        out = {"observed_steps": self.observed,
+               "counts": dict(self.counts),
+               "findings": len(self.findings)}
+        if self.first_nonfinite_step is not None:
+            out["first_nonfinite_step"] = self.first_nonfinite_step
+        if self.max_loss_z:
+            out["max_loss_z"] = round(self.max_loss_z, 3)
+        return out

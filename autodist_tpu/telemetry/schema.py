@@ -7,6 +7,11 @@ One JSON object per line.  Every record carries:
 - ``w``     — worker rank (added by the per-host writer)
 - ``pid``   — producing process id
 
+The ``meta`` header stamps :data:`SCHEMA_VERSION` as ``schema`` (v2
+introduced the ``health_finding`` kind and the summary's ``health``
+block; v1 manifests carry no stamp and still validate — unknown kinds
+were always tolerated).
+
 Kinds and their required fields (``docs/observability.md`` is the prose
 version; ``make telemetry-check`` asserts a live run validates):
 
@@ -25,11 +30,18 @@ version; ``make telemetry-check`` asserts a live run validates):
 - ``span``      — host span: ``name``, ``ts``, ``dur``
 - ``counter`` / ``gauge`` / ``hist`` — ``name``, ``value``
 - ``watchdog``  — slow-step capture: ``step``, ``trace_dir``
+- ``health_finding`` — online health verdict
+                  (:mod:`~autodist_tpu.telemetry.health`): ``step``,
+                  ``check`` (nonfinite / loss_spike / grad_norm_spike /
+                  step_time_drift); optional ``value``, ``severity``,
+                  ``message``
 - ``summary``   — run trailer: ``steps``, ``step_time_p50_s``;
                   optional ``mfu_p50``, ``compile_s``,
-                  ``runtime_record``, ``aggregates``
+                  ``runtime_record``, ``aggregates``, ``health``
 """
 import json
+
+SCHEMA_VERSION = 2
 
 REQUIRED_COMMON = ("kind",)
 
@@ -42,6 +54,7 @@ REQUIRED_BY_KIND = {
     "gauge": ("name", "value"),
     "hist": ("name", "value"),
     "watchdog": ("step", "trace_dir"),
+    "health_finding": ("step", "check"),
     "summary": ("steps", "step_time_p50_s"),
 }
 
@@ -50,6 +63,7 @@ NUMERIC_FIELDS = {
              "examples", "compile_s"),
     "summary": ("steps", "step_time_p50_s", "mfu_p50", "compile_s"),
     "span": ("ts", "dur"),
+    "health_finding": ("step",),
 }
 
 

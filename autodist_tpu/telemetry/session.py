@@ -22,8 +22,12 @@ What one instrumented step records (a ``step`` JSONL line):
 
 Plus periodic ``snapshot`` records (``memory_stats`` per device, peak
 summarized), host ``span`` records, the slow-step watchdog's
-``watchdog`` capture events, and a ``summary`` trailer with step-time
-percentiles and the registry aggregates.  At finalize the measured
+``watchdog`` capture events, online ``health_finding`` verdicts from the
+:class:`~autodist_tpu.telemetry.health.HealthMonitor` (NaN/Inf loss,
+loss/grad-norm spikes, step-time drift — the loss scalar the step
+already fetches to close the wall measurement is reused, so health
+costs no extra device sync), and a ``summary`` trailer with step-time
+percentiles, the health verdict, and the registry aggregates.  At finalize the measured
 steady-state median is exported as an AutoSync-style
 :class:`~autodist_tpu.simulator.cost_model.RuntimeRecord` so
 ``cost_model.calibrate()`` can refit from this run
@@ -65,6 +69,13 @@ class SessionTelemetry:
                 multiple=float(os.environ.get(
                     "AUTODIST_TELEMETRY_WATCHDOG_MULT", "3.0")))
         self.watchdog = watchdog or None
+        if os.environ.get("AUTODIST_TELEMETRY_HEALTH", "1") in \
+                ("0", "False"):
+            self.health = None
+        else:
+            from autodist_tpu.telemetry.health import HealthMonitor
+
+            self.health = HealthMonitor()
         self._n = 0                    # instrumented steps completed
         self._t0 = None
         self._rtt_s = None
@@ -82,9 +93,12 @@ class SessionTelemetry:
     def _write_meta(self):
         import jax
 
+        from autodist_tpu.telemetry.schema import SCHEMA_VERSION
+
         devices = list(self._t.mesh.devices.flat)
         meta = {
             "kind": "meta", "t": time.time(), "run_id": self.run_id,
+            "schema": SCHEMA_VERSION,
             "backend": jax.default_backend(),
             "num_devices": len(devices),
             "device_kind": getattr(devices[0], "device_kind", "?"),
@@ -159,8 +173,9 @@ class SessionTelemetry:
 
     def _sync_metrics(self, metrics):
         """Close the step at a REAL synchronization point: fetch one device
-        scalar (prefer the loss).  Returns the RTT estimate measured by
-        re-fetching the already-materialized scalar (once, first step)."""
+        scalar (prefer the loss).  Returns the fetched scalar (the health
+        monitor judges it — no second sync) or None; the RTT estimate is
+        measured once by re-fetching the already-materialized scalar."""
         from autodist_tpu.utils.timing import fetch_scalar
 
         leaf = None
@@ -173,15 +188,16 @@ class SessionTelemetry:
                 leaf = x
                 break
         if leaf is None:
-            return
+            return None
         try:
-            fetch_scalar(leaf)
+            val = fetch_scalar(leaf)
             if self._rtt_s is None:
                 t0 = time.perf_counter()
                 fetch_scalar(leaf)
                 self._rtt_s = time.perf_counter() - t0
+            return val
         except Exception:
-            pass
+            return None
 
     def _ensure_flops(self, gbatch):
         if self._flops_per_device is not None or self._flops_failed:
@@ -213,7 +229,7 @@ class SessionTelemetry:
         """Record one completed step; returns the step record dict."""
         from autodist_tpu.utils.timing import peak_flops
 
-        self._sync_metrics(metrics)
+        loss_val = self._sync_metrics(metrics)
         wall = time.perf_counter() - self._t0 if self._t0 is not None else 0.0
         self._t0 = None
         step = self._n
@@ -245,6 +261,26 @@ class SessionTelemetry:
             self._walls.append(cancelled)
         self._writer.write(rec)
         self.registry.histogram("session.step_wall_s", wall)
+        if self.health is not None:
+            grad_norm = None
+            if isinstance(metrics, dict) and "grad_norm" in metrics:
+                try:
+                    from autodist_tpu.utils.timing import fetch_scalar
+
+                    grad_norm = fetch_scalar(metrics["grad_norm"])
+                except Exception:
+                    grad_norm = None
+            health_findings = self.health.observe(
+                step, loss=loss_val, grad_norm=grad_norm, wall_s=eff)
+            for hf in health_findings:
+                self._writer.write({"kind": "health_finding",
+                                    "t": time.time(), **hf})
+                self.registry.counter(f"health.{hf['check']}")
+                logging.warning("telemetry health: %s", hf["message"])
+            if health_findings:
+                # the returned record carries the verdicts so the caller
+                # (ElasticTrainer.on_anomaly) can react without re-deriving
+                rec["health_findings"] = health_findings
         if self.watchdog is not None and not watchdog_capture:
             if self.watchdog.observe(step, wall):
                 s, w, med = self.watchdog.last_trigger
@@ -376,6 +412,8 @@ class SessionTelemetry:
                 span_records,
                 os.path.join(self.run_dir,
                              f"host_spans_worker_{self.worker}.trace.json"))
+        if self.health is not None:
+            summary["health"] = self.health.summary()
         summary["aggregates"] = self.registry.aggregates()
         self._writer.write(summary)
         manifest = None

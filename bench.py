@@ -612,6 +612,24 @@ def _run_child(env_extra, timeout_s):
     return None, f"rc={proc.returncode}: {tail}", combined
 
 
+def _attach_cpu_proxy(rec, budget, t_start):
+    """Attach the CPU-mesh engine-overhead table to a bench record —
+    success or failure alike.  tools/perf_gate.py diffs this trajectory
+    point against the blessed ``records/baselines`` every round, so a
+    round that measured real chips must not be the round that LOSES the
+    engine-overhead series; budget-guarded and best-effort."""
+    if rec.get("cpu_proxy") is not None:
+        return rec
+    remaining = budget - (time.monotonic() - t_start) - 30
+    if remaining > 45:
+        prox, _info, _out = _run_child({"_BENCH_CPU_PROXY": "1",
+                                        "JAX_PLATFORMS": "cpu"},
+                                       int(min(180, remaining)))
+        if prox is not None:
+            rec["cpu_proxy"] = prox
+    return rec
+
+
 def main():
     name = os.environ.get("BENCH_MODEL", "resnet50")
     if name not in MODELS:
@@ -694,14 +712,7 @@ def main():
         # relay down: run the CPU-mesh proxy so THIS round still records
         # an engine-overhead number (the perf trajectory r01-r05 lost) —
         # clearly a pipeline artifact, never merged into hardware claims
-        remaining = budget - (time.monotonic() - t_start) - 30
-        if remaining > 45:
-            prox, _info, _out = _run_child({"_BENCH_CPU_PROXY": "1",
-                                            "JAX_PLATFORMS": "cpu"},
-                                           int(min(180, remaining)))
-            if prox is not None:
-                rec["cpu_proxy"] = prox
-        _emit(rec)
+        _emit(_attach_cpu_proxy(rec, budget, t_start))
         return
     probe["n_probe_attempts"] = len(attempts) + 1
 
@@ -720,7 +731,7 @@ def main():
                 rec["fallback_from"] = {
                     "metric": MODELS[_model_name()]["metric"],
                     "error": last_err[:500]}
-                _emit(rec)
+                _emit(_attach_cpu_proxy(rec, budget, t_start))
                 return
             last_err += f" | gpt_small fallback: {gpt_err}"
         _emit(_error_rec("all_attempts_failed",
@@ -765,7 +776,7 @@ def main():
                                     t_start, max_tries=1)
             if gpt is not None:
                 rec["secondary"] = gpt
-    _emit(rec)
+    _emit(_attach_cpu_proxy(rec, budget, t_start))
 
 
 def _measure_model(name, env_extra, probe, budget, t_start, max_tries=2):

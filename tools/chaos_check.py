@@ -17,6 +17,11 @@ Asserts the elastic-training acceptance contract end to end, no TPU needed:
    with parameters exactly equal to an uninterrupted run.
 3. **delay (straggler) injection** — an injected host stall must not
    perturb the run's membership (no spurious re-plan).
+4. **NaN (anomaly) injection** — an injected all-NaN batch must surface
+   through the trainer's HealthMonitor as an ``on_anomaly`` signal
+   (``check='nonfinite'``), land in the telemetry manifest as
+   ``health_finding`` records + the summary's health verdict, and the
+   run must still drain to its step target with membership untouched.
 """
 import json
 import os
@@ -244,12 +249,68 @@ def check_delay_injection():
         return {"steps": 4, "replans": 0}
 
 
+def check_nan_anomaly_drill():
+    """Scenario 4: an injected all-NaN batch -> on_anomaly fires with
+    check='nonfinite', the manifest records the health findings, and the
+    run drains to its step target without a re-plan."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import telemetry
+    from autodist_tpu.elastic import ElasticTrainer
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    r = np.random.RandomState(7)
+    params = {"w": jnp.asarray(r.randn(12, 3), jnp.float32)}
+
+    def batch_fn(step):
+        rr = np.random.RandomState(step)
+        return {"x": rr.randn(16, 12).astype(np.float32),
+                "y": rr.randn(16, 3).astype(np.float32)}
+
+    anomalies = []
+    with tempfile.TemporaryDirectory() as d:
+        run_dir = os.path.join(d, "telemetry")
+        telemetry.enable(run_dir=run_dir)
+        try:
+            trainer = ElasticTrainer(
+                ResourceSpec.from_num_chips(8), AllReduce(), loss, params,
+                optax.sgd(0.05), checkpoint_dir=d, chaos="nan@2",
+                on_anomaly=anomalies.append)
+            sess = trainer.fit(batch_fn, steps=4)
+        finally:
+            telemetry.disable()
+            telemetry._STATE["run_dir"] = None
+        assert anomalies, "on_anomaly never fired on the injected NaN"
+        assert anomalies[0]["check"] == "nonfinite", anomalies[0]
+        # an anomaly is a signal, not a membership event
+        assert trainer.replans == 0 and trainer.epoch == 0
+        assert sess.step == 4, sess.step
+        # the session-side monitor wrote the manifest trail
+        records = telemetry.load_manifest(run_dir)
+        hf = [x for x in records if x.get("kind") == "health_finding"]
+        assert hf and any(x.get("check") == "nonfinite" for x in hf), hf
+        summ = next((x for x in records if x.get("kind") == "summary"), {})
+        counts = (summ.get("health") or {}).get("counts") or {}
+        assert counts.get("nonfinite"), summ.get("health")
+        return {"anomalies": len(anomalies),
+                "first_check": anomalies[0]["check"],
+                "manifest_health_findings": len(hf),
+                "nonfinite_count": counts["nonfinite"], "replans": 0}
+
+
 def main():
     t0 = time.monotonic()
     results = {}
     for name, fn in (("kill_one_worker", check_kill_one_worker),
                      ("preempt_resume", check_preempt_resume),
-                     ("delay_injection", check_delay_injection)):
+                     ("delay_injection", check_delay_injection),
+                     ("nan_anomaly_drill", check_nan_anomaly_drill)):
         t = time.monotonic()
         results[name] = fn()
         print(f"chaos_check: {name} OK ({time.monotonic() - t:.1f}s) -> "

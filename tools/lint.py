@@ -51,6 +51,16 @@ codebase:
         the ph=="X" filter); route parsing through
         ``telemetry.timeline.load_events`` / ``summarize_trace``.
         Scoped to ``autodist_tpu/`` and ``tools/``.
+  AD05  ad-hoc NaN/Inf screening of loss/grad values in engine code: a
+        ``jnp/np/numpy/math.isnan``/``isinf`` call whose arguments name
+        a loss or gradient, outside the blessed online detector
+        (``telemetry/health.py``).  Scattered finiteness checks disagree
+        on response policy (log? raise? skip the update?) and never
+        reach the manifest; route them through ``HealthMonitor`` so
+        every non-finite step becomes a ``health_finding`` record, an
+        R002 in the regression audit, and an ``on_anomaly`` signal in
+        the elastic trainer.  Scoped to ``autodist_tpu/``; tests and
+        tools assert on NaNs legitimately.
 
 Exit code 1 when any finding is reported.
 """
@@ -106,6 +116,17 @@ def _ad04_applies(path):
     return any(part in _AD01_PARTS for part in p.parts) \
         and _AD04_EXEMPT_DIR not in p.parts \
         and p.name not in (_AD04_EXEMPT_NAME, "lint.py")
+
+
+# AD05 applies inside the package only; telemetry/health.py IS the
+# blessed online-detection site (tools/ and tests assert on NaNs
+# legitimately)
+_AD05_EXEMPT = "health.py"
+
+
+def _ad05_applies(path):
+    p = Path(path)
+    return "autodist_tpu" in p.parts and p.name != _AD05_EXEMPT
 
 
 class Checker(ast.NodeVisitor):
@@ -291,6 +312,20 @@ class Checker(ast.NodeVisitor):
                          "the Cluster layer (retry/backoff, TERM->KILL "
                          "escalation, monitor reaping); '# noqa' with a "
                          "justification for non-process-management uses")
+        # AD05: ad-hoc NaN/Inf screening of loss/grad values — online
+        # numeric health detection must route through telemetry/health.py
+        if (_ad05_applies(self.path)
+                and isinstance(f, ast.Attribute)
+                and f.attr in ("isnan", "isinf")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("jnp", "np", "numpy", "math")
+                and self._names_loss_or_grad(node)):
+            self.add(node.lineno, "AD05",
+                     f"ad-hoc {f.attr} on a loss/grad value: route "
+                     f"finiteness checks through telemetry/health.py "
+                     f"(HealthMonitor.observe) so non-finite steps "
+                     f"become health_finding records, R002 in the "
+                     f"regression audit, and on_anomaly signals")
         # AD03: a shape-product inside flops-named code re-derives FLOP
         # accounting that must come from simulator/cost_model.py
         if (self._flop_ctx and self._is_prod_call(node)
@@ -302,6 +337,22 @@ class Checker(ast.NodeVisitor):
                      "jaxpr_flops) so the jaxpr model and the HLO "
                      "compute audit cannot drift")
         self.generic_visit(node)
+
+    # -- AD05: ad-hoc NaN/Inf screening of loss/grad ------------------------
+
+    @staticmethod
+    def _names_loss_or_grad(call):
+        """Any identifier anywhere in the call's arguments whose name
+        mentions a loss or gradient (Name ids and Attribute attrs,
+        case-insensitive substring)."""
+        for a in call.args + [kw.value for kw in call.keywords]:
+            for n in ast.walk(a):
+                ident = n.id if isinstance(n, ast.Name) else (
+                    n.attr if isinstance(n, ast.Attribute) else "")
+                low = ident.lower()
+                if "loss" in low or "grad" in low:
+                    return True
+        return False
 
     # -- AD04: ad-hoc chrome-trace parsing ---------------------------------
 

@@ -32,7 +32,18 @@ absent; schema in ``autodist_tpu/telemetry/schema.py``) and reports:
   step decomposition, per-hop predicted-vs-measured bandwidth error,
   worker skew, and the overlap reconciliation; with no artifact argument
   the tables come from the manifest itself (the ``runtime_finding``
-  records a SlowStepWatchdog capture auto-writes).
+  records a SlowStepWatchdog capture auto-writes),
+- with ``--health [BASELINE]`` (a blessed baseline name under
+  ``records/baselines`` or a baseline JSON path; default: look one up by
+  the run id): the run's health verdict — the HealthMonitor's
+  ``health_finding`` records (NaN/Inf, loss/grad spikes, step-time
+  drift) and counts — plus the cross-run R-code diff
+  (:mod:`autodist_tpu.analysis.regression_audit`) against the baseline.
+
+Merge hygiene: when the per-worker manifests are merged (or a chief
+manifest is parsed), lines the reader skipped (torn writes) and
+duplicate records dropped are surfaced as ``merge_hygiene`` — nonzero
+counts mean the manifest needs attention before its numbers are trusted.
 """
 import argparse
 import json
@@ -43,7 +54,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-from autodist_tpu.telemetry import load_manifest, percentiles  # noqa: E402
+from autodist_tpu.telemetry import (load_manifest_with_stats,  # noqa: E402
+                                    percentiles)
 
 
 def _fmt_s(x):
@@ -73,7 +85,7 @@ def _hbm_budget(device_kind):
     return None
 
 
-def summarize_manifest(records):
+def summarize_manifest(records, stats=None):
     """Manifest records -> summary dict (the --json payload)."""
     meta = next((r for r in records if r.get("kind") == "meta"), {})
     steps = [r for r in records if r.get("kind") == "step"]
@@ -146,6 +158,22 @@ def summarize_manifest(records):
             if key in counters:
                 out.setdefault("async_ps", {})[key.split(".", 1)[1]] = \
                     counters[key]
+    # merge hygiene: torn lines skipped + duplicates dropped — from the
+    # reader's own parse stats AND any counters the run recorded (the
+    # same merge may be counted in both places, so take the max)
+    hygiene = {"skipped_lines": 0, "skipped_duplicates": 0}
+    for k in hygiene:
+        if stats:
+            hygiene[k] = max(hygiene[k], int(stats.get(k, 0) or 0))
+        for s in summaries:
+            counters = (s.get("aggregates") or {}).get("counters", {})
+            hygiene[k] = max(hygiene[k],
+                             int(counters.get(f"aggregate.{k}", 0) or 0))
+    out["merge_hygiene"] = hygiene
+    # the run's own health verdict, surfaced from any summary
+    for s in summaries:
+        if s.get("health"):
+            out["health"] = s["health"]
     return out
 
 
@@ -209,6 +237,17 @@ def render(summary):
         add(f"watchdog captures: {summary['watchdog_captures']}")
     if summary.get("runtime_records"):
         add("runtime records: " + ", ".join(summary["runtime_records"]))
+    hygiene = summary.get("merge_hygiene") or {}
+    if any(hygiene.values()):
+        add(f"MERGE HYGIENE: {hygiene.get('skipped_lines', 0)} torn "
+            f"line(s) skipped, {hygiene.get('skipped_duplicates', 0)} "
+            f"duplicate record(s) dropped — inspect the per-worker "
+            f"manifests before trusting these numbers")
+    health = summary.get("health") or {}
+    if health.get("counts"):
+        add("health: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(health["counts"].items()))
+            + " (details with --health)")
     return "\n".join(lines)
 
 
@@ -418,6 +457,75 @@ def render_timeline(timelines, summary=None):
     return "\n".join(lines)
 
 
+def load_health(records, baseline_spec=None):
+    """The run's health verdict + the cross-run R-code diff.  Returns
+    ``(health_findings, regression_findings)`` where the former are the
+    manifest's ``health_finding`` records and the latter are R-code
+    :class:`Finding` objects from the regression audit (against the
+    blessed baseline named/pathed by ``baseline_spec``, or looked up by
+    the run id; no baseline -> the audit still judges R002/R003 and
+    notes R000)."""
+    from autodist_tpu.analysis.regression_audit import regression_audit
+    from autodist_tpu.telemetry.baseline import (baseline_from_manifest,
+                                                 load_baseline)
+
+    meta = next((r for r in records if r.get("kind") == "meta"), {})
+    name = str(meta.get("run_id") or "run")
+    current = baseline_from_manifest(records, name=name)
+    baseline = None
+    if baseline_spec and os.path.exists(baseline_spec):
+        with open(baseline_spec) as f:
+            baseline = json.load(f)
+    elif baseline_spec:
+        baseline = load_baseline(baseline_spec)
+    else:
+        baseline = load_baseline(name)
+    hf = [r for r in records if r.get("kind") == "health_finding"]
+    return hf, regression_audit(current, baseline)
+
+
+def render_health(health_findings, regression_findings, summary=None):
+    """The health & regression section: per-step online detections, the
+    run's aggregate counts, and the R-code diff against the baseline."""
+    lines = []
+    h = (summary or {}).get("health") or {}
+    counts = h.get("counts") or {}
+    lines.append(
+        f"health — {h.get('observed_steps', 0)} step(s) observed, "
+        f"{h.get('findings', len(health_findings))} finding(s)"
+        + (": " + ", ".join(f"{k}={v}"
+                            for k, v in sorted(counts.items()))
+           if counts else " (clean)"))
+    if h.get("first_nonfinite_step") is not None:
+        lines.append(f"  first non-finite at step "
+                     f"{h['first_nonfinite_step']} — every later "
+                     f"step is poisoned")
+    for r in health_findings[:20]:
+        lines.append(f"  step {r.get('step')}: [{r.get('severity')}] "
+                     f"{r.get('check')} — {r.get('message')}")
+    if len(health_findings) > 20:
+        lines.append(f"  ... {len(health_findings) - 20} more "
+                     f"health finding(s)")
+    r006 = next((f.data for f in regression_findings
+                 if f.code == "R006"), None)
+    base = (r006 or {}).get("baseline")
+    lines.append("regression vs baseline"
+                 + (f" '{base.get('name')}'" if base else " (none blessed)")
+                 + ":")
+    for f in regression_findings:
+        if f.code != "R006":
+            lines.append(f"  [{f.severity.name}] {f.code}: {f.message}")
+    for metric, d in ((r006 or {}).get("diffs") or {}).items():
+        lines.append(f"  {metric:28s} current {d['current']:.4g}  "
+                     f"blessed {d['baseline']:.4g}  "
+                     f"limit {d['limit']:.4g}")
+    verdict = (r006 or {}).get("regressed") or []
+    lines.append("  verdict: "
+                 + ("REGRESSED " + ", ".join(verdict) if verdict
+                    else "clean"))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("path", help="telemetry run dir or manifest.jsonl")
@@ -442,12 +550,19 @@ def main(argv=None):
                          "runtime_finding records): show the T006 "
                          "three-way table with per-hop "
                          "predicted-vs-measured bandwidth error")
+    ap.add_argument("--health", nargs="?", const="", default=None,
+                    metavar="BASELINE",
+                    help="show the run's health verdict (health_finding "
+                         "records, counts) and the cross-run R-code diff "
+                         "against a blessed baseline (a name under "
+                         "records/baselines or a JSON path; default: "
+                         "look one up by the run id)")
     args = ap.parse_args(argv)
-    records = load_manifest(args.path)
+    records, stats = load_manifest_with_stats(args.path)
     if not records:
         print(f"no telemetry records under {args.path}", file=sys.stderr)
         return 1
-    summary = summarize_manifest(records)
+    summary = summarize_manifest(records, stats=stats)
     audits = load_audit(args.audit) if args.audit else []
     if audits:
         summary["hlo_audit"] = {name: table for name, table in audits}
@@ -463,6 +578,14 @@ def main(argv=None):
                   "capture in the manifest)", file=sys.stderr)
         else:
             summary["runtime_timeline"] = {n: t for n, t in timelines}
+    health_findings, regression_findings = [], []
+    if args.health is not None:
+        health_findings, regression_findings = \
+            load_health(records, args.health or None)
+        summary["health_findings"] = health_findings
+        summary["regression"] = next(
+            (f.data for f in regression_findings if f.code == "R006"),
+            None)
     if args.json:
         print(json.dumps(summary, indent=2))
     else:
@@ -473,6 +596,9 @@ def main(argv=None):
             print(render_compute(computes, summary))
         if timelines:
             print(render_timeline(timelines, summary))
+        if args.health is not None:
+            print(render_health(health_findings, regression_findings,
+                                summary))
     return 0
 
 

@@ -31,6 +31,14 @@ Targets:
   (the F006 table every target must emit); with ``--selftest``, the
   seeded remat-everything case must be caught as F002 and the seeded
   dropped-donation case as F004.
+- ``--regression`` — run the cross-run REGRESSION tier (R-codes): each
+  record target is diffed against its blessed baseline in
+  ``records/baselines/<name>.json`` (throughput/engine-overhead R001,
+  non-finite R002, MFU-ceiling drop R004, comm-bytes growth R005) and
+  must emit its machine-readable R006 run-vs-baseline table; with
+  ``--selftest``, the golden fixtures under ``tests/data/regression``
+  must fire R001 on the seeded slow manifest and R002 on the NaN
+  manifest while the control stays clean.
 - ``--runtime [TRACE_DIR]`` — run the RUNTIME audit tier (T-codes): a
   ``jax.profiler`` chrome-trace capture is parsed, its collective
   events matched against the strategy's intended channel table, and
@@ -145,6 +153,11 @@ def main(argv=None):
                          "exposed-comm fraction diffed against the "
                          "prediction; every target must emit its T006 "
                          "three-way table")
+    ap.add_argument("--regression", action="store_true",
+                    help="also run the cross-run REGRESSION tier "
+                         "(R-codes): diff each record against its "
+                         "blessed baseline in records/baselines/; every "
+                         "target must emit its R006 table")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write all reports as JSON to this path")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -152,9 +165,9 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     _force_cpu_devices()
-    from autodist_tpu.analysis import (LOWERED_PASSES, RUNTIME_PASSES,
-                                       STATIC_PASSES, TRACE_PASSES,
-                                       verify_strategy)
+    from autodist_tpu.analysis import (LOWERED_PASSES, REGRESSION_PASSES,
+                                       RUNTIME_PASSES, STATIC_PASSES,
+                                       TRACE_PASSES, verify_strategy)
     from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
                                              EXPECTED_DONATION_CODE,
                                              EXPECTED_ERROR_CODES,
@@ -190,6 +203,10 @@ def main(argv=None):
         base = passes if passes is not None else \
             STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
         passes = base + RUNTIME_PASSES
+    if args.regression:
+        base = passes if passes is not None else \
+            STATIC_PASSES + TRACE_PASSES
+        passes = base + REGRESSION_PASSES
     trace_dir = args.runtime or None
     # with a lowered compute pass selected, every record target must
     # produce its machine-readable F006 compute table
@@ -197,6 +214,9 @@ def main(argv=None):
     # with the runtime tier selected, every record target must produce
     # its machine-readable T006 three-way table
     want_t006 = bool(passes) and "runtime-audit" in passes
+    # with the regression tier selected, every record target must produce
+    # its machine-readable R006 run-vs-baseline table
+    want_r006 = bool(passes) and "regression-audit" in passes
     results = {}
     failed = False
 
@@ -218,10 +238,24 @@ def main(argv=None):
             print(f"[ERROR] {path}: cannot load record: {e}")
             failed = True
             continue
+        if args.regression:
+            # key the baseline lookup on the record stem (the name the
+            # perf gate blesses under), not the embedded strategy id
+            stem = os.path.basename(path)
+            if stem.endswith(".json"):
+                stem = stem[:-len(".json")]
+            case["current_metrics"] = {"name": stem}
         report = verify_strategy(passes=passes, trace_dir=trace_dir, **case)
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
         failed = failed or not report.ok
+        if want_r006:
+            r6 = next((f for f in report.findings if f.code == "R006"),
+                      None)
+            if r6 is None:
+                print(f"[ERROR] {os.path.basename(path)}: regression "
+                      f"audit produced no R006 table")
+                failed = True
         if want_t006:
             t6 = next((f for f in report.findings if f.code == "T006"),
                       None)
@@ -312,6 +346,55 @@ def main(argv=None):
                 else:
                     print(f"compute selftest passed: the {label} case "
                           f"is {want}")
+        if args.regression:
+            # the golden regression fixtures (tests/data/regression):
+            # the seeded slow manifest must fire R001, the NaN manifest
+            # R002, and the blessed level diffed against itself must
+            # stay clean
+            from autodist_tpu.analysis.regression_audit import \
+                audit_fixture as regression_fixture
+            from autodist_tpu.analysis.report import Report
+
+            fixdir = os.path.join(REPO, "tests", "data", "regression")
+            base = os.path.join(fixdir, "baseline.json")
+            checks = (
+                ("slow", dict(
+                    manifest_dir=os.path.join(fixdir, "slow_run"),
+                    baseline_path=base, name="regfix"), "R001"),
+                ("nan", dict(
+                    manifest_dir=os.path.join(fixdir, "nan_run"),
+                    baseline_path=base, name="regfix"), "R002"),
+                ("control", dict(
+                    current_path=base, baseline_path=base,
+                    name="regfix"), None),
+            )
+            for label, kw, want in checks:
+                findings = regression_fixture(**kw)
+                report = Report()
+                report.extend(findings)
+                results[f"<regression-{label}-selftest>"] = report
+                _print_report(f"regression selftest ({label})", report,
+                              args.verbose)
+                codes = {f.code for f in findings}
+                if want is not None:
+                    if want not in codes:
+                        print(f"[ERROR] regression selftest ({label}): "
+                              f"expected {want} did not fire "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print(f"regression selftest passed: the {label} "
+                              f"fixture fires {want}")
+                else:
+                    bad = codes & {"R001", "R002", "R004", "R005"}
+                    if bad or "R006" not in codes:
+                        print(f"[ERROR] regression selftest (control): "
+                              f"expected a clean R006 "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print("regression selftest passed: the control "
+                              "stays clean with its R006 table")
         if args.runtime is not None:
             # the golden trace fixtures (tests/data/trace): the
             # exposed-comm step must be caught as T001, the skewed
