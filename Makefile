@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check chaos perf-gate check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check monitor-check chaos perf-gate check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -96,6 +96,18 @@ timeline-check:
 	$(PY) tools/timeline_check.py
 	$(PY) tools/verify_strategy.py --runtime --selftest
 
+# live control-plane gate (docs/observability.md "Live control plane"):
+# a telemetry-enabled CPU-mesh session streams frames to a chief-side
+# TelemetryCollector over the length-prefixed-JSON socket, the mirrored
+# cluster event log folds into the schema-v3 manifest with a clean E005
+# causality table, tools/monitor.py --once and telemetry_report --follow
+# render the run dir, and a dead collector degrades to file-only with
+# counted drops; the E-code fixtures must fire E001 (unacted signal) and
+# E002 (blown MTTR budget) with a clean control (--events --selftest)
+monitor-check:
+	$(PY) tools/monitor_check.py
+	$(PY) tools/verify_strategy.py --events --selftest
+
 # fault-injection gate (docs/elasticity.md): CPU-mesh chaos drills —
 # kill-one-worker (drain -> manifest checkpoint -> AutoStrategy re-plan on
 # the shrunk topology -> R->R' reshard incl. sharded opt state -> Y/X
@@ -116,11 +128,12 @@ perf-gate:
 	$(PY) tools/perf_gate.py
 
 # the pre-merge gate: lint + strategy verification + HLO audit + live
-# telemetry + runtime timeline + chaos drills + the cross-run perf gate
-# (tests/test_analysis.py + test_telemetry.py + test_timeline.py +
-# test_elastic.py + test_regression_audit.py run the same chains, so
+# telemetry + runtime timeline + live control plane + chaos drills + the
+# cross-run perf gate (tests/test_analysis.py + test_telemetry.py +
+# test_timeline.py + test_elastic.py + test_regression_audit.py +
+# test_stream.py + test_reaction_audit.py run the same chains, so
 # tier-1 exercises it)
-check: lint verify audit telemetry-check timeline-check chaos perf-gate
+check: lint verify audit telemetry-check timeline-check monitor-check chaos perf-gate
 
 clean:
 	$(MAKE) -C native clean

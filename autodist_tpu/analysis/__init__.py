@@ -32,8 +32,9 @@ See ``docs/analysis.md``.
 """
 from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
                                           StrategyVerificationError)
-from autodist_tpu.analysis.passes import (LOWERED_PASSES, PASS_REGISTRY,  # noqa: F401
-                                          REGRESSION_PASSES, RUNTIME_PASSES,
-                                          STATIC_PASSES, TRACE_PASSES)
+from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,  # noqa: F401
+                                          PASS_REGISTRY, REGRESSION_PASSES,
+                                          RUNTIME_PASSES, STATIC_PASSES,
+                                          TRACE_PASSES)
 from autodist_tpu.analysis.verify import (AnalysisContext, verify_strategy,  # noqa: F401
                                           verify_transformer)

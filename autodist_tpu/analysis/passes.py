@@ -105,6 +105,13 @@ Finding codes (stable; tests and tools match on them):
   R005 WARNING realized comm bytes grew vs baseline
   R006 INFO    machine-readable run-vs-baseline table (carried in
                Finding.data)
+  E000 INFO    reaction audit skipped (no cluster events recorded)
+  E001 ERROR   persistent signal never acted on by the control plane
+  E002 ERROR   signal->action latency beyond the MTTR budget
+  E003 WARNING re-plan that regressed throughput vs the pre-replan window
+  E004 WARNING heartbeat gap without a membership event
+  E005 INFO    machine-readable event/causality table (carried in
+               Finding.data)
   TR001 ERROR  tracing the strategy's train step failed
   TR002 INFO   trace skipped (trace passes did not run)
 
@@ -121,6 +128,11 @@ measured loop.  The R-codes form the CROSS-RUN tier
 above — or a finalized run manifest — against the blessed baselines in
 ``records/baselines`` (:mod:`autodist_tpu.telemetry.baseline`), so a
 regression is a ranked finding in the same Report as everything else.
+The E-codes form the CONTROL-PLANE tier
+(:mod:`autodist_tpu.analysis.reaction_audit`): they judge the causal
+cluster event log (schema v3 ``cluster_event`` records — live signals,
+control actions, cause, signal->action latency) against the reaction
+contract, so an ignored alarm or a slow MTTR ranks in the same Report.
 """
 import numpy as np
 
@@ -800,6 +812,16 @@ def regression_audit_pass(ctx):
     return _run(ctx)
 
 
+def reaction_audit_pass(ctx):
+    """Control-plane tier pass: judge the run's causal cluster event log
+    (signals vs actions, cause, signal->action latency) against the
+    reaction contract (:mod:`autodist_tpu.analysis.reaction_audit`)."""
+    from autodist_tpu.analysis.reaction_audit import \
+        reaction_audit_pass as _run
+
+    return _run(ctx)
+
+
 PASS_REGISTRY = {
     "sharding": sharding_pass,
     "hierarchy": hierarchy_pass,
@@ -811,6 +833,7 @@ PASS_REGISTRY = {
     "compute-audit": compute_audit_pass,
     "runtime-audit": runtime_audit_pass,
     "regression-audit": regression_audit_pass,
+    "reaction-audit": reaction_audit_pass,
 }
 
 STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
@@ -829,3 +852,8 @@ RUNTIME_PASSES = ("runtime-audit",)
 # via verify_strategy(passes=..., baseline=...), the CLI's --regression,
 # and tools/perf_gate.py
 REGRESSION_PASSES = ("regression-audit",)
+# the CONTROL-PLANE tier: judge the causal cluster event log (live
+# signals vs control actions + measured MTTR); opt-in via
+# verify_strategy(passes=..., event_records=...), the CLI's --events,
+# ElasticTrainer's end-of-fit export, and tools/monitor_check.py
+EVENT_PASSES = ("reaction-audit",)

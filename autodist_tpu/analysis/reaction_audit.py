@@ -1,0 +1,294 @@
+"""Reaction audit: the CONTROL-PLANE tier (E-codes) of the verification
+stack.
+
+The cross-run tier (R-codes) judges what a run *achieved*; this pass
+judges how the control plane *reacted*.  Input is the causal cluster
+event log (:mod:`autodist_tpu.telemetry.events` — schema v3
+``cluster_event`` records): signals the live stream observed (straggler,
+anomaly, heartbeat gap, worker exit) and the actions taken (membership
+epoch bump, re-plan, checkpoint save, preemption guard, chaos injection,
+hook firing), each action carrying ``cause=`` the signal and the
+measured signal->action latency.
+
+  E000 INFO    reaction audit skipped (no cluster events recorded)
+  E001 ERROR   persistent signal never acted on — the control loop saw
+               it (repeatedly, or flagged persistent) and did nothing
+  E002 ERROR   signal->action latency beyond the MTTR budget (the
+               chaos-scenario mean-time-to-react gate)
+  E003 WARNING a re-plan that regressed throughput vs the pre-replan
+               window — the reaction made things worse
+  E004 WARNING heartbeat gap with no membership event — a silent worker
+               neither recovered nor was evicted
+  E005 INFO    machine-readable event/causality table (``Finding.data``;
+               consumed by ``tools/monitor.py`` and
+               ``tools/verify_strategy.py --events``)
+
+Signals and actions are matched on the action's ``cause``: same signal
+name, same worker (when both name one).  A signal that repeats without a
+matching action is the definition of an ignored alarm — that is E001's
+contract, regardless of severity downstream.
+"""
+from typing import List
+
+from autodist_tpu.analysis.report import Finding, Severity
+
+# signal->action latency budget (E002): chaos drills inject faults with
+# sub-second detection paths, so seconds of reaction lag means the live
+# loop is not actually live.  Callers override per run (ctx.mttr_budget_s).
+MTTR_BUDGET_S = 5.0
+# a signal group with no matching action fires E001 once it repeated this
+# many times (a single transient blip is not an ignored alarm) — unless a
+# record is flagged persistent, which fires alone
+UNACTED_MIN_REPEATS = 2
+# E003: post-replan step walls may exceed the pre-replan window by this
+# much relative slack before the re-plan counts as a regression (a
+# shrunk topology legitimately does more work per remaining worker)
+REPLAN_TOL_REL = 0.60
+# E003 window: how many steady-state steps on each side of the re-plan
+REPLAN_WINDOW = 5
+
+
+def _f(sev, code, msg, subject="", data=None):
+    return Finding(Severity(sev), code, "reaction-audit", msg, subject,
+                   data=data)
+
+
+def _median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def _sig_key(signal, worker):
+    return (signal or "?", worker if worker is not None else "?")
+
+
+def _cause_matches(cause, key):
+    signal, worker = key
+    if (cause.get("signal") or "?") != signal:
+        return False
+    cworker = cause.get("worker")
+    # an action that names a worker must name THIS worker; an action
+    # without one (e.g. a global re-plan) answers any worker's signal
+    return cworker is None or worker == "?" or cworker == worker
+
+
+def _step_walls_by_index(steps):
+    by_idx = {}
+    for r in steps or ():
+        if r.get("kind") not in (None, "step"):
+            continue
+        idx, wall = r.get("step"), r.get("wall_cancelled_s", r.get("wall_s"))
+        if isinstance(idx, (int, float)) and isinstance(wall, (int, float)):
+            by_idx.setdefault(int(idx), []).append(float(wall))
+    return {i: _median(v) for i, v in by_idx.items()}
+
+
+def reaction_audit(events, steps=None, *,
+                   mttr_budget_s=MTTR_BUDGET_S) -> List[Finding]:
+    """Judge the control plane's reactions recorded in ``events``.
+
+    ``events`` are ``cluster_event`` records (from a live
+    :class:`~autodist_tpu.telemetry.events.ClusterEventLog`, an
+    ``events.jsonl``, or a merged manifest); ``steps`` are optional
+    manifest ``step`` records for the E003 throughput windows."""
+    findings = []
+    events = [e for e in (events or [])
+              if isinstance(e, dict) and e.get("event")]
+    signals = [e for e in events if e.get("event") == "signal"]
+    actions = [e for e in events if e.get("event") != "signal"]
+
+    if not events:
+        findings.append(_f(
+            Severity.INFO, "E000",
+            "reaction audit has no cluster events — run with telemetry "
+            "streaming on (ElasticTrainer records the event log)"))
+
+    # -- group signals, match each group to its caused actions --------------
+    groups = {}
+    for s in signals:
+        key = _sig_key(s.get("signal"), s.get("worker"))
+        g = groups.setdefault(key, {"count": 0, "persistent": False,
+                                    "first_t": None, "steps": [],
+                                    "codes": set(), "acted": []})
+        g["count"] += 1
+        g["persistent"] = g["persistent"] or bool(s.get("persistent"))
+        if isinstance(s.get("t"), (int, float)):
+            g["first_t"] = s["t"] if g["first_t"] is None \
+                else min(g["first_t"], s["t"])
+        if s.get("step") is not None:
+            g["steps"].append(s["step"])
+        if s.get("code"):
+            g["codes"].add(s["code"])
+    causality = []
+    for a in actions:
+        cause = a.get("cause")
+        if not isinstance(cause, dict):
+            continue
+        pair = {"signal": cause.get("signal"), "worker": cause.get("worker"),
+                "code": cause.get("code"), "signal_step": cause.get("step"),
+                "action": a.get("event"), "action_step": a.get("step"),
+                "latency_s": a.get("latency_s")}
+        causality.append(pair)
+        for key, g in groups.items():
+            if _cause_matches(cause, key):
+                g["acted"].append(a)
+
+    # -- E001: persistent signal never acted on -----------------------------
+    unacted = []
+    for (signal, worker), g in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        if g["acted"]:
+            continue
+        if not (g["persistent"] or g["count"] >= UNACTED_MIN_REPEATS):
+            continue
+        unacted.append({"signal": signal, "worker": worker,
+                        "count": g["count"], "codes": sorted(g["codes"]),
+                        "steps": g["steps"][:8]})
+        why = "flagged persistent" if g["persistent"] \
+            else f"repeated {g['count']}x"
+        findings.append(_f(
+            Severity.ERROR, "E001",
+            f"ignored alarm: '{signal}' signal from {worker} ({why}"
+            + (f", codes {', '.join(sorted(g['codes']))}" if g["codes"]
+               else "")
+            + ") was never answered by any control-plane action — the "
+            "live loop observed a fault and did nothing",
+            str(worker)))
+
+    # -- E002: signal->action latency beyond the MTTR budget ----------------
+    latencies = [a.get("latency_s") for a in actions
+                 if isinstance(a.get("latency_s"), (int, float))]
+    for a in actions:
+        lat = a.get("latency_s")
+        if not isinstance(lat, (int, float)) or lat <= mttr_budget_s:
+            continue
+        cause = a.get("cause") or {}
+        findings.append(_f(
+            Severity.ERROR, "E002",
+            f"slow reaction: '{a.get('event')}' answered the "
+            f"'{cause.get('signal', '?')}' signal from "
+            f"{cause.get('worker', '?')} after {lat:.2f} s "
+            f"(MTTR budget {mttr_budget_s:.2f} s) — the control loop is "
+            f"not live at this latency",
+            str(cause.get("worker", "?")),
+            data={"latency_s": lat, "budget_s": mttr_budget_s,
+                  "action": a.get("event"), "cause": cause}))
+
+    # -- E003: re-plan that regressed throughput ----------------------------
+    walls = _step_walls_by_index(steps)
+    for a in actions:
+        if a.get("event") != "replan" or a.get("step") is None or not walls:
+            continue
+        at = int(a["step"])
+        pre = [walls[i] for i in sorted(walls) if 0 < i < at][-REPLAN_WINDOW:]
+        post = [walls[i] for i in sorted(walls) if i > at][:REPLAN_WINDOW]
+        if len(pre) < 2 or len(post) < 2:
+            continue
+        pre_med, post_med = _median(pre), _median(post)
+        limit = pre_med * (1.0 + REPLAN_TOL_REL)
+        if post_med > limit:
+            findings.append(_f(
+                Severity.WARNING, "E003",
+                f"re-plan at step {at} regressed throughput: post-replan "
+                f"step p50 {post_med * 1e3:.2f} ms vs pre-replan "
+                f"{pre_med * 1e3:.2f} ms (limit {limit * 1e3:.2f} ms = "
+                f"+{REPLAN_TOL_REL:.0%}) — the reaction made the run "
+                f"slower than the fault did",
+                f"step {at}",
+                data={"step": at, "pre_p50_s": pre_med,
+                      "post_p50_s": post_med, "limit_s": limit}))
+
+    # -- E004: heartbeat gap with no membership event -----------------------
+    membership_ts = [a.get("t") for a in actions
+                     if a.get("event") == "membership_epoch"
+                     and isinstance(a.get("t"), (int, float))]
+    for (signal, worker), g in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        if signal != "heartbeat_gap" or g["acted"]:
+            continue
+        t0 = g["first_t"]
+        answered = t0 is not None and any(t >= t0 for t in membership_ts)
+        if not answered:
+            findings.append(_f(
+                Severity.WARNING, "E004",
+                f"heartbeat gap on {worker} with no membership event — "
+                f"the worker went silent but was neither declared dead "
+                f"(epoch bump) nor recovered",
+                str(worker)))
+
+    # -- E005: the machine-readable event/causality table -------------------
+    kind_counts = {}
+    for e in events:
+        k = e.get("event")
+        kind_counts[k] = kind_counts.get(k, 0) + 1
+    data = {
+        "events": len(events),
+        "signals": len(signals),
+        "actions": len(actions),
+        "by_event": dict(sorted(kind_counts.items())),
+        "causality": causality,
+        "unacted": unacted,
+        "latency_s": {
+            "count": len(latencies),
+            "max": max(latencies) if latencies else None,
+            "mean": (sum(latencies) / len(latencies)) if latencies else None,
+        },
+        "mttr_budget_s": mttr_budget_s,
+        "flagged": sorted({f.code for f in findings
+                           if f.code in ("E001", "E002", "E003", "E004")}),
+    }
+    verdict = "flagged: " + ", ".join(data["flagged"]) if data["flagged"] \
+        else "clean"
+    findings.append(_f(
+        Severity.INFO, "E005",
+        f"control-plane table: {len(signals)} signal(s), "
+        f"{len(actions)} action(s), {len(causality)} caused, "
+        + (f"max latency {data['latency_s']['max']:.2f} s"
+           if latencies else "no measured latencies")
+        + f" — {verdict}", "events", data=data))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points: the registered pass and the fixture/CLI path
+# ---------------------------------------------------------------------------
+
+
+def events_from_context(ctx):
+    """The event records the context carries: an explicit
+    ``ctx.event_records`` list wins; otherwise the ``cluster_event``
+    records inside the aggregated manifest."""
+    explicit = getattr(ctx, "event_records", None)
+    if explicit is not None:
+        return explicit
+    records = getattr(ctx, "manifest_records", None) or []
+    return [r for r in records if r.get("kind") == "cluster_event"]
+
+
+def reaction_audit_pass(ctx) -> List[Finding]:
+    """PASS_REGISTRY entry (the control-plane tier): audit the run's
+    cluster event log against the reaction contract."""
+    events = events_from_context(ctx)
+    records = getattr(ctx, "manifest_records", None) or []
+    steps = [r for r in records if r.get("kind") == "step"]
+    budget = getattr(ctx, "mttr_budget_s", None) or MTTR_BUDGET_S
+    findings = reaction_audit(events, steps, mttr_budget_s=budget)
+    ctx.reaction_summary = next(
+        (f.data for f in findings if f.code == "E005"), None)
+    return findings
+
+
+def audit_fixture(events_path, manifest_dir=None, *,
+                  mttr_budget_s=MTTR_BUDGET_S):
+    """Run the audit over a golden events JSONL (plus an optional
+    worker-manifest dir for the E003 step windows); returns the findings
+    (``tools/verify_strategy.py --events --selftest`` drives this)."""
+    from autodist_tpu.telemetry.events import load_events
+
+    steps = None
+    if manifest_dir:
+        from autodist_tpu.telemetry import aggregate
+
+        steps = [r for r in aggregate.load_manifest(manifest_dir)
+                 if r.get("kind") == "step"]
+    return reaction_audit(load_events(events_path), steps,
+                          mttr_budget_s=mttr_budget_s)

@@ -19,9 +19,10 @@ does not mask every other finding behind a trace error.
 import dataclasses
 from typing import Any, Dict, Optional
 
-from autodist_tpu.analysis.passes import (LOWERED_PASSES, PASS_REGISTRY,
-                                          REGRESSION_PASSES, RUNTIME_PASSES,
-                                          STATIC_PASSES, TRACE_PASSES)
+from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,
+                                          PASS_REGISTRY, REGRESSION_PASSES,
+                                          RUNTIME_PASSES, STATIC_PASSES,
+                                          TRACE_PASSES)
 from autodist_tpu.analysis.report import Report, Severity
 from autodist_tpu.utils import logging
 
@@ -74,6 +75,12 @@ class AnalysisContext:
     baseline: Any = None
     current_metrics: Optional[dict] = None
     regression_summary: Optional[dict] = None
+    # control-plane (reaction) tier: the causal cluster event log to
+    # audit (explicit records win; else the manifest's cluster_event
+    # records), the MTTR latency budget, and the audit's E005 table
+    event_records: Optional[list] = None
+    mttr_budget_s: Optional[float] = None
+    reaction_summary: Optional[dict] = None
 
 
 def _mesh_info(strategy, resource_spec, mesh):
@@ -177,7 +184,8 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
                        hbm_bytes_per_device=None, rng=None,
                        passes=None, trace_dir=None,
                        manifest_records=None, baseline=None,
-                       current_metrics=None) -> Report:
+                       current_metrics=None, event_records=None,
+                       mttr_budget_s=None) -> Report:
     """Verify an already-built :class:`GraphTransformer` (the engine's
     in-session entry: the runner's ``verify=`` knob, ``aot_compile``, and
     the watchdog's post-capture analysis reuse the transformer they
@@ -190,7 +198,8 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
         batch_shapes=batch_shapes, donate=donate,
         hbm_bytes_per_device=hbm_bytes_per_device,
         trace_dir=trace_dir, manifest_records=manifest_records,
-        baseline=baseline, current_metrics=current_metrics)
+        baseline=baseline, current_metrics=current_metrics,
+        event_records=event_records, mttr_budget_s=mttr_budget_s)
     ctx.transformer = transformer
     report = Report(strategy_id=getattr(transformer.strategy, "id", ""))
     selected = tuple(passes) if passes is not None else \
@@ -209,6 +218,11 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
             report.extend(PASS_REGISTRY[name](ctx))
     for name in runtime_selected:
         report.extend(PASS_REGISTRY[name](ctx))
+    # control-plane tier: audits the event records attached to the
+    # context (or the manifest's cluster_event records)
+    for name in selected:
+        if name in EVENT_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
     # cross-run tier last: it harvests whatever the earlier tiers left on
     # the context (F006 ceiling, X006 bytes, manifest walls/health)
     for name in selected:
@@ -222,6 +236,7 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
                     donate=True, hbm_bytes_per_device=None, passes=None,
                     rng=None, trace_dir=None, manifest_records=None,
                     baseline=None, current_metrics=None,
+                    event_records=None, mttr_budget_s=None,
                     **transformer_kwargs) -> Report:
     """Statically verify a strategy before any compile.
 
@@ -251,6 +266,10 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         name under ``records/baselines``, or None to load by strategy
         id) and caller-measured current-side metrics
         (``cpu_mesh_engine_overhead`` etc.).
+      event_records / mttr_budget_s: control-plane (reaction) tier inputs
+        when ``"reaction-audit"`` is selected — the causal cluster event
+        log (``cluster_event`` records; defaults to the manifest's) and
+        the signal->action latency budget for E002.
       transformer_kwargs: forwarded to :class:`GraphTransformer`
         (``data_axes``, ``batch_spec``, ``accum_steps``, ...).
 
@@ -266,7 +285,8 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         hbm_bytes_per_device=hbm_bytes_per_device,
         transformer_kwargs=transformer_kwargs,
         trace_dir=trace_dir, manifest_records=manifest_records,
-        baseline=baseline, current_metrics=current_metrics)
+        baseline=baseline, current_metrics=current_metrics,
+        event_records=event_records, mttr_budget_s=mttr_budget_s)
     report = Report(strategy_id=getattr(strategy, "id", ""))
 
     selected = tuple(passes) if passes is not None else \
@@ -312,6 +332,12 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
     # transformer's intended channels when the trace tier built one
     for name in selected:
         if name in RUNTIME_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
+
+    # control-plane (reaction) tier: audits the causal cluster event log
+    # attached to the context (or the manifest's cluster_event records)
+    for name in selected:
+        if name in EVENT_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
 
     # cross-run (regression) tier last: it diffs whatever the earlier

@@ -69,6 +69,12 @@ class Cluster:
         # callable(addr, exit_code) -> bool, consulted by _monitor before
         # the fail-fast os._exit; runs on the monitor thread
         self.on_worker_exit = None
+        # live control plane (telemetry/stream.py): the chief-side frame
+        # collector + its advertised address, started on demand by
+        # start_collector(); workers inherit the address through the
+        # worker-env contract and push step/heartbeat/finding frames
+        self.collector = None
+        self._stream_address = None
 
     # -- identity ----------------------------------------------------------
 
@@ -129,6 +135,52 @@ class Cluster:
         raise RuntimeError(
             f"No surviving node: all of {self._rank_order()} are down")
 
+    # -- live control plane --------------------------------------------------
+
+    @property
+    def cluster_view(self):
+        """The live :class:`~autodist_tpu.telemetry.stream.ClusterView`
+        (None until :meth:`start_collector`)."""
+        return self.collector.view if self.collector is not None else None
+
+    @property
+    def stream_address(self):
+        """The collector address workers should push frames to: this
+        cluster's own collector when started, else an inherited
+        ``AUTODIST_TELEMETRY_STREAM`` ('' when streaming is off)."""
+        return self._stream_address or ENV.AUTODIST_TELEMETRY_STREAM.val
+
+    def start_collector(self, port=0, view=None):
+        """Chief only: bind the live telemetry collector and remember the
+        address to advertise to workers (port 0 = ephemeral; the bound
+        port reuses the coordinator-address plumbing — same chief host,
+        its own port).  Returns the advertised ``host:port``, or None
+        off-chief."""
+        if not self.is_chief:
+            return None
+        if self.collector is not None:
+            return self._stream_address
+        from autodist_tpu.telemetry.stream import TelemetryCollector
+
+        multi = self.num_processes > 1
+        bind_host = "0.0.0.0" if multi else "127.0.0.1"
+        self.collector = TelemetryCollector(host=bind_host, port=port,
+                                            view=view)
+        bound = self.collector.start()
+        bound_port = bound.rsplit(":", 1)[1]
+        advert_host = self._spec.chief if multi else "127.0.0.1"
+        self._stream_address = f"{advert_host}:{bound_port}"
+        logging.info("telemetry collector listening on %s (advertised %s)",
+                     bound, self._stream_address)
+        return self._stream_address
+
+    def stop_collector(self):
+        """Stop the live telemetry collector (idempotent)."""
+        if self.collector is not None:
+            self.collector.stop()
+            self.collector = None
+            self._stream_address = None
+
     def initialize(self):
         """Join the jax.distributed process group (no-op single node)."""
         import jax
@@ -187,6 +239,13 @@ class Cluster:
             run_dir = telemetry.configured_run_dir()
             if run_dir:
                 env.setdefault("AUTODIST_TELEMETRY_DIR", run_dir)
+        # live control plane: the chief's collector address (started via
+        # start_collector, or inherited) so the worker's SessionTelemetry
+        # pushes frames mid-run; launch-scoped extra_env wins
+        stream = extra_env.pop("AUTODIST_TELEMETRY_STREAM",
+                               self.stream_address)
+        if stream:
+            env.setdefault("AUTODIST_TELEMETRY_STREAM", stream)
         env.update(extra_env)
         ssh = self._spec.ssh_config(worker_address)
         if ssh is not None:
@@ -353,6 +412,7 @@ class Cluster:
         threads, self._monitor_threads = self._monitor_threads, []
         for t in threads:
             t.join(timeout=max(grace_s, 2.0))
+        self.stop_collector()
 
     def merge_telemetry(self, run_dir=None):
         """Chief-side aggregation: merge every host's

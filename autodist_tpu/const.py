@@ -28,6 +28,12 @@ DEFAULT_COORDINATOR_PORT = 15501
 # ENV.AUTODIST_ASYNC_PS_ADDR ("host:port", port 0 = ephemeral).
 DEFAULT_ASYNC_PS_PORT = 15990
 
+# Default port the chief's live telemetry collector binds
+# (telemetry/stream.py, docs/observability.md "Live control plane");
+# override per run with ENV.AUTODIST_TELEMETRY_STREAM ("host:port",
+# port 0 = ephemeral).
+DEFAULT_TELEMETRY_STREAM_PORT = 15991
+
 # Default mesh axis names.  "replica" is the data-parallel axis (the only
 # axis the reference's strategies use); the others are forward-looking axes
 # for tensor/pipeline/sequence/expert parallelism (SURVEY.md section 2.8).
@@ -86,6 +92,13 @@ class ENV(Enum):
     # launched workers so every host writes into the same run directory
     AUTODIST_TELEMETRY = (lambda v: v == "True" or v == "1",)
     AUTODIST_TELEMETRY_DIR = (lambda v: v or "",)
+    # live control plane (telemetry/stream.py, docs/observability.md):
+    # "host:port" of the chief-side collector; when set, each worker's
+    # SessionTelemetry pushes compact length-prefixed-JSON frames (steps,
+    # heartbeats, health/runtime findings) over a best-effort socket so
+    # the chief's ClusterView observes the run mid-flight.  Empty = the
+    # post-hoc file-only path (today's behavior).
+    AUTODIST_TELEMETRY_STREAM = (lambda v: v or "",)
     # cluster membership epoch (docs/elasticity.md): bumped by the chief on
     # every topology change and handed to relaunched workers through the
     # worker-env contract, so a worker joining epoch N can never apply a

@@ -23,6 +23,21 @@ SIGTERM/SIGINT preemption rides the same machinery via the runner's
 :class:`~autodist_tpu.runner.PreemptionGuard`: drain, manifest
 checkpoint, clean exit, resume (bitwise on an unchanged topology).
 
+**Live control plane** (docs/observability.md).  When telemetry is on,
+the chief-side trainer starts the stream
+:class:`~autodist_tpu.telemetry.stream.TelemetryCollector`
+(``Cluster.start_collector``), workers push compact metric frames to it,
+and :meth:`fit` polls the live
+:class:`~autodist_tpu.telemetry.stream.ClusterView` at every step
+boundary — streamed health/runtime findings feed :meth:`note_anomaly`
+and live step-skew feeds :meth:`note_straggler` MID-RUN, not post-hoc.
+Every signal and every reaction (hook firing, membership epoch, re-plan,
+checkpoint, preemption guard, chaos injection) lands in the causal
+:class:`~autodist_tpu.telemetry.events.ClusterEventLog` (mirrored to
+``events.jsonl``, schema v3) with ``cause=`` the provoking signal and
+the measured signal->action latency; :meth:`reaction_report` runs the
+E-code reaction audit over that table.
+
 **Scope.**  Within one ``jax.distributed`` process group the device set
 is fixed for the life of the processes — a live SPMD step cannot lose a
 participant.  The protocol therefore spans a *restart*: the surviving
@@ -132,6 +147,13 @@ class ElasticTrainer:
         immediately for a non-finite loss (R002 class), after
         :data:`ANOMALY_PERSISTENCE` consecutive signals for spikes.
         Mirrors ``on_straggler``: a hook, not a policy.
+      event_log: a prebuilt :class:`~autodist_tpu.telemetry.events.
+        ClusterEventLog` (default: a fresh in-memory log, mirrored to
+        ``events.jsonl`` in the first session's telemetry run dir when
+        telemetry is on).
+      mttr_budget_s: signal->action latency budget for
+        :meth:`reaction_report`'s E002 gate (default: the audit's
+        module default).
     """
 
     # consecutive T002 signals before the straggler is considered
@@ -141,11 +163,18 @@ class ElasticTrainer:
     # (a single loss spike self-heals; nonfinite always fires at once —
     # a poisoned update never heals)
     ANOMALY_PERSISTENCE = 2
+    # a worker silent on the stream this long without a membership event
+    # is a heartbeat-gap signal (the reaction audit's E004 subject)
+    HEARTBEAT_TIMEOUT_S = 10.0
+    # class-level default so a hook-logic-only trainer (tests build one
+    # via __new__) degrades to no causality recording instead of raising
+    event_log = None
 
     def __init__(self, resource_spec, strategy_builder, loss_fn, params,
                  optimizer, *, checkpoint_dir, distribute_kwargs=None,
                  verify_restore=True, chaos=None, max_replans=8,
-                 on_straggler=None, on_anomaly=None):
+                 on_straggler=None, on_anomaly=None, event_log=None,
+                 mttr_budget_s=None):
         from autodist_tpu.autodist import AutoDist
         from autodist_tpu.cluster import Cluster
 
@@ -176,6 +205,17 @@ class ElasticTrainer:
         self._anomaly_streak = {}     # check -> consecutive signals
         self.anomaly_signals = 0
         self._poison_next = False     # armed by the nan@N chaos event
+        from autodist_tpu.telemetry.events import ClusterEventLog
+
+        self.event_log = event_log if event_log is not None \
+            else ClusterEventLog()
+        self.mttr_budget_s = mttr_budget_s
+        self._pending_causes = {}     # (signal, subject) -> cause token
+        self._stale_seen = set()      # workers already flagged E004-stale
+        self._events_run_dir = None   # run dir holding the event mirror
+        self._self_worker = 0         # this process's stream worker index
+        self._collector_owned = False
+        self.last_reaction_report = None
 
     # -- membership signals -------------------------------------------------
 
@@ -199,15 +239,28 @@ class ElasticTrainer:
         telemetry.counter("elastic.straggler_signals", addr=addr)
         self._straggler_streak = {
             addr: self._straggler_streak.get(addr, 0) + 1}
-        if self._straggler_streak[addr] < self.STRAGGLER_PERSISTENCE:
+        streak = self._straggler_streak[addr]
+        cause = None
+        if self.event_log is not None:
+            cause = self.event_log.note_signal(
+                "straggler", worker=addr, step=skew.get("step"), code="T002",
+                persistent=streak >= self.STRAGGLER_PERSISTENCE,
+                skew_s=skew.get("skew_s"))
+            self._pending_causes.setdefault(("straggler", addr), cause)
+        if streak < self.STRAGGLER_PERSISTENCE:
             return False
         logging.warning(
             "ElasticTrainer: persistent straggler %s (skew %.3fs over %d "
-            "signals)%s", addr, skew.get("skew_s", 0.0),
-            self._straggler_streak[addr],
+            "signals)%s", addr, skew.get("skew_s", 0.0), streak,
             "" if self.on_straggler else " — no on_straggler hook set")
         if self.on_straggler is not None:
             self.on_straggler(dict(skew))
+            if self.event_log is not None:
+                self.event_log.record(
+                    "hook_fired", step=skew.get("step"),
+                    hook="on_straggler", worker=addr,
+                    cause=self._pending_causes.pop(("straggler", addr),
+                                                   cause))
             return True
         return False
 
@@ -231,7 +284,15 @@ class ElasticTrainer:
         telemetry.counter("elastic.anomaly_signals", check=check)
         self._anomaly_streak[check] = self._anomaly_streak.get(check, 0) + 1
         need = 1 if check == "nonfinite" else self.ANOMALY_PERSISTENCE
-        if self._anomaly_streak[check] < need:
+        streak = self._anomaly_streak[check]
+        cause = None
+        if self.event_log is not None:
+            cause = self.event_log.note_signal(
+                "anomaly", worker=finding.get("worker"),
+                step=finding.get("step"), code=check,
+                persistent=streak >= need)
+            self._pending_causes.setdefault(("anomaly", check), cause)
+        if streak < need:
             return False
         logging.warning(
             "ElasticTrainer: health anomaly %s at step %s (%s)%s",
@@ -239,6 +300,12 @@ class ElasticTrainer:
             "" if self.on_anomaly else " — no on_anomaly hook set")
         if self.on_anomaly is not None:
             self.on_anomaly(dict(finding))
+            if self.event_log is not None:
+                self.event_log.record(
+                    "hook_fired", step=finding.get("step"),
+                    hook="on_anomaly", check=check,
+                    cause=self._pending_causes.pop(("anomaly", check),
+                                                   cause))
             return True
         return False
 
@@ -247,6 +314,9 @@ class ElasticTrainer:
         the step-boundary handler; True = claimed, no fail-fast."""
         logging.warning("ElasticTrainer: worker %s exited with %d; "
                         "queueing membership change", addr, code)
+        cause = self.event_log.note_signal(
+            "worker_exit", worker=addr, code=str(code), persistent=True)
+        self._pending_causes.setdefault(("worker_exit", addr), cause)
         self._lost.append(addr)
         return True
 
@@ -274,11 +344,21 @@ class ElasticTrainer:
             telemetry.counter("elastic.chaos_events", kind=ev.kind,
                               step=step)
             logging.warning("Chaos injection at step %d: %r", step, ev)
+            cause = self.event_log.note_signal(
+                "chaos", step=step, code=ev.kind,
+                worker=ev.arg if ev.kind == "kill_worker" else None)
+            self.event_log.record("chaos_injection", step=step,
+                                  chaos_kind=ev.kind, arg=ev.arg,
+                                  cause=cause)
             if ev.kind == "kill_worker":
                 if ev.arg:
+                    self._pending_causes.setdefault(
+                        ("worker_exit", ev.arg), cause)
                     self._lost.append(ev.arg)
                 else:
                     addr, keep = self._default_kill_target()
+                    self._pending_causes.setdefault(
+                        ("worker_exit", addr), cause)
                     if keep is None:
                         self._lost.append(addr)
                     else:
@@ -286,6 +366,7 @@ class ElasticTrainer:
             elif ev.kind == "delay":
                 time.sleep(float(ev.arg or 0.1))
             elif ev.kind == "preempt":
+                self._pending_causes.setdefault(("preempt", None), cause)
                 import signal
 
                 os.kill(os.getpid(), signal.SIGTERM)
@@ -298,7 +379,140 @@ class ElasticTrainer:
         loss_fn, params, optimizer = self._model
         self.session = self._ad.distribute(loss_fn, params, optimizer,
                                            **self._kwargs)
+        self._connect_live(self.session)
         return self.session
+
+    # -- live control plane -------------------------------------------------
+
+    def _connect_live(self, sess):
+        """Wire the live control plane around a freshly built session:
+        mirror the event log to ``events.jsonl`` in the session's run
+        dir (first session only — one causal log per run), start the
+        chief-side stream collector (telemetry-on only), and point the
+        session's publisher at it so this process's frames reach the
+        :class:`~autodist_tpu.telemetry.stream.ClusterView` too.  All of
+        it best-effort: a dead/unbindable collector degrades to the
+        file-only telemetry path with a counted warning."""
+        from autodist_tpu import telemetry as _tel
+
+        tel = getattr(sess, "_telemetry", None)
+        if tel is None:
+            return
+        self._self_worker = tel.worker
+        if not self.event_log.mirrored:
+            from autodist_tpu.telemetry.events import EVENTS_NAME
+            from autodist_tpu.telemetry.metrics import JsonlWriter
+
+            # replay=True: events recorded before the first session
+            # existed (worker deaths during launch, chaos at step 0)
+            # must not be missing from the on-disk mirror
+            self.event_log.attach_writer(
+                JsonlWriter(os.path.join(tel.run_dir, EVENTS_NAME),
+                            worker=tel.worker), replay=True)
+            self._events_run_dir = tel.run_dir
+        if not _tel.enabled() or not self.cluster.is_chief:
+            return
+        if self.cluster.collector is None:
+            addr = self.cluster.start_collector()
+            if addr:
+                self._collector_owned = True
+                self.event_log.record("collector_start", address=addr)
+        if tel.stream is None and self.cluster.stream_address:
+            from autodist_tpu.telemetry.stream import StreamPublisher
+
+            try:
+                tel.stream = StreamPublisher(
+                    self.cluster.stream_address, worker=tel.worker,
+                    addr=self._ad.resource_spec.chief)
+            except (ValueError, OSError) as e:
+                logging.warning(
+                    "ElasticTrainer: could not attach stream publisher "
+                    "(%s); continuing file-only", e)
+
+    def _poll_live(self, step):
+        """Step-boundary poll of the live ClusterView: streamed findings
+        from REMOTE workers feed :meth:`note_anomaly` (the chief's own
+        session is already judged trainer-side), live cross-worker
+        step-skew feeds :meth:`note_straggler`, and stream-silent
+        workers raise ``heartbeat_gap`` signals — all mid-run, without
+        waiting for the post-hoc manifest merge."""
+        view = self.cluster.cluster_view
+        if view is None:
+            return
+        for fr in view.pop_findings():
+            w = fr.get("w")
+            if w == self._self_worker:
+                continue
+            worker = view.worker_address(w) or f"worker_{w}"
+            self.note_anomaly({
+                "check": fr.get("check") or fr.get("code"),
+                "step": fr.get("step"), "value": fr.get("value"),
+                "worker": worker,
+                "message": fr.get("message")
+                or f"streamed {fr.get('kind')} from {worker}"})
+        skew = view.step_skew()
+        if skew is not None:
+            skew = dict(skew, step=step)
+        if (skew or {}).get("straggler_addr"):
+            self.note_straggler(skew)
+        elif skew is not None:
+            # workers measurably steady again: a straggler streak must
+            # not survive recovery
+            self.note_straggler(None)
+        for w in sorted(view.stale_workers(self.HEARTBEAT_TIMEOUT_S)):
+            if w in self._stale_seen:
+                continue
+            self._stale_seen.add(w)
+            worker = view.worker_address(w) or f"worker_{w}"
+            logging.warning(
+                "ElasticTrainer: no stream frames from %s for >%.0fs",
+                worker, self.HEARTBEAT_TIMEOUT_S)
+            self.event_log.note_signal("heartbeat_gap", worker=worker,
+                                       step=step, persistent=True)
+
+    def _finalize_live(self):
+        """Close the control plane at the end of :meth:`fit`: stop a
+        collector this trainer started, close the event-log mirror, and
+        run the E-code reaction audit over the run's causal table."""
+        if self._collector_owned and self.cluster.collector is not None:
+            c = self.cluster.collector
+            self.event_log.record("collector_stop", frames=c.frames,
+                                  connections=c.connections)
+            self.cluster.stop_collector()
+            self._collector_owned = False
+        self.event_log.close()
+        if self._events_run_dir:
+            # the session merged its manifest before the collector-stop /
+            # heartbeat-tail events landed; re-merge so the final
+            # manifest.jsonl carries the complete causal table
+            try:
+                from autodist_tpu.telemetry.aggregate import \
+                    merge_worker_manifests
+                merge_worker_manifests(self._events_run_dir)
+            except (OSError, ValueError) as e:
+                logging.warning(
+                    "ElasticTrainer: final event merge failed: %s", e)
+        try:
+            self.last_reaction_report = self.reaction_report()
+        except Exception as e:  # pragma: no cover - audit never kills fit
+            logging.warning("ElasticTrainer: reaction audit failed: %s", e)
+
+    def reaction_report(self, *, mttr_budget_s=None):
+        """The ElasticTrainer export of the CONTROL-PLANE tier: run the
+        E-code reaction audit (:mod:`autodist_tpu.analysis.
+        reaction_audit`) over this run's causal event log and return the
+        ranked :class:`~autodist_tpu.analysis.report.Report`."""
+        from autodist_tpu.analysis.reaction_audit import (MTTR_BUDGET_S,
+                                                          reaction_audit)
+        from autodist_tpu.analysis.report import Report
+
+        budget = mttr_budget_s if mttr_budget_s is not None \
+            else self.mttr_budget_s
+        findings = reaction_audit(
+            self.event_log.to_records(),
+            mttr_budget_s=MTTR_BUDGET_S if budget is None else budget)
+        return Report(strategy_id="elastic-control-plane",
+                      findings=findings)
 
     def _restore(self, batch=None):
         """Manifest-aware restore into the current session: direct when
@@ -327,6 +541,10 @@ class ElasticTrainer:
         lost = list(dict.fromkeys(self._lost))
         self._lost = []
         keep_chips, self._keep_chips = self._keep_chips, None
+        cause = None
+        for a in list(lost) + sorted(keep_chips or ()):
+            cause = self._pending_causes.pop(("worker_exit", a), None) \
+                or cause
         if self.replans + 1 > self._max_replans:
             raise RuntimeError(
                 f"ElasticTrainer: {self.replans + 1} topology changes "
@@ -337,11 +555,17 @@ class ElasticTrainer:
         jax.block_until_ready(self.session.state)
         # 2. preemption-safe manifest checkpoint of the OLD epoch
         Saver(self.session).save_sharded(self._ckpt, epoch=self.epoch)
+        self.event_log.record("checkpoint_save",
+                              step=int(self.session.step),
+                              epoch=self.epoch, cause=cause)
         # 3. survivors-only spec; deterministic chief failover inside
         old_spec = self._ad.resource_spec
         new_spec = old_spec.shrink(drop_addresses=lost,
                                    keep_chips=keep_chips)
         self.epoch = self.cluster.advance_epoch()
+        self.event_log.record("membership_epoch", epoch=self.epoch,
+                              lost=lost or sorted(keep_chips or ()),
+                              cause=cause)
         logging.warning(
             "Membership epoch %d: lost %s; surviving topology %r",
             self.epoch, lost or list(keep_chips or ()), new_spec)
@@ -363,6 +587,9 @@ class ElasticTrainer:
         #    (Y-codes + X-audit) before the new epoch's first step
         probe = batch_fn(int(sess.step)) if batch_fn is not None else None
         self._restore(probe)
+        self.event_log.record("replan", step=int(sess.step),
+                              epoch=self.epoch, replans=self.replans,
+                              cause=cause)
         logging.info(
             "Epoch %d resumed at step %d on R=%d after re-plan #%d",
             self.epoch, sess.step, sess._t.num_replicas, self.replans)
@@ -394,6 +621,11 @@ class ElasticTrainer:
                     from autodist_tpu.checkpoint.saver import Saver
 
                     Saver(sess).save_sharded(self._ckpt, epoch=self.epoch)
+                    self.event_log.record(
+                        "preemption_guard", step=int(sess.step),
+                        epoch=self.epoch,
+                        cause=self._pending_causes.pop(("preempt", None),
+                                                       None))
                     logging.warning(
                         "ElasticTrainer: preempted at step %d; manifest "
                         "checkpoint written, exiting cleanly", sess.step)
@@ -420,8 +652,12 @@ class ElasticTrainer:
                 if loss_f is not None:
                     for hf in self._health.observe(step, loss=loss_f):
                         self.note_anomaly(hf)
+                # live control plane: streamed remote findings and live
+                # step-skew act on THIS step boundary, not post-hoc
+                self._poll_live(int(sess.step))
                 if log_every and sess.step % log_every == 0:
                     logging.info("epoch %d step %d: %s", self.epoch,
                                  sess.step, sess._metrics_log_str(metrics))
         sess.finalize_telemetry()
+        self._finalize_live()
         return sess

@@ -18,6 +18,12 @@ The observability layer of the stack (``docs/observability.md``):
   baselines (``records/baselines``, the regression audit's memory);
 - :mod:`~autodist_tpu.telemetry.aggregate` — chief-side merge of
   per-worker manifests;
+- :mod:`~autodist_tpu.telemetry.stream` — the LIVE control plane
+  (``make monitor-check``): worker->chief metric frames over a
+  length-prefixed-JSON socket, the chief's :class:`ClusterView`;
+- :mod:`~autodist_tpu.telemetry.events` — the causal cluster event log
+  (schema v3 ``cluster_event`` records: signals, actions, cause,
+  signal->action latency — the E-code reaction audit's input);
 - :mod:`~autodist_tpu.telemetry.schema` — the JSONL schema + validator
   (``make telemetry-check``).
 
@@ -36,11 +42,15 @@ import time
 from autodist_tpu.telemetry.aggregate import (load_manifest,
                                               load_manifest_with_stats,
                                               merge_worker_manifests)
+from autodist_tpu.telemetry.events import ClusterEventLog, load_events
 from autodist_tpu.telemetry.health import HealthMonitor
 from autodist_tpu.telemetry.metrics import (JsonlWriter, MetricsRegistry,
                                             percentiles)
 from autodist_tpu.telemetry.schema import validate_manifest
 from autodist_tpu.telemetry.spans import SpanRecorder, dump_chrome_trace
+from autodist_tpu.telemetry.stream import (ClusterView, StreamPublisher,
+                                           TelemetryCollector,
+                                           stream_address_from_env)
 from autodist_tpu.telemetry.watchdog import SlowStepWatchdog
 
 __all__ = [
@@ -50,6 +60,8 @@ __all__ = [
     "SessionTelemetry", "dump_chrome_trace", "percentiles",
     "validate_manifest", "merge_worker_manifests", "load_manifest",
     "load_manifest_with_stats", "HealthMonitor",
+    "ClusterView", "StreamPublisher", "TelemetryCollector",
+    "stream_address_from_env", "ClusterEventLog", "load_events",
 ]
 
 _STATE = {

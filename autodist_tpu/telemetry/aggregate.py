@@ -31,6 +31,7 @@ import os
 
 MANIFEST_NAME = "manifest.jsonl"
 WORKER_GLOB = "worker_*.jsonl"
+EVENTS_NAME = "events.jsonl"  # chief's cluster-event log (telemetry.events)
 
 
 def _count(name, value=1.0):
@@ -45,6 +46,28 @@ def _count(name, value=1.0):
 
 def worker_manifest_paths(run_dir):
     return sorted(glob.glob(os.path.join(run_dir, WORKER_GLOB)))
+
+
+def _rotated_paths(base):
+    """Rotated segments of ``base`` (``<base>.1`` newest), oldest first —
+    the read-back order for a size-capped :class:`~.metrics.JsonlWriter`."""
+    segs = []
+    for p in glob.glob(base + ".*"):
+        suffix = p[len(base) + 1:]
+        if suffix.isdigit():
+            segs.append((int(suffix), p))
+    return [p for _, p in sorted(segs, reverse=True)]
+
+
+def _segment_paths(run_dir):
+    """``[(base, [segment paths, oldest first incl. base])]`` for every
+    worker file and the chief's events log under ``run_dir``."""
+    bases = worker_manifest_paths(run_dir)
+    events = os.path.join(run_dir, EVENTS_NAME)
+    if os.path.exists(events) or _rotated_paths(events):
+        bases.append(events)
+    return [(b, _rotated_paths(b) + ([b] if os.path.exists(b) else []))
+            for b in bases]
 
 
 def _parse_lines(path):
@@ -103,15 +126,21 @@ def estimate_clock_offsets(per_worker):
 
 
 def merge_records(run_dir):
-    """All worker records under ``run_dir``, clock-offset corrected,
-    time-ordered, step-deduplicated.  Returns ``(records, stats)`` with
-    ``stats = {skipped_lines, skipped_duplicates, clock_offsets_s}``;
-    never raises."""
+    """All worker records under ``run_dir`` — rotated segments read back
+    oldest-first, the chief's ``events.jsonl`` included — clock-offset
+    corrected, time-ordered, step-deduplicated.  Returns ``(records,
+    stats)`` with ``stats = {skipped_lines, skipped_duplicates,
+    rotated_files, clock_offsets_s}``; never raises."""
     per_worker = {}
     skipped_lines = 0
-    for i, p in enumerate(worker_manifest_paths(run_dir)):
-        recs, skipped = _parse_lines(p)
-        skipped_lines += skipped
+    rotated_files = 0
+    for i, (base, segments) in enumerate(_segment_paths(run_dir)):
+        rotated_files += max(0, len(segments) - 1)
+        recs = []
+        for p in segments:
+            seg_recs, skipped = _parse_lines(p)
+            skipped_lines += skipped
+            recs.extend(seg_recs)
         # the filename rank is authoritative for grouping; records carry
         # their own "w" for rendering
         rank = recs[0].get("w", i) if recs else i
@@ -139,16 +168,19 @@ def merge_records(run_dir):
         _count("aggregate.skipped_lines", skipped_lines)
     if dups:
         _count("aggregate.skipped_duplicates", dups)
+    if rotated_files:
+        _count("aggregate.rotated_files", rotated_files)
     stats = {"skipped_lines": skipped_lines, "skipped_duplicates": dups,
-             "clock_offsets_s": offsets}
+             "rotated_files": rotated_files, "clock_offsets_s": offsets}
     return records, stats
 
 
 def merge_worker_manifests(run_dir, out_path=None):
-    """Merge every ``worker_*.jsonl`` under ``run_dir`` into one
-    time-ordered ``manifest.jsonl``; returns the manifest path (or None
-    when there is nothing to merge)."""
-    if not worker_manifest_paths(run_dir):
+    """Merge every ``worker_*.jsonl`` (rotated segments included) plus
+    the chief's ``events.jsonl`` under ``run_dir`` into one time-ordered
+    ``manifest.jsonl``; returns the manifest path (or None when there is
+    nothing to merge)."""
+    if not any(segs for _, segs in _segment_paths(run_dir)):
         return None
     records, _ = merge_records(run_dir)
     out_path = out_path or os.path.join(run_dir, MANIFEST_NAME)
@@ -174,10 +206,11 @@ def load_manifest_with_stats(path):
         if os.path.exists(merged):
             records, skipped = _parse_lines(merged)
             return records, {"skipped_lines": skipped,
-                             "skipped_duplicates": 0}
+                             "skipped_duplicates": 0, "rotated_files": 0}
         return merge_records(path)
     records, skipped = _parse_lines(path)
-    return records, {"skipped_lines": skipped, "skipped_duplicates": 0}
+    return records, {"skipped_lines": skipped, "skipped_duplicates": 0,
+                     "rotated_files": 0}
 
 
 def load_manifest(path):

@@ -1,0 +1,159 @@
+"""Causal cluster event log: what happened, WHY, and how fast.
+
+Schema v3's ``cluster_event`` manifest kind (docs/observability.md "Live
+control plane").  The elastic control loop records two species of event:
+
+- **signals** — observations that should provoke a reaction: a straggler
+  named by the live :class:`~autodist_tpu.telemetry.stream.ClusterView`,
+  a health/runtime finding, a heartbeat gap, a worker death;
+- **actions** — what the control plane did about it: membership epoch
+  bumps, re-plans, checkpoint saves, preemption guards, chaos
+  injections, user hook firings.
+
+Every action carries ``cause=`` the signal that triggered it (worker
+address, step, finding code, signal timestamp) plus the measured
+signal->action ``latency_s``.  The reaction audit
+(:mod:`autodist_tpu.analysis.reaction_audit`, E-codes) consumes exactly
+this table: a persistent signal with no caused action is E001, a caused
+action past the MTTR budget is E002.
+
+The log is in-memory first (the trainer polls it) and optionally
+line-flushed to ``events.jsonl`` in the telemetry run dir through the
+rotating :class:`~autodist_tpu.telemetry.metrics.JsonlWriter`, so
+``tools/telemetry_report.py --follow`` and ``tools/monitor.py`` can tail
+it during the run; ``aggregate.merge_records`` folds it into the merged
+manifest.
+"""
+import time
+from collections import deque
+
+EVENTS_NAME = "events.jsonl"
+
+# Action kinds the control plane records (signals all share kind
+# "signal" with a ``signal=`` discriminator).
+ACTION_KINDS = ("membership_epoch", "replan", "checkpoint_save",
+                "preemption_guard", "chaos_injection", "hook_fired",
+                "collector_start", "collector_stop")
+
+SIGNAL_KINDS = ("straggler", "anomaly", "heartbeat_gap", "worker_exit",
+                "chaos")
+
+
+def make_cause(signal, *, worker=None, step=None, code=None, t=None):
+    """A cause token: the signal identity an action will point back to."""
+    return {"signal": signal, "worker": worker, "step": step,
+            "code": code, "t": time.time() if t is None else t}
+
+
+class ClusterEventLog:
+    """Append-only causal event log, optionally mirrored to JSONL.
+
+    Bounded (``maxlen``) so a pathological signal storm cannot grow the
+    chief's memory without bound; the JSONL mirror keeps the full record
+    on disk (size-capped by the writer's own rotation).
+    """
+
+    def __init__(self, writer=None, maxlen=4096):
+        self._events = deque(maxlen=maxlen)
+        self._writer = writer
+        self.dropped = 0
+
+    @property
+    def mirrored(self):
+        """True when the log is being mirrored to a JSONL writer."""
+        return self._writer is not None
+
+    def attach_writer(self, writer, replay=False):
+        """Mirror every subsequent event to ``writer``; with ``replay``,
+        first flush the events already held in memory so a writer
+        attached after recording started still captures the full log."""
+        self._writer = writer
+        if replay:
+            for rec in self._events:
+                try:
+                    writer.write(dict(rec))
+                except OSError:  # pragma: no cover - disk full etc.
+                    pass
+        return writer
+
+    # -- recording --------------------------------------------------------
+    def note_signal(self, signal, *, worker=None, step=None, code=None,
+                    persistent=False, **fields):
+        """Record a signal event; returns its cause token for the action."""
+        cause = make_cause(signal, worker=worker, step=step, code=code)
+        rec = {"kind": "cluster_event", "event": "signal",
+               "signal": signal, "worker": worker, "step": step,
+               "code": code, "persistent": bool(persistent),
+               "t": cause["t"]}
+        rec.update(fields)
+        self._append(rec)
+        return cause
+
+    def record(self, event, *, step=None, cause=None, latency_s=None,
+               **fields):
+        """Record an action event, measuring signal->action latency.
+
+        ``cause`` is a token from :meth:`note_signal` /
+        :func:`make_cause`; when it carries the signal timestamp and
+        ``latency_s`` is not given, the latency is measured here.
+        """
+        now = time.time()
+        rec = {"kind": "cluster_event", "event": event, "step": step,
+               "t": now}
+        if cause is not None:
+            rec["cause"] = dict(cause)
+            if latency_s is None and isinstance(cause.get("t"), (int, float)):
+                latency_s = now - cause["t"]
+        if latency_s is not None:
+            rec["latency_s"] = float(latency_s)
+        rec.update(fields)
+        self._append(rec)
+        return rec
+
+    def _append(self, rec):
+        if len(self._events) == self._events.maxlen:
+            self.dropped += 1
+        self._events.append(rec)
+        if self._writer is not None:
+            try:
+                self._writer.write(dict(rec))
+            except OSError:  # pragma: no cover - disk full etc.
+                pass
+
+    # -- read side --------------------------------------------------------
+    @property
+    def events(self):
+        return list(self._events)
+
+    def to_records(self):
+        """Manifest-shaped copies (the writer adds w/pid when mirrored)."""
+        return [dict(r) for r in self._events]
+
+    def signals(self):
+        return [r for r in self._events if r.get("event") == "signal"]
+
+    def actions(self):
+        return [r for r in self._events if r.get("event") != "signal"]
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+def load_events(path):
+    """Read an events JSONL file -> list of records (skip bad lines)."""
+    import json
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out

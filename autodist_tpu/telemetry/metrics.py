@@ -175,30 +175,73 @@ def _json_default(o):
     return str(o)
 
 
+# Size-capped rotation defaults for JsonlWriter: a million-step run must
+# not grow the per-worker file without bound (the JSONL analog of the
+# registry's bounded ring).  The active file rotates to ``<path>.1``
+# (``.1`` newest, ``.N`` oldest) once it would exceed DEFAULT_MAX_BYTES;
+# at most DEFAULT_MAX_SEGMENTS rotated segments are kept, the oldest is
+# dropped-and-counted.  ``aggregate.merge_records`` reads the segments
+# back oldest-first and counts them in its merge stats.
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+DEFAULT_MAX_SEGMENTS = 4
+
+
 class JsonlWriter:
-    """Append-only, line-flushed JSONL file — per-step records persist as
-    they happen, so a crashed run still leaves a readable manifest prefix.
+    """Append-only, line-flushed, size-capped JSONL file — per-step
+    records persist as they happen, so a crashed run still leaves a
+    readable manifest prefix, and rotation keeps long runs bounded.
 
     Every record is annotated with this writer's ``worker`` rank and
     ``pid`` (if not already present) so the chief's cross-worker merge
     can attribute lines after concatenation.
     """
 
-    def __init__(self, path, worker=0):
+    def __init__(self, path, worker=0, max_bytes=DEFAULT_MAX_BYTES,
+                 max_segments=DEFAULT_MAX_SEGMENTS):
         self.path = os.path.abspath(path)
         self.worker = int(worker)
+        self.max_bytes = int(max_bytes) if max_bytes else 0  # 0 = unbounded
+        self.max_segments = int(max_segments)
+        self.rotations = 0
+        self.dropped_segments = 0
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         self._f = open(self.path, "a")
+        self._size = os.path.getsize(self.path)
         self._lock = threading.Lock()
 
     def write(self, rec):
         rec = dict(rec)
         rec.setdefault("w", self.worker)
         rec.setdefault("pid", os.getpid())
-        line = json.dumps(rec, default=_json_default)
+        line = json.dumps(rec, default=_json_default) + "\n"
         with self._lock:
-            self._f.write(line + "\n")
+            if (self.max_bytes and self._size
+                    and self._size + len(line) > self.max_bytes):
+                self._rotate()
+            self._f.write(line)
             self._f.flush()
+            self._size += len(line)
+
+    def _rotate(self):
+        """Shift ``path.(k)`` -> ``path.(k+1)``, active -> ``path.1``."""
+        self._f.close()
+        oldest = f"{self.path}.{self.max_segments}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+            self.dropped_segments += 1
+        for k in range(self.max_segments - 1, 0, -1):
+            src = f"{self.path}.{k}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{k + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a")
+        self._size = 0
+        self.rotations += 1
+        try:  # facade counter, lazily — metrics must import standalone
+            from autodist_tpu import telemetry as _tel
+            _tel.counter("telemetry.rotations")
+        except Exception:
+            pass
 
     def close(self):
         with self._lock:

@@ -9,8 +9,9 @@ One JSON object per line.  Every record carries:
 
 The ``meta`` header stamps :data:`SCHEMA_VERSION` as ``schema`` (v2
 introduced the ``health_finding`` kind and the summary's ``health``
-block; v1 manifests carry no stamp and still validate — unknown kinds
-were always tolerated).
+block; v3 the ``cluster_event`` kind — the causal control-plane log of
+:mod:`~autodist_tpu.telemetry.events`; v1 manifests carry no stamp and
+still validate — unknown kinds were always tolerated).
 
 Kinds and their required fields (``docs/observability.md`` is the prose
 version; ``make telemetry-check`` asserts a live run validates):
@@ -35,13 +36,22 @@ version; ``make telemetry-check`` asserts a live run validates):
                   ``check`` (nonfinite / loss_spike / grad_norm_spike /
                   step_time_drift); optional ``value``, ``severity``,
                   ``message``
+- ``cluster_event`` — causal control-plane event
+                  (:mod:`~autodist_tpu.telemetry.events`): ``event``
+                  (``signal`` or an action: ``membership_epoch`` /
+                  ``replan`` / ``checkpoint_save`` / ``preemption_guard``
+                  / ``chaos_injection`` / ``hook_fired`` / ...);
+                  signals add ``signal``, ``worker``, ``step``, ``code``,
+                  ``persistent``; actions optionally add ``cause`` (the
+                  triggering signal's worker/step/code/t) and the
+                  measured signal->action ``latency_s``
 - ``summary``   — run trailer: ``steps``, ``step_time_p50_s``;
                   optional ``mfu_p50``, ``compile_s``,
                   ``runtime_record``, ``aggregates``, ``health``
 """
 import json
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 REQUIRED_COMMON = ("kind",)
 
@@ -55,6 +65,7 @@ REQUIRED_BY_KIND = {
     "hist": ("name", "value"),
     "watchdog": ("step", "trace_dir"),
     "health_finding": ("step", "check"),
+    "cluster_event": ("event",),
     "summary": ("steps", "step_time_p50_s"),
 }
 
@@ -64,6 +75,7 @@ NUMERIC_FIELDS = {
     "summary": ("steps", "step_time_p50_s", "mfu_p50", "compile_s"),
     "span": ("ts", "dur"),
     "health_finding": ("step",),
+    "cluster_event": ("latency_s",),
 }
 
 

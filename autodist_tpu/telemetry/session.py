@@ -42,11 +42,13 @@ from autodist_tpu.utils import logging
 class SessionTelemetry:
     def __init__(self, transformer, *, run_dir=None, run_id=None,
                  registry=None, mem_every=5, watchdog=None, mem_fn=None,
-                 worker=None):
+                 worker=None, stream=None):
         from autodist_tpu import telemetry
         from autodist_tpu.const import ENV
         from autodist_tpu.telemetry.metrics import JsonlWriter
         from autodist_tpu.telemetry.spans import SpanRecorder
+        from autodist_tpu.telemetry.stream import (StreamPublisher,
+                                                   stream_address_from_env)
         from autodist_tpu.telemetry.watchdog import SlowStepWatchdog
 
         self._t = transformer
@@ -61,6 +63,23 @@ class SessionTelemetry:
         self._writer = JsonlWriter(
             os.path.join(self.run_dir, f"worker_{self.worker}.jsonl"),
             worker=self.worker)
+        # live control plane (docs/observability.md): push compact frames
+        # to the chief's collector when one is configured.  Best-effort
+        # only — a dead collector degrades to the file-only path above.
+        self.stream = None
+        stream_addr = stream if stream is not None \
+            else stream_address_from_env()
+        if isinstance(stream_addr, StreamPublisher):
+            self.stream = stream_addr
+        elif stream_addr:
+            try:
+                self.stream = StreamPublisher(
+                    stream_addr, worker=self.worker,
+                    addr=ENV.AUTODIST_WORKER.val or None)
+            except (ValueError, OSError) as e:
+                logging.warning("telemetry: bad stream address %r (%s); "
+                                "falling back to file-only telemetry",
+                                stream_addr, e)
         self._mem_every = max(1, int(mem_every))
         self._mem_fn = mem_fn
         if watchdog is None:
@@ -118,6 +137,9 @@ class SessionTelemetry:
                                     hier["ici_hop_bytes"])
                 self.registry.gauge("sync.dcn_hop_bytes",
                                     hier["dcn_hop_bytes"])
+                for g in ("ici_hop_bytes", "dcn_hop_bytes"):
+                    self._publish({"kind": "gauge", "name": f"sync.{g}",
+                                   "value": hier[g]})
         # ZeRO sharded weight update: whether the session runs it, plus
         # the per-chip shard volume and the fresh-param gather bytes that
         # replaced the gradient all-gather (docs/performance.md "Sharded
@@ -158,6 +180,12 @@ class SessionTelemetry:
 
     def span(self, name, **args):
         return self.spans.span(name, **args)
+
+    def _publish(self, frame):
+        """Push one frame to the live collector (non-blocking no-op when
+        streaming is off or the collector died)."""
+        if self.stream is not None:
+            self.stream.publish(frame)
 
     # -- per-step hooks (called by DistributedSession.run) -----------------
 
@@ -260,6 +288,13 @@ class SessionTelemetry:
         else:
             self._walls.append(cancelled)
         self._writer.write(rec)
+        frame = {"kind": "step", "step": step, "wall_s": eff}
+        if loss_val is not None:
+            try:
+                frame["loss"] = float(loss_val)
+            except (TypeError, ValueError):
+                pass
+        self._publish(frame)
         self.registry.histogram("session.step_wall_s", wall)
         if self.health is not None:
             grad_norm = None
@@ -275,6 +310,7 @@ class SessionTelemetry:
             for hf in health_findings:
                 self._writer.write({"kind": "health_finding",
                                     "t": time.time(), **hf})
+                self._publish({"kind": "health_finding", **hf})
                 self.registry.counter(f"health.{hf['check']}")
                 logging.warning("telemetry health: %s", hf["message"])
             if health_findings:
@@ -305,6 +341,7 @@ class SessionTelemetry:
                 self.watchdog.capture_finished()
         if step == 0 or (step + 1) % self._mem_every == 0:
             self._memory_snapshot(step)
+            self._publish({"kind": "heartbeat", "step": step})
         return rec
 
     def _analyze_capture(self, step, trace_dir):
@@ -331,6 +368,9 @@ class SessionTelemetry:
                 rec = {"kind": "runtime_finding", "t": time.time(),
                        "step": step, "code": f.code,
                        "severity": str(f.severity), "message": f.message}
+                self._publish({"kind": "runtime_finding", "step": step,
+                               "code": f.code,
+                               "severity": str(f.severity)})
                 if f.code == "T006" and f.data:
                     rec["data"] = f.data
                     for hop, key in (("ici", "sync.measured_ici_bw"),
@@ -414,6 +454,12 @@ class SessionTelemetry:
                              f"host_spans_worker_{self.worker}.trace.json"))
         if self.health is not None:
             summary["health"] = self.health.summary()
+        if self.stream is not None:
+            st = self.stream.stats()
+            summary["stream"] = st
+            self.registry.gauge("stream.sent", st["sent"])
+            self.registry.gauge("stream.dropped", st["dropped"])
+            self.stream.close()
         summary["aggregates"] = self.registry.aggregates()
         self._writer.write(summary)
         manifest = None

@@ -1,0 +1,457 @@
+"""Live telemetry stream: worker -> chief metric frames over a socket.
+
+The post-hoc pipeline (per-worker JSONL merged by ``Cluster.merge_telemetry``
+at finalize) only lets the chief analyze a run after it ended.  This module
+is the in-run observation plane (docs/observability.md "Live control
+plane"): each worker pushes compact periodic *frames* — step walls,
+heartbeats, health/runtime findings, sync hop gauges — to a chief-side
+collector, and the chief maintains a live :class:`ClusterView` that feeds
+``ElasticTrainer.note_straggler`` / ``note_anomaly`` mid-run.
+
+Wire format (stdlib-only, deliberately boring): one frame is a 4-byte
+big-endian unsigned length prefix followed by that many bytes of UTF-8
+JSON (one object).  Frames larger than :data:`MAX_FRAME_BYTES` are
+rejected at both ends.  Frame kinds mirror the manifest schema where one
+exists (``step``, ``health_finding``, ``runtime_finding``, ``gauge``)
+plus two stream-only kinds: ``hello`` (worker rank/address/pid handshake)
+and ``heartbeat``.
+
+Delivery is best-effort by contract:
+
+- the worker-side :class:`StreamPublisher` never blocks the training hot
+  path — frames go through a bounded queue and are dropped-and-counted on
+  backpressure (``stream.dropped_frames``);
+- a dead/unreachable collector degrades to the file-only path: the
+  publisher logs one counted warning (``stream.connect_failures``) and
+  every subsequent frame is dropped-and-counted, never raised.
+
+The chief side (:class:`TelemetryCollector`) accepts any number of worker
+connections and folds frames into a thread-safe :class:`ClusterView`
+(per-worker last-seen step, recent step walls, heartbeat age, pending
+health/runtime findings).  ``ClusterView.step_skew`` applies the same
+T002 straggler contract as the post-hoc timeline
+(:func:`autodist_tpu.telemetry.timeline.step_skew`).
+"""
+import json
+import logging
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from collections import deque
+
+from ..const import ENV
+
+logger = logging.getLogger(__name__)
+
+# Hard cap on one frame's JSON payload; a frame this size is a bug, not a
+# metric, so both ends drop-and-count rather than buffer it.
+MAX_FRAME_BYTES = 1 << 20
+
+_LEN = struct.Struct(">I")
+
+# Frame kinds the collector folds into the ClusterView.  Unknown kinds are
+# tolerated (counted, then handed to on_frame) for forward compatibility,
+# mirroring the manifest schema's unknown-kind policy.
+FRAME_KINDS = ("hello", "step", "heartbeat", "health_finding",
+               "runtime_finding", "gauge")
+
+# How many recent step walls the view keeps per worker; enough for a
+# median that reacts within a few steps of an injected delay without
+# being flipped by one jittery step.
+_RECENT_WALLS = 8
+# Minimum recent walls before a worker participates in skew detection.
+_MIN_SKEW_STEPS = 3
+
+
+def _bump(name, value=1):
+    """Best-effort facade counter (no-op when telemetry is disabled)."""
+    try:  # local import: the facade lazily imports this module back
+        from . import counter
+        counter(name, value)
+    except Exception:  # pragma: no cover - never let accounting raise
+        pass
+
+
+def encode_frame(obj):
+    """``dict`` -> length-prefixed JSON bytes (raises on oversized)."""
+    payload = json.dumps(obj, separators=(",", ":"), default=str).encode()
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame too large: {len(payload)} bytes")
+    return _LEN.pack(len(payload)) + payload
+
+
+def _recv_exact(sock, n):
+    """Read exactly ``n`` bytes or return ``None`` on EOF."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frames(sock):
+    """Yield decoded frames from ``sock`` until EOF / error.
+
+    Malformed frames (oversized length, bad JSON) terminate the stream —
+    the framing is broken at that point, there is nothing to resync on.
+    """
+    while True:
+        header = _recv_exact(sock, _LEN.size)
+        if header is None:
+            return
+        (length,) = _LEN.unpack(header)
+        if length > MAX_FRAME_BYTES:
+            raise ValueError(f"frame length {length} exceeds cap")
+        payload = _recv_exact(sock, length)
+        if payload is None:
+            return
+        yield json.loads(payload.decode())
+
+
+def stream_address_from_env():
+    """The collector ``host:port`` handed down by the chief ('' = off)."""
+    return ENV.AUTODIST_TELEMETRY_STREAM.val
+
+
+class StreamPublisher:
+    """Worker-side frame pusher: bounded queue + background sender thread.
+
+    ``publish`` is the only hot-path entry point and is O(1) non-blocking:
+    it enqueues or drops-and-counts.  All socket work (connect, send,
+    reconnect-never — a dead collector stays dead for the run) happens on
+    the daemon thread.
+    """
+
+    def __init__(self, address, worker=0, addr=None, maxsize=256,
+                 connect_timeout_s=2.0):
+        host, _, port = address.rpartition(":")
+        self.address = address
+        self.worker = worker
+        self.worker_addr = addr
+        self._target = (host or "127.0.0.1", int(port))
+        self._connect_timeout_s = connect_timeout_s
+        self._q = queue.Queue(maxsize=maxsize)
+        self.sent = 0
+        self.dropped = 0
+        self.dead = False
+        self.connect_error = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"telemetry-stream-w{worker}", daemon=True)
+        self._thread.start()
+
+    # -- hot path ---------------------------------------------------------
+    def publish(self, frame):
+        """Enqueue one frame; returns False when dropped (never blocks)."""
+        if self.dead or self._closed:
+            self.dropped += 1
+            return False
+        frame.setdefault("w", self.worker)
+        try:
+            self._q.put_nowait(frame)
+            return True
+        except queue.Full:
+            self.dropped += 1
+            _bump("stream.dropped_frames")
+            return False
+
+    # -- background thread ------------------------------------------------
+    def _run(self):
+        sock = None
+        try:
+            sock = socket.create_connection(
+                self._target, timeout=self._connect_timeout_s)
+            sock.settimeout(10.0)
+            sock.sendall(encode_frame(
+                {"kind": "hello", "w": self.worker, "pid": os.getpid(),
+                 "addr": self.worker_addr, "t": time.time()}))
+        except OSError as e:
+            # Dead collector: degrade to the file-only path with ONE
+            # counted warning; everything already queued is a drop.
+            self.connect_error = str(e)
+            self._go_dead(f"telemetry stream collector unreachable at "
+                          f"{self.address} ({e}); falling back to "
+                          f"file-only telemetry", "stream.connect_failures")
+            if sock is not None:
+                sock.close()
+            return
+        while True:
+            frame = self._q.get()
+            if frame is None:
+                break
+            try:
+                sock.sendall(encode_frame(frame))
+                self.sent += 1
+            except (OSError, ValueError) as e:
+                self._go_dead(f"telemetry stream send failed ({e}); "
+                              f"falling back to file-only telemetry",
+                              "stream.send_failures")
+                break
+        try:
+            sock.close()
+        except OSError:  # pragma: no cover
+            pass
+
+    def _go_dead(self, message, counter_name):
+        self.dead = True
+        logger.warning(message)
+        _bump(counter_name)
+        # Drain whatever is queued so close() doesn't wait on it.
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self.dropped += 1
+
+    def close(self, timeout_s=2.0):
+        """Flush and stop the sender thread (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            self.dead = True  # sender stuck; thread is daemonic anyway
+        self._thread.join(timeout=timeout_s)
+
+    def stats(self):
+        return {"sent": self.sent, "dropped": self.dropped,
+                "dead": self.dead, "address": self.address}
+
+
+class ClusterView:
+    """Chief-side live state: what every worker reported most recently.
+
+    Thread-safe; the collector's reader threads call :meth:`ingest`, the
+    trainer/monitor poll the read side.  Findings (health + runtime) are
+    queued per-worker and drained once by :meth:`pop_findings` so the
+    trainer feeds each signal to ``note_anomaly`` exactly once.
+    """
+
+    def __init__(self, max_pending_findings=256):
+        self._lock = threading.Lock()
+        self._workers = {}
+        self._findings = deque(maxlen=max_pending_findings)
+        self.frames = 0
+
+    def _entry(self, w):
+        return self._workers.setdefault(w, {
+            "addr": None, "pid": None, "last_step": None,
+            "last_step_wall_s": None, "recent_walls": deque(maxlen=_RECENT_WALLS),
+            "last_seen_t": None, "last_heartbeat_t": None,
+            "health": "ok", "gauges": {}, "findings": 0,
+        })
+
+    def ingest(self, frame, recv_t=None):
+        """Fold one decoded frame into the view (never raises)."""
+        if not isinstance(frame, dict):
+            return
+        now = time.time() if recv_t is None else recv_t
+        w = frame.get("w", 0)
+        kind = frame.get("kind")
+        with self._lock:
+            self.frames += 1
+            e = self._entry(w)
+            e["last_seen_t"] = now
+            if kind == "hello":
+                if frame.get("addr"):
+                    e["addr"] = frame["addr"]
+                if frame.get("pid"):
+                    e["pid"] = frame["pid"]
+            elif kind == "step":
+                step = frame.get("step")
+                wall = frame.get("wall_s")
+                if isinstance(step, (int, float)):
+                    e["last_step"] = int(step)
+                if isinstance(wall, (int, float)):
+                    e["last_step_wall_s"] = float(wall)
+                    # Step 0 includes compile; keep skew on steady state.
+                    if not step == 0:
+                        e["recent_walls"].append(float(wall))
+            elif kind == "heartbeat":
+                e["last_heartbeat_t"] = now
+            elif kind in ("health_finding", "runtime_finding"):
+                e["findings"] += 1
+                sev = str(frame.get("severity", "")).lower()
+                if kind == "health_finding" and sev in ("error", "warning"):
+                    e["health"] = sev
+                self._findings.append(dict(frame))
+            elif kind == "gauge":
+                name = frame.get("name")
+                if name is not None:
+                    e["gauges"][name] = frame.get("value")
+
+    # -- read side --------------------------------------------------------
+    def pop_findings(self):
+        """Drain pending health/runtime finding frames (oldest first)."""
+        out = []
+        with self._lock:
+            while self._findings:
+                out.append(self._findings.popleft())
+        return out
+
+    def last_steps(self):
+        with self._lock:
+            return {w: e["last_step"] for w, e in self._workers.items()}
+
+    def worker_address(self, w):
+        with self._lock:
+            e = self._workers.get(w)
+        if e and e.get("addr"):
+            return e["addr"]
+        return f"worker {w}"
+
+    def step_skew(self, rel_threshold=0.25, abs_threshold_s=0.05):
+        """Live step-wall skew under the post-hoc T002 contract.
+
+        Median of each worker's recent walls; ``None`` with fewer than two
+        workers reporting >= 3 steady-state steps; names the
+        ``straggler`` / ``straggler_addr`` when the slowest exceeds the
+        fastest by ``max(rel * fastest, abs)``.
+        """
+        with self._lock:
+            walls = {w: list(e["recent_walls"])
+                     for w, e in self._workers.items()
+                     if len(e["recent_walls"]) >= _MIN_SKEW_STEPS}
+            addrs = {w: e["addr"] for w, e in self._workers.items()}
+        if len(walls) < 2:
+            return None
+        medians = {w: sorted(v)[len(v) // 2] for w, v in walls.items()}
+        fastest = min(medians.values())
+        slowest_w = max(medians, key=lambda w: medians[w])
+        skew = medians[slowest_w] - fastest
+        threshold = max(rel_threshold * fastest, abs_threshold_s)
+        out = {"per_worker_median_s": medians, "skew_s": skew,
+               "fastest_s": fastest, "threshold_s": threshold,
+               "straggler": None, "straggler_addr": None}
+        if skew > threshold:
+            out["straggler"] = slowest_w
+            out["straggler_addr"] = (addrs.get(slowest_w)
+                                     or f"worker {slowest_w}")
+        return out
+
+    def stale_workers(self, timeout_s, now=None):
+        """Workers silent (no frame of any kind) for > ``timeout_s``."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return {w: now - e["last_seen_t"]
+                    for w, e in self._workers.items()
+                    if e["last_seen_t"] is not None
+                    and now - e["last_seen_t"] > timeout_s}
+
+    def snapshot(self, now=None):
+        """JSON-able live summary (the monitor's data source)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            steps = [e["last_step"] for e in self._workers.values()
+                     if e["last_step"] is not None]
+            front = max(steps) if steps else None
+            workers = {}
+            for w, e in sorted(self._workers.items()):
+                workers[w] = {
+                    "addr": e["addr"], "last_step": e["last_step"],
+                    "last_step_wall_s": e["last_step_wall_s"],
+                    "steps_behind": (front - e["last_step"]
+                                     if front is not None
+                                     and e["last_step"] is not None else None),
+                    "age_s": (now - e["last_seen_t"]
+                              if e["last_seen_t"] is not None else None),
+                    "heartbeat_age_s": (now - e["last_heartbeat_t"]
+                                        if e["last_heartbeat_t"] is not None
+                                        else None),
+                    "health": e["health"], "findings": e["findings"],
+                    "gauges": dict(e["gauges"]),
+                }
+        skew = self.step_skew()
+        return {"workers": workers, "frames": self.frames,
+                "front_step": front,
+                "skew_s": skew["skew_s"] if skew else None,
+                "straggler_addr": skew["straggler_addr"] if skew else None}
+
+
+class TelemetryCollector:
+    """Chief-side listener: accepts worker streams, feeds a ClusterView.
+
+    One daemon accept thread plus one daemon reader thread per
+    connection; every decoded frame is folded into ``view`` and then
+    handed to the optional ``on_frame`` callback.  Broken/oversized
+    frames tear down that one connection (counted), never the collector.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, view=None, on_frame=None):
+        self._host = host
+        self._port = port
+        self.view = view if view is not None else ClusterView()
+        self._on_frame = on_frame
+        self._sock = None
+        self._threads = []
+        self._stopping = False
+        self.connections = 0
+        self.frames = 0
+        self.bad_frames = 0
+
+    @property
+    def address(self):
+        if self._sock is None:
+            return None
+        host, port = self._sock.getsockname()[:2]
+        return f"{self._host}:{port}"
+
+    def start(self):
+        """Bind + listen; returns the bound ``host:port``."""
+        self._sock = socket.create_server((self._host, self._port))
+        self._sock.settimeout(0.5)
+        t = threading.Thread(target=self._accept_loop,
+                             name="telemetry-collector", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self.address
+
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self.connections += 1
+            t = threading.Thread(target=self._read_loop, args=(conn,),
+                                 name="telemetry-collector-conn", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _read_loop(self, conn):
+        try:
+            with conn:
+                conn.settimeout(None)
+                for frame in recv_frames(conn):
+                    self.frames += 1
+                    try:
+                        self.view.ingest(frame)
+                        if self._on_frame is not None:
+                            self._on_frame(frame)
+                    except Exception:  # pragma: no cover - view never raises
+                        self.bad_frames += 1
+        except (OSError, ValueError, json.JSONDecodeError):
+            self.bad_frames += 1
+            _bump("stream.bad_frames")
+
+    def stop(self):
+        """Stop accepting and close the listening socket (idempotent)."""
+        self._stopping = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:  # pragma: no cover
+                pass
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self._threads = []

@@ -1,0 +1,281 @@
+"""Reaction audit — the control-plane tier (autodist_tpu/analysis/
+reaction_audit.py, docs/analysis.md "Reaction audit").
+
+Pins the E-code contract over synthetic causal event logs (E001 ignored
+alarm, E002 blown MTTR budget, E003 throughput-regressing re-plan, E004
+unanswered heartbeat gap, E005 causality table), the golden fixtures
+under ``tests/data/events/`` that ``verify_strategy --events
+--selftest`` drives, the registered ``reaction-audit`` pass, the
+ElasticTrainer export, and the AD06 lint rule that confines raw socket
+channel creation to the two blessed transport sites.
+"""
+import os
+
+from autodist_tpu.analysis.reaction_audit import (MTTR_BUDGET_S,
+                                                  audit_fixture,
+                                                  reaction_audit)
+from autodist_tpu.analysis.report import Severity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "events")
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+def _sig(signal, worker=None, step=None, code=None, t=100.0,
+         persistent=False):
+    return {"kind": "cluster_event", "event": "signal", "signal": signal,
+            "worker": worker, "step": step, "code": code,
+            "persistent": persistent, "t": t}
+
+
+def _act(event, *, cause=None, step=None, latency_s=None, t=101.0, **f):
+    rec = {"kind": "cluster_event", "event": event, "step": step, "t": t}
+    if cause is not None:
+        rec["cause"] = cause
+    if latency_s is not None:
+        rec["latency_s"] = latency_s
+    rec.update(f)
+    return rec
+
+
+def _cause(signal, worker=None, step=None, code=None, t=100.0):
+    return {"signal": signal, "worker": worker, "step": step,
+            "code": code, "t": t}
+
+
+# -- E000 / E005: the table is always there ----------------------------------
+
+
+def test_empty_log_yields_e000_and_an_empty_table():
+    findings = reaction_audit([])
+    assert _codes(findings) == ["E000", "E005"]
+    table = next(f for f in findings if f.code == "E005")
+    assert table.severity is Severity.INFO
+    assert table.data["events"] == 0 and table.data["flagged"] == []
+
+
+def test_clean_answered_signal_yields_only_the_e005_table():
+    cause = _cause("straggler", worker="10.0.0.2", code="T002")
+    events = [_sig("straggler", worker="10.0.0.2", code="T002", t=100.0),
+              _sig("straggler", worker="10.0.0.2", code="T002", t=100.5,
+                   persistent=True),
+              _act("hook_fired", cause=cause, latency_s=0.6, t=100.6)]
+    findings = reaction_audit(events)
+    assert _codes(findings) == ["E005"]
+    table = next(f for f in findings if f.code == "E005").data
+    assert table["signals"] == 2 and table["actions"] == 1
+    assert table["causality"][0]["latency_s"] == 0.6
+    assert table["latency_s"]["max"] == 0.6
+
+
+# -- E001: ignored alarm -----------------------------------------------------
+
+
+def test_e001_fires_on_repeated_or_persistent_unacted_signal():
+    repeated = [_sig("straggler", worker="10.0.0.2", t=100.0 + i)
+                for i in range(2)]
+    assert "E001" in _codes(reaction_audit(repeated))
+    flagged_once = [_sig("worker_exit", worker="10.0.0.3", persistent=True)]
+    assert "E001" in _codes(reaction_audit(flagged_once))
+
+
+def test_e001_spares_transient_blips_and_answered_signals():
+    # one non-persistent blip is not an ignored alarm
+    assert "E001" not in _codes(reaction_audit([_sig("anomaly", step=3)]))
+    # a global action (no worker) answers any worker's signal
+    events = [_sig("worker_exit", worker="10.0.0.3", persistent=True),
+              _act("replan", cause=_cause("worker_exit"), step=9,
+                   latency_s=1.0)]
+    assert "E001" not in _codes(reaction_audit(events))
+    # but an action for ANOTHER signal name does not
+    events = [_sig("worker_exit", worker="10.0.0.3", persistent=True),
+              _act("hook_fired", cause=_cause("straggler"), latency_s=0.1)]
+    assert "E001" in _codes(reaction_audit(events))
+
+
+# -- E002: blown MTTR budget -------------------------------------------------
+
+
+def test_e002_fires_per_action_beyond_the_budget():
+    cause = _cause("worker_exit", worker="10.0.0.3")
+    events = [_sig("worker_exit", worker="10.0.0.3", persistent=True),
+              _act("checkpoint_save", cause=cause, latency_s=9.0),
+              _act("replan", cause=cause, latency_s=9.8),
+              _act("hook_fired", cause=cause, latency_s=0.2)]
+    findings = reaction_audit(events)
+    e002 = [f for f in findings if f.code == "E002"]
+    assert len(e002) == 2  # each slow action flagged; the fast one spared
+    assert all(f.severity is Severity.ERROR for f in e002)
+    assert all(f.data["budget_s"] == MTTR_BUDGET_S for f in e002)
+    # the same log passes under a run-specific relaxed budget
+    assert "E002" not in _codes(reaction_audit(events, mttr_budget_s=15.0))
+
+
+# -- E003: the re-plan made it worse -----------------------------------------
+
+
+def _steps(walls, start=1):
+    return [{"kind": "step", "step": start + i, "wall_s": w}
+            for i, w in enumerate(walls)]
+
+
+def test_e003_fires_when_post_replan_walls_regress():
+    cause = _cause("worker_exit", worker="10.0.0.3")
+    events = [_sig("worker_exit", worker="10.0.0.3", persistent=True),
+              _act("replan", cause=cause, step=6, latency_s=0.5)]
+    steps = _steps([0.010] * 5) + _steps([0.030] * 5, start=7)  # 3x slower
+    findings = reaction_audit(events, steps)
+    e003 = [f for f in findings if f.code == "E003"]
+    assert len(e003) == 1 and e003[0].severity is Severity.WARNING
+    assert e003[0].data["step"] == 6
+    # within the +60% shrunk-topology slack: no finding
+    ok_steps = _steps([0.010] * 5) + _steps([0.014] * 5, start=7)
+    assert "E003" not in _codes(reaction_audit(events, ok_steps))
+
+
+# -- E004: silent worker, no membership event --------------------------------
+
+
+def test_e004_fires_on_unanswered_heartbeat_gap():
+    events = [_sig("heartbeat_gap", worker="10.0.0.4", t=100.0)]
+    findings = reaction_audit(events)
+    e004 = [f for f in findings if f.code == "E004"]
+    assert len(e004) == 1 and e004[0].severity is Severity.WARNING
+    # a membership epoch AFTER the gap answers it
+    answered = events + [_act("membership_epoch", t=103.0, epoch=2)]
+    assert "E004" not in _codes(reaction_audit(answered))
+    # one BEFORE the gap does not
+    stale = events + [_act("membership_epoch", t=99.0, epoch=1)]
+    assert "E004" in _codes(reaction_audit(stale))
+
+
+# -- the golden fixtures (verify_strategy --events --selftest) ---------------
+
+
+def test_unacted_fixture_fires_e001():
+    findings = audit_fixture(os.path.join(FIXTURES, "unacted.jsonl"))
+    assert "E001" in _codes(findings)
+
+
+def test_slow_mttr_fixture_fires_e002():
+    findings = audit_fixture(os.path.join(FIXTURES, "slow_mttr.jsonl"))
+    assert "E002" in _codes(findings)
+    assert "E001" not in _codes(findings)  # the signal WAS acted on
+
+
+def test_clean_fixture_stays_clean_with_its_table():
+    findings = audit_fixture(os.path.join(FIXTURES, "clean.jsonl"))
+    assert _codes(findings) == ["E005"]
+
+
+# -- the registered pass + the trainer export --------------------------------
+
+
+def test_reaction_audit_pass_reads_manifest_cluster_events():
+    from autodist_tpu.analysis import EVENT_PASSES
+    from autodist_tpu.analysis.reaction_audit import reaction_audit_pass
+
+    assert "reaction-audit" in EVENT_PASSES
+
+    class Ctx:
+        pass
+
+    ctx = Ctx()
+    ctx.manifest_records = [
+        _sig("straggler", worker="10.0.0.2", t=100.0),
+        _sig("straggler", worker="10.0.0.2", t=100.5),
+        {"kind": "step", "step": 1, "wall_s": 0.01},
+    ]
+    findings = reaction_audit_pass(ctx)
+    assert "E001" in _codes(findings)
+    assert ctx.reaction_summary["signals"] == 2
+    # an explicit event_records list wins over the manifest
+    ctx2 = Ctx()
+    ctx2.manifest_records = ctx.manifest_records
+    ctx2.event_records = []
+    assert _codes(reaction_audit_pass(ctx2)) == ["E000", "E005"]
+
+
+def test_elastic_trainer_exports_a_reaction_report():
+    from autodist_tpu.elastic import ElasticTrainer
+
+    trainer = ElasticTrainer.__new__(ElasticTrainer)
+    from autodist_tpu.telemetry.events import ClusterEventLog
+
+    trainer.event_log = ClusterEventLog()
+    trainer.mttr_budget_s = None
+    cause = trainer.event_log.note_signal("straggler", worker="10.0.0.2",
+                                          code="T002", persistent=True)
+    trainer.event_log.record("hook_fired", hook="on_straggler",
+                             worker="10.0.0.2", cause=cause)
+    report = trainer.reaction_report()
+    assert report.strategy_id == "elastic-control-plane"
+    assert _codes(report.findings) == ["E005"]
+    assert not report.errors
+
+
+# -- AD06 lint rule ----------------------------------------------------------
+
+
+def _lint_snippet(tmp_path, relpath, source):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [code for _p, _ln, code, _m in lint.lint_file(p)]
+
+
+_AD06_BAD = ("import socket\n"
+             "def push(host):\n"
+             "    s = socket.create_connection((host, 9999))\n"
+             "    return s\n")
+_AD06_FROM = ("from socket import socketpair\n"
+              "def chan():\n"
+              "    return socketpair()\n")
+
+
+def test_ad06_flags_raw_socket_channels_in_engine_code(tmp_path):
+    assert "AD06" in _lint_snippet(tmp_path, "autodist_tpu/x.py", _AD06_BAD)
+    assert "AD06" in _lint_snippet(tmp_path, "autodist_tpu/sub/y.py",
+                                   _AD06_FROM)
+
+
+def test_ad06_exempts_the_transport_layer_and_mere_imports(tmp_path):
+    # the two blessed transport sites
+    assert "AD06" not in _lint_snippet(
+        tmp_path, "autodist_tpu/cluster.py", _AD06_BAD)
+    assert "AD06" not in _lint_snippet(
+        tmp_path, "autodist_tpu/telemetry/stream.py", _AD06_BAD)
+    # tools and tests drive sockets legitimately
+    assert "AD06" not in _lint_snippet(tmp_path, "tools/t.py", _AD06_BAD)
+    assert "AD06" not in _lint_snippet(tmp_path, "tests/t.py", _AD06_BAD)
+    # name resolution (utils/network.py) only imports socket — clean
+    resolve = ("import socket\n"
+               "def resolve(h):\n"
+               "    return socket.gethostbyname(h)\n")
+    assert "AD06" not in _lint_snippet(
+        tmp_path, "autodist_tpu/utils/network.py", resolve)
+
+
+def test_ad06_holds_on_the_real_tree():
+    """The shipped package carries no raw socket channel outside the
+    transport layer (the other direction of the pin)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    from pathlib import Path
+
+    ad06 = [f for p in sorted(Path(REPO, "autodist_tpu").rglob("*.py"))
+            for f in lint.lint_file(p) if f[2] == "AD06"]
+    assert ad06 == []

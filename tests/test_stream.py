@@ -1,0 +1,357 @@
+"""Live telemetry stream (autodist_tpu/telemetry/stream.py,
+docs/observability.md "Live control plane").
+
+Pins the transport contracts without a mesh or a jax import: the
+length-prefixed-JSON frame codec, the worker-side publisher's
+never-block/drop-and-count hot path, the ONE counted warning on a dead
+collector, the chief-side ClusterView (front step, T002 skew contract,
+heartbeat staleness, drain-once findings), the JsonlWriter size-capped
+rotation the event mirror rides on, and the causal ClusterEventLog
+(cause tokens, measured latency, attach-writer replay) whose records
+validate under manifest schema v3.
+"""
+import io
+import json
+import logging
+import socket
+import threading
+import time
+
+import pytest
+
+from autodist_tpu.telemetry.aggregate import merge_records
+from autodist_tpu.telemetry.events import (EVENTS_NAME, ClusterEventLog,
+                                           load_events, make_cause)
+from autodist_tpu.telemetry.metrics import JsonlWriter
+from autodist_tpu.telemetry.stream import (MAX_FRAME_BYTES, ClusterView,
+                                           StreamPublisher,
+                                           TelemetryCollector, encode_frame,
+                                           recv_frames)
+
+
+# -- frame codec -------------------------------------------------------------
+
+
+def test_frame_codec_round_trip_over_socketpair():
+    frames = [{"kind": "hello", "w": 1, "addr": "10.0.0.2"},
+              {"kind": "step", "w": 1, "step": 7, "wall_s": 0.012},
+              {"kind": "heartbeat", "w": 1}]
+    a, b = socket.socketpair()
+    try:
+        for f in frames:
+            a.sendall(encode_frame(f))
+        a.shutdown(socket.SHUT_WR)
+        assert list(recv_frames(b)) == frames
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_codec_rejects_oversized_both_ends():
+    with pytest.raises(ValueError):
+        encode_frame({"blob": "x" * (MAX_FRAME_BYTES + 1)})
+    a, b = socket.socketpair()
+    try:
+        # a lying length prefix terminates the stream, it is never buffered
+        a.sendall((MAX_FRAME_BYTES + 1).to_bytes(4, "big"))
+        with pytest.raises(ValueError):
+            list(recv_frames(b))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frames_stops_cleanly_on_truncated_frame():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(encode_frame({"kind": "heartbeat"})
+                  + (50).to_bytes(4, "big") + b"{tru")  # torn mid-payload
+        a.close()
+        assert list(recv_frames(b)) == [{"kind": "heartbeat"}]
+    finally:
+        b.close()
+
+
+# -- publisher -> collector end-to-end ---------------------------------------
+
+
+def _wait(pred, timeout_s=5.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_publisher_streams_frames_into_live_view():
+    collector = TelemetryCollector()
+    address = collector.start()
+    assert isinstance(address, str) and ":" in address
+    pub = StreamPublisher(address, worker=1, addr="10.0.0.2")
+    try:
+        for step in range(4):
+            assert pub.publish({"kind": "step", "step": step,
+                                "wall_s": 0.01})
+        pub.publish({"kind": "heartbeat"})
+        pub.publish({"kind": "health_finding", "check": "nonfinite_loss",
+                     "severity": "error", "step": 3})
+        pub.publish({"kind": "gauge", "name": "hbm_bytes", "value": 7})
+        assert _wait(lambda: collector.frames >= 8)  # + the hello
+        snap = collector.view.snapshot()
+        w1 = snap["workers"][1]
+        assert w1["addr"] == "10.0.0.2"          # the hello handshake
+        assert w1["last_step"] == 3 == snap["front_step"]
+        assert w1["heartbeat_age_s"] is not None
+        assert w1["health"] == "error" and w1["findings"] == 1
+        assert w1["gauges"]["hbm_bytes"] == 7
+        assert collector.view.worker_address(1) == "10.0.0.2"
+        # findings drain exactly once (the trainer's note_anomaly feed)
+        drained = collector.view.pop_findings()
+        assert [f["check"] for f in drained] == ["nonfinite_loss"]
+        assert collector.view.pop_findings() == []
+        assert pub.stats()["sent"] >= 7 and not pub.stats()["dead"]
+    finally:
+        pub.close()
+        collector.stop()
+
+
+def test_publisher_never_blocks_on_backpressure(monkeypatch):
+    """A full queue drops-and-counts; the hot path returns immediately."""
+    import autodist_tpu.telemetry.stream as stream_mod
+
+    gate = threading.Event()
+
+    def stalled_connect(target, timeout=None):
+        gate.wait(10.0)
+        raise OSError("test: collector never came up")
+
+    monkeypatch.setattr(stream_mod.socket, "create_connection",
+                        stalled_connect)
+    pub = StreamPublisher("127.0.0.1:1", worker=0, maxsize=2)
+    try:
+        assert pub.publish({"kind": "heartbeat"})
+        assert pub.publish({"kind": "heartbeat"})
+        t0 = time.time()
+        assert pub.publish({"kind": "heartbeat"}) is False  # queue full
+        assert time.time() - t0 < 0.5  # dropped, not blocked
+        assert pub.dropped == 1
+    finally:
+        gate.set()
+        pub.close()
+    # once the connect fails, everything queued becomes a counted drop
+    assert _wait(lambda: pub.dead and pub.dropped == 3)
+
+
+def test_dead_collector_degrades_with_one_counted_warning():
+    # an explicit handler on the module logger: the repo's logging config
+    # may disable propagation, which would hide the warning from caplog
+    seen = []
+    handler = logging.Handler()
+    handler.emit = seen.append
+    stream_logger = logging.getLogger("autodist_tpu.telemetry.stream")
+    stream_logger.addHandler(handler)
+    try:
+        pub = StreamPublisher("127.0.0.1:9", worker=0)  # nothing listens
+        assert _wait(lambda: pub.dead)
+        n0 = pub.dropped
+        for _ in range(5):
+            assert pub.publish({"kind": "heartbeat"}) is False  # never raises
+        pub.close()
+    finally:
+        stream_logger.removeHandler(handler)
+    assert pub.connect_error
+    assert pub.dropped == n0 + 5
+    warnings = [r for r in seen if "file-only" in r.getMessage()]
+    assert len(warnings) == 1  # ONE warning, not one per frame
+
+
+def test_collector_survives_a_broken_connection():
+    collector = TelemetryCollector()
+    address = collector.start()
+    host, _, port = address.rpartition(":")
+    try:
+        bad = socket.create_connection((host, int(port)))
+        bad.sendall((12).to_bytes(4, "big") + b"not json  {]")
+        bad.close()
+        good = StreamPublisher(address, worker=2)
+        good.publish({"kind": "step", "step": 1, "wall_s": 0.01})
+        assert _wait(lambda: 2 in collector.view.last_steps())
+        good.close()
+        assert collector.bad_frames >= 1  # counted, collector still up
+    finally:
+        collector.stop()
+
+
+# -- ClusterView: the T002 skew contract, staleness --------------------------
+
+
+def _feed_steps(view, w, walls, start_step=1):
+    for i, wall in enumerate(walls):
+        view.ingest({"kind": "step", "w": w, "step": start_step + i,
+                     "wall_s": wall})
+
+
+def test_step_skew_names_the_straggler_by_address():
+    view = ClusterView()
+    view.ingest({"kind": "hello", "w": 0, "addr": "10.0.0.1"})
+    view.ingest({"kind": "hello", "w": 1, "addr": "10.0.0.2"})
+    _feed_steps(view, 0, [0.010] * 5)
+    assert view.step_skew() is None  # one worker reporting is not a skew
+    _feed_steps(view, 1, [0.200] * 5)
+    skew = view.step_skew()
+    assert skew["straggler"] == 1
+    assert skew["straggler_addr"] == "10.0.0.2"
+    assert skew["skew_s"] == pytest.approx(0.190, abs=1e-6)
+    snap = view.snapshot()
+    assert snap["straggler_addr"] == "10.0.0.2"
+    assert snap["workers"][1]["steps_behind"] == 0
+
+
+def test_step_skew_needs_steady_state_and_skips_step_zero():
+    view = ClusterView()
+    # step 0 includes compile: a huge wall there must not create skew
+    view.ingest({"kind": "step", "w": 0, "step": 0, "wall_s": 30.0})
+    view.ingest({"kind": "step", "w": 1, "step": 0, "wall_s": 0.01})
+    _feed_steps(view, 0, [0.010] * 2)
+    _feed_steps(view, 1, [0.010] * 2)
+    assert view.step_skew() is None  # < 3 steady-state walls each
+    _feed_steps(view, 0, [0.010], start_step=3)
+    _feed_steps(view, 1, [0.010], start_step=3)
+    skew = view.step_skew()
+    assert skew is not None and skew["straggler"] is None  # balanced
+
+
+def test_stale_workers_and_heartbeat_age():
+    view = ClusterView()
+    t0 = 1000.0
+    view.ingest({"kind": "heartbeat", "w": 0}, recv_t=t0)
+    view.ingest({"kind": "heartbeat", "w": 1}, recv_t=t0 + 9.0)
+    stale = view.stale_workers(5.0, now=t0 + 10.0)
+    assert set(stale) == {0} and stale[0] == pytest.approx(10.0)
+    snap = view.snapshot(now=t0 + 10.0)
+    assert snap["workers"][0]["heartbeat_age_s"] == pytest.approx(10.0)
+    assert snap["workers"][1]["heartbeat_age_s"] == pytest.approx(1.0)
+
+
+# -- JsonlWriter rotation ----------------------------------------------------
+
+
+def test_jsonl_writer_rotates_and_merge_reads_segments(tmp_path):
+    run_dir = tmp_path / "run"
+    w = JsonlWriter(str(run_dir / "worker_0.jsonl"), worker=0,
+                    max_bytes=400, max_segments=2)
+    t0 = time.time()
+    for i in range(20):
+        w.write({"kind": "gauge", "name": "g", "value": i, "t": t0 + i})
+    w.close()
+    assert w.rotations >= 2
+    assert (run_dir / "worker_0.jsonl.1").exists()
+    assert (run_dir / "worker_0.jsonl.2").exists()
+    assert not (run_dir / "worker_0.jsonl.3").exists()  # capped
+    assert w.dropped_segments >= 1
+    merged, stats = merge_records(str(run_dir))
+    assert stats["rotated_files"] >= 2
+    values = [r["value"] for r in merged if r.get("kind") == "gauge"]
+    # oldest surviving segment first, newest (active file) last
+    assert values == sorted(values) and values[-1] == 19
+
+
+# -- the causal event log ----------------------------------------------------
+
+
+def test_event_log_cause_tokens_measure_latency():
+    log = ClusterEventLog()
+    cause = log.note_signal("straggler", worker="10.0.0.2", step=4,
+                            code="T002", persistent=True, skew_s=0.19)
+    assert cause["signal"] == "straggler" and cause["worker"] == "10.0.0.2"
+    rec = log.record("hook_fired", step=4, hook="on_straggler",
+                     worker="10.0.0.2", cause=cause)
+    assert rec["cause"]["code"] == "T002"
+    assert 0.0 <= rec["latency_s"] < 5.0  # measured here, not passed in
+    explicit = log.record("replan", step=5,
+                          cause=make_cause("worker_exit", t=100.0),
+                          latency_s=1.25)
+    assert explicit["latency_s"] == 1.25  # an explicit latency wins
+    assert len(log.signals()) == 1 and len(log.actions()) == 2
+
+
+def test_event_log_is_bounded_and_counts_drops():
+    log = ClusterEventLog(maxlen=4)
+    for i in range(7):
+        log.note_signal("anomaly", step=i)
+    assert len(log.events) == 4 and log.dropped == 3
+    assert [e["step"] for e in log.events] == [3, 4, 5, 6]
+
+
+def test_attach_writer_replays_and_mirror_validates_as_schema_v3(tmp_path):
+    from autodist_tpu import telemetry
+
+    run_dir = tmp_path / "run"
+    log = ClusterEventLog()
+    cause = log.note_signal("worker_exit", worker="10.0.0.3", code="-9",
+                            persistent=True)
+    log.record("membership_epoch", epoch=2, lost=["10.0.0.3"], cause=cause)
+    assert not log.mirrored
+    log.attach_writer(JsonlWriter(str(run_dir / EVENTS_NAME), worker=0),
+                      replay=True)
+    assert log.mirrored
+    log.record("replan", step=9, cause=cause)
+    log.close()
+    events = load_events(str(run_dir / EVENTS_NAME))
+    assert [e["event"] for e in events] == ["signal", "membership_epoch",
+                                           "replan"]  # replay kept order
+    # the chief merge folds events.jsonl in; schema v3 accepts the kind
+    merge_path = run_dir / "manifest.jsonl"
+    merge_path.write_text("".join(
+        json.dumps(r) + "\n" for r in merge_records(str(run_dir))[0]))
+    records, errors = telemetry.validate_manifest(str(merge_path))
+    assert errors == []
+    assert sum(r.get("kind") == "cluster_event" for r in records) == 3
+
+
+def test_load_events_skips_torn_lines(tmp_path):
+    p = tmp_path / EVENTS_NAME
+    p.write_text(json.dumps({"kind": "cluster_event", "event": "signal",
+                             "signal": "chaos"}) + "\n"
+                 + '{"torn": \n' + "[1,2]\n")
+    events = load_events(str(p))
+    assert len(events) == 1 and events[0]["signal"] == "chaos"
+
+
+# -- monitor renders the same view -------------------------------------------
+
+
+def test_monitor_renders_view_and_event_tail():
+    from tools.monitor import render_view, view_from_records
+
+    t0 = 2000.0
+    records = [{"kind": "meta", "w": 0, "addr": "10.0.0.1", "t": t0}]
+    records += [{"kind": "step", "w": 0, "step": s, "wall_s": 0.01,
+                 "t": t0 + s} for s in range(1, 5)]
+    view = view_from_records(records)
+    out = render_view(view.snapshot(now=t0 + 4), events=[
+        {"kind": "cluster_event", "event": "hook_fired", "step": 4,
+         "worker": "10.0.0.2", "latency_s": 0.0123,
+         "cause": {"signal": "straggler", "worker": "10.0.0.2"}}])
+    assert "cluster view" in out and "10.0.0.1" in out
+    assert "hook_fired@4" in out and "<- straggler(10.0.0.2)" in out
+    assert "12.3ms" in out
+
+
+def test_telemetry_report_follow_tails_a_growing_run(tmp_path):
+    from tools.telemetry_report import follow
+
+    run_dir = tmp_path / "run"
+    w = JsonlWriter(str(run_dir / "worker_0.jsonl"), worker=0)
+    t0 = time.time()
+    w.write({"kind": "meta", "schema": 3, "run_id": "r", "t": t0,
+             "backend": "cpu", "num_devices": 1})
+    for s in range(3):
+        w.write({"kind": "step", "step": s, "wall_s": 0.01, "t": t0 + s})
+    w.close()
+    buf = io.StringIO()
+    assert follow(str(run_dir), interval_s=0.01, max_updates=2,
+                  out=buf) == 2
+    assert "live:" in buf.getvalue()
+    assert "summary" not in buf.getvalue()  # no finalized trailer

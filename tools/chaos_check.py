@@ -22,6 +22,15 @@ Asserts the elastic-training acceptance contract end to end, no TPU needed:
    (``check='nonfinite'``), land in the telemetry manifest as
    ``health_finding`` records + the summary's health verdict, and the
    run must still drain to its step target with membership untouched.
+5. **live straggler stream** — the LIVE control plane (docs/
+   observability.md): a synthetic peer worker publishes ``delay@N``-
+   shaped step walls over the real stream socket to the chief's
+   collector; the trainer's step-boundary ClusterView poll must name the
+   peer a straggler and fire ``on_straggler`` MID-RUN, within K steps of
+   the injected stall — not from the post-hoc manifest merge — and the
+   causal event log must record the signal -> ``hook_fired`` pair with a
+   measured signal->action latency (clean under the E-code reaction
+   audit: acted-on, within the MTTR budget).
 """
 import json
 import os
@@ -304,13 +313,130 @@ def check_nan_anomaly_drill():
                 "nonfinite_count": counts["nonfinite"], "replans": 0}
 
 
+def check_live_straggler_stream():
+    """Scenario 5: the straggler signal reaches the chief over the LIVE
+    stream mid-run.  A synthetic peer (worker 1) publishes step frames
+    over the real socket with ``delay@N``-shaped walls — normal until
+    the injected stall, inflated after — while the trainer's own session
+    streams its real walls.  The step-boundary poll must flag the peer,
+    fire ``on_straggler`` within K steps of the stall, and the event log
+    must carry the signal->hook causality with a measured latency."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import telemetry
+    from autodist_tpu.elastic import ElasticTrainer, parse_chaos
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.telemetry.events import EVENTS_NAME
+    from autodist_tpu.telemetry.stream import StreamPublisher
+
+    # the peer's scripted stall, in the AUTODIST_CHAOS contract's shape
+    stall = parse_chaos("delay@6:0.2")[0]
+    total_steps, within_k = 14, 6
+    peer_addr = "10.0.0.99"
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    r = np.random.RandomState(7)
+    params = {"w": jnp.asarray(r.randn(12, 3), jnp.float32)}
+
+    stragglers = []  # (skew dict, session step when the hook fired)
+
+    with tempfile.TemporaryDirectory() as d:
+        run_dir = os.path.join(d, "telemetry")
+        telemetry.enable(run_dir=run_dir)
+        peer = {}
+
+        def on_straggler(skew):
+            stragglers.append((skew, int(trainer.session.step)))
+
+        def batch_fn(step):
+            # publish the peer's frame for this step over the REAL
+            # socket before the chief runs it, so the poll at this step
+            # boundary can see it (one step of delivery lag tolerated
+            # by the within-K window)
+            if "pub" not in peer and trainer.cluster.stream_address:
+                peer["pub"] = StreamPublisher(
+                    trainer.cluster.stream_address, worker=1,
+                    addr=peer_addr)
+                peer["sent"] = set()
+            if "pub" in peer and step not in peer["sent"]:
+                peer["sent"].add(step)
+                wall = float(stall.arg) if step >= stall.step else 0.001
+                peer["pub"].publish(
+                    {"kind": "step", "step": step, "wall_s": wall})
+                time.sleep(0.01)
+            rr = np.random.RandomState(step)
+            return {"x": rr.randn(16, 12).astype(np.float32),
+                    "y": rr.randn(16, 3).astype(np.float32)}
+
+        try:
+            trainer = ElasticTrainer(
+                ResourceSpec.from_num_chips(8), AllReduce(), loss, params,
+                optax.sgd(0.05), checkpoint_dir=d,
+                on_straggler=on_straggler)
+            sess = trainer.fit(batch_fn, steps=total_steps)
+        finally:
+            if "pub" in peer:
+                peer["pub"].close()
+            telemetry.disable()
+            telemetry._STATE["run_dir"] = None
+
+        assert sess.step == total_steps, sess.step
+        # a straggler is a signal, not a membership event
+        assert trainer.replans == 0 and trainer.epoch == 0
+        assert stragglers, \
+            "on_straggler never fired from the live stream path"
+        skew0, fired_at = stragglers[0]
+        assert skew0.get("straggler_addr") == peer_addr, skew0
+        assert stall.step <= fired_at <= stall.step + within_k, (
+            f"hook fired at step {fired_at}, want within "
+            f"{within_k} steps of the stall at {stall.step}")
+        assert fired_at < total_steps, "hook only fired post-hoc"
+
+        # the causal event log: signal -> hook_fired with measured latency
+        recs = trainer.event_log.to_records()
+        sigs = [x for x in recs if x.get("event") == "signal"
+                and x.get("signal") == "straggler"
+                and x.get("worker") == peer_addr]
+        acts = [x for x in recs if x.get("event") == "hook_fired"
+                and x.get("hook") == "on_straggler"]
+        assert sigs and acts, (len(sigs), len(acts))
+        cause = acts[0].get("cause") or {}
+        assert cause.get("signal") == "straggler" \
+            and cause.get("worker") == peer_addr, cause
+        lat = acts[0].get("latency_s")
+        assert isinstance(lat, float) and 0.0 <= lat < 10.0, lat
+        # mirrored to events.jsonl and folded into the merged manifest
+        assert os.path.exists(os.path.join(run_dir, EVENTS_NAME))
+        merged = [x for x in telemetry.load_manifest(run_dir)
+                  if x.get("kind") == "cluster_event"]
+        assert any(x.get("event") == "hook_fired" for x in merged), \
+            "cluster events missing from the merged manifest"
+        # the reaction audit judges the loop live: acted-on, in budget
+        rep = trainer.last_reaction_report
+        assert rep is not None
+        codes = {f.code for f in rep.findings}
+        assert "E005" in codes, codes
+        assert "E001" not in codes and "E002" not in codes, codes
+        return {"fired_at_step": fired_at, "stall_step": stall.step,
+                "signals": len(sigs), "hook_firings": len(acts),
+                "signal_to_hook_latency_s": lat,
+                "merged_cluster_events": len(merged)}
+
+
 def main():
     t0 = time.monotonic()
     results = {}
     for name, fn in (("kill_one_worker", check_kill_one_worker),
                      ("preempt_resume", check_preempt_resume),
                      ("delay_injection", check_delay_injection),
-                     ("nan_anomaly_drill", check_nan_anomaly_drill)):
+                     ("nan_anomaly_drill", check_nan_anomaly_drill),
+                     ("live_straggler_stream",
+                      check_live_straggler_stream)):
         t = time.monotonic()
         results[name] = fn()
         print(f"chaos_check: {name} OK ({time.monotonic() - t:.1f}s) -> "

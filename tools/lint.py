@@ -61,6 +61,18 @@ codebase:
         R002 in the regression audit, and an ``on_anomaly`` signal in
         the elastic trainer.  Scoped to ``autodist_tpu/``; tests and
         tools assert on NaNs legitimately.
+  AD06  raw socket channel creation in ``autodist_tpu/`` outside the
+        two blessed transport sites: a ``socket.socket``/
+        ``create_connection``/``create_server``/``socketpair`` call
+        anywhere but ``cluster.py`` (the worker heartbeat/membership
+        channel) or ``telemetry/stream.py`` (the length-prefixed-JSON
+        metric stream).  An ad-hoc socket bypasses the framing, the
+        bounded-queue backpressure, the drop accounting, and the
+        dead-peer degradation the control plane guarantees
+        (docs/observability.md "Live control plane"); name resolution
+        via ``utils/network.py`` is fine — only channel *creation* is
+        flagged, never a bare ``import socket``.  Tools and tests
+        drive sockets legitimately.
 
 Exit code 1 when any finding is reported.
 """
@@ -129,6 +141,20 @@ def _ad05_applies(path):
     return "autodist_tpu" in p.parts and p.name != _AD05_EXEMPT
 
 
+# AD06 applies inside the package only; cluster.py (worker heartbeat/
+# membership channel) and telemetry/stream.py (the metric stream) ARE
+# the transport layer.  Only channel creation is flagged — importing
+# socket for name resolution (utils/network.py) is fine.
+_AD06_EXEMPT = ("cluster.py", "stream.py")
+_AD06_CALLS = ("socket", "create_connection", "create_server",
+               "socketpair")
+
+
+def _ad06_applies(path):
+    p = Path(path)
+    return "autodist_tpu" in p.parts and p.name not in _AD06_EXEMPT
+
+
 class Checker(ast.NodeVisitor):
     def __init__(self, path, source):
         self.path = path
@@ -139,6 +165,7 @@ class Checker(ast.NodeVisitor):
         self._depth = 0        # function nesting: local imports aren't tracked
         self._all_names = set()  # strings listed in __all__
         self._subprocess_names = set()  # names imported from subprocess
+        self._socket_names = set()      # channel-creating names from socket
         self._flop_ctx = 0     # AD03: inside a flops-named def/assign
 
     def add(self, lineno, code, msg):
@@ -164,6 +191,8 @@ class Checker(ast.NodeVisitor):
                 continue
             if node.module == "subprocess":  # AD02 tracks the aliases
                 self._subprocess_names.add(a.asname or a.name)
+            if node.module == "socket" and a.name in _AD06_CALLS:
+                self._socket_names.add(a.asname or a.name)  # AD06 aliases
             self._record_import(a.asname or a.name, node.lineno)
 
     def visit_Name(self, node):
@@ -312,6 +341,22 @@ class Checker(ast.NodeVisitor):
                          "the Cluster layer (retry/backoff, TERM->KILL "
                          "escalation, monitor reaping); '# noqa' with a "
                          "justification for non-process-management uses")
+        # AD06: raw socket channel creation outside the transport layer
+        if _ad06_applies(self.path):
+            bare = (isinstance(f, ast.Attribute)
+                    and f.attr in _AD06_CALLS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "socket")
+            from_import = (isinstance(f, ast.Name)
+                           and f.id in self._socket_names)
+            if bare or from_import:
+                self.add(node.lineno, "AD06",
+                         "raw socket channel creation outside cluster.py/"
+                         "telemetry/stream.py: transport must route "
+                         "through the Cluster layer or the telemetry "
+                         "stream (length-prefixed framing, bounded-queue "
+                         "backpressure, drop accounting, dead-peer "
+                         "degradation — docs/observability.md)")
         # AD05: ad-hoc NaN/Inf screening of loss/grad values — online
         # numeric health detection must route through telemetry/health.py
         if (_ad05_applies(self.path)

@@ -1,0 +1,181 @@
+"""Live cluster status view (docs/observability.md "Live control plane").
+
+Usage::
+
+    python tools/monitor.py RUN_DIR [--once] [--interval S] [--json]
+    python tools/monitor.py --listen [HOST:PORT] [--interval S]
+
+Two sources, one render:
+
+- **RUN_DIR** — tail the growing telemetry run dir: the per-worker
+  JSONL manifests (plus rotated segments) and the ``events.jsonl``
+  cluster event log are re-read every ``--interval`` seconds and
+  replayed through a :class:`~autodist_tpu.telemetry.stream.ClusterView`
+  (record timestamps stand in for receive times), so the same per-worker
+  front-step / step-skew / health table the chief's live loop acts on is
+  what the operator sees.
+- **--listen** — ACT as the chief-side collector: bind the
+  length-prefixed-JSON stream socket (default
+  ``127.0.0.1:<DEFAULT_TELEMETRY_STREAM_PORT>``), point workers at it
+  via ``AUTODIST_TELEMETRY_STREAM``, and render the live view as frames
+  arrive.
+
+``--once`` renders a single frame and exits (the CI path —
+``tools/monitor_check.py`` drives it); default is to refresh until
+interrupted.  Exit status 1 when there is nothing to show.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _fmt_s(x):
+    if x is None:
+        return "-"
+    if x >= 1.0:
+        return f"{x:.2f}s"
+    return f"{x * 1e3:.1f}ms"
+
+
+def view_from_records(records):
+    """Replay manifest/stream-shaped records into a fresh ClusterView
+    (record ``t`` timestamps stand in for receive times)."""
+    from autodist_tpu.telemetry.stream import ClusterView
+
+    view = ClusterView()
+    for r in records:
+        kind = r.get("kind")
+        if kind == "meta":
+            # manifest meta carries the worker's address like a hello
+            view.ingest({"kind": "hello", "w": r.get("w", 0),
+                         "addr": r.get("addr"), "pid": r.get("pid")},
+                        recv_t=r.get("t"))
+        elif kind in ("step", "heartbeat", "health_finding",
+                      "runtime_finding", "gauge"):
+            view.ingest(r, recv_t=r.get("t"))
+    return view
+
+
+def render_view(snapshot, events=(), now=None):
+    """The status table: one row per worker, then skew + event tail."""
+    lines = []
+    add = lines.append
+    add(f"cluster view — {snapshot.get('frames', 0)} frame(s), "
+        f"front step {snapshot.get('front_step')}")
+    for w, e in sorted((snapshot.get("workers") or {}).items()):
+        add(f"  w{w} {e.get('addr') or '?':20s} "
+            f"step {str(e.get('last_step')):>5s} "
+            f"(behind {e.get('steps_behind')}) "
+            f"wall {_fmt_s(e.get('last_step_wall_s'))} "
+            f"age {_fmt_s(e.get('age_s'))} "
+            f"health {e.get('health')} "
+            f"findings {e.get('findings')}")
+    if snapshot.get("skew_s") is not None:
+        add(f"  skew {_fmt_s(snapshot['skew_s'])}"
+            + (f" — STRAGGLER {snapshot['straggler_addr']}"
+               if snapshot.get("straggler_addr") else ""))
+    events = list(events)
+    if events:
+        add(f"  events ({len(events)}):")
+        for e in events[-5:]:
+            cause = e.get("cause") or {}
+            add("    "
+                + (f"signal {e.get('signal')}" if e.get("event") == "signal"
+                   else str(e.get("event")))
+                + (f"@{e.get('step')}" if e.get("step") is not None else "")
+                + (f" worker={e.get('worker')}" if e.get("worker") else "")
+                + (f" <- {cause.get('signal')}({cause.get('worker')})"
+                   if cause else "")
+                + (f" latency {e['latency_s'] * 1e3:.1f}ms"
+                   if isinstance(e.get("latency_s"), (int, float)) else ""))
+    return "\n".join(lines)
+
+
+def _load_run_dir(path):
+    """(records, events, latest_t) off the run dir / manifest path."""
+    from autodist_tpu.telemetry import load_manifest_with_stats
+
+    try:
+        records, _ = load_manifest_with_stats(path)
+    except (OSError, ValueError):
+        records = []
+    events = [r for r in records if r.get("kind") == "cluster_event"]
+    ts = [r["t"] for r in records
+          if isinstance(r.get("t"), (int, float))]
+    return records, events, (max(ts) if ts else None)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", default=None,
+                    help="telemetry run dir (or manifest.jsonl) to tail")
+    ap.add_argument("--listen", nargs="?", const="", default=None,
+                    metavar="HOST:PORT",
+                    help="act as the live stream collector instead of "
+                         "tailing files (default bind: 127.0.0.1:"
+                         "DEFAULT_TELEMETRY_STREAM_PORT)")
+    ap.add_argument("--once", action="store_true",
+                    help="render a single frame and exit (CI path)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds (default 1)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the snapshot as JSON instead of the table")
+    args = ap.parse_args(argv)
+    if (args.path is None) == (args.listen is None):
+        ap.error("pass a run dir to tail OR --listen, not both/neither")
+
+    collector = None
+    if args.listen is not None:
+        from autodist_tpu.const import DEFAULT_TELEMETRY_STREAM_PORT
+        from autodist_tpu.telemetry.stream import TelemetryCollector
+
+        host, _, port = (args.listen or "").rpartition(":")
+        collector = TelemetryCollector(
+            host=host or "127.0.0.1",
+            port=int(port) if port else DEFAULT_TELEMETRY_STREAM_PORT)
+        bound = collector.start()
+        print(f"listening on {bound} "
+              f"(point workers via AUTODIST_TELEMETRY_STREAM)",
+              file=sys.stderr)
+
+    shown = False
+    try:
+        while True:
+            if collector is not None:
+                snapshot, events = collector.view.snapshot(), []
+            else:
+                records, events, latest_t = _load_run_dir(args.path)
+                if not records:
+                    print(f"(no records under {args.path})",
+                          file=sys.stderr)
+                    if args.once:
+                        return 1
+                    time.sleep(args.interval)
+                    continue
+                view = view_from_records(records)
+                snapshot = view.snapshot(now=latest_t)
+            shown = True
+            if args.json:
+                print(json.dumps({"view": snapshot,
+                                  "events": events[-20:]}, indent=2),
+                      flush=True)
+            else:
+                print(render_view(snapshot, events), flush=True)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0 if shown else 1
+    finally:
+        if collector is not None:
+            collector.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+"""CI gate: the live control plane works on a CPU mesh (``make
+monitor-check``, wired into ``make check``).
+
+Asserts the acceptance contract of the streaming telemetry channel
+end-to-end, without a real accelerator:
+
+1. a chief-side :class:`~autodist_tpu.telemetry.stream.TelemetryCollector`
+   receives a telemetry-enabled session's frames over the
+   length-prefixed-JSON socket (``AUTODIST_TELEMETRY_STREAM`` contract):
+   the live ClusterView names the worker, tracks its front step, and saw
+   heartbeats;
+2. a causal :class:`~autodist_tpu.telemetry.events.ClusterEventLog`
+   mirrored to ``events.jsonl`` is folded into the merged manifest and
+   validates under schema v3, and the reaction audit over it emits a
+   clean E005 causality table;
+3. ``tools/monitor.py --once`` renders the run dir and
+   ``tools/telemetry_report.py --follow`` tails it without a finalized
+   summary trailer;
+4. a DEAD collector degrades gracefully: the publisher goes dead with a
+   counted warning, drops (never blocks, never raises), and the
+   file-only manifest path still validates.
+"""
+import contextlib
+import io
+import os
+import sys
+import tempfile
+import time
+
+# CPU mesh, no real accelerator needed — must precede any jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4").strip()
+os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+STEPS = 5
+
+
+def _run_session(run_dir, steps=STEPS):
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import telemetry
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    telemetry.enable(run_dir=run_dir)
+    rs = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rs.randn(12, 3), jnp.float32),
+              "b": jnp.zeros((3,), jnp.float32)}
+
+    def loss(p, b):
+        return jnp.mean((b @ p["w"] + p["b"]) ** 2)
+
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(4),
+                  strategy_builder=AllReduce())
+    sess = ad.distribute(loss, params, optax.sgd(0.1))
+    batch = rs.randn(16, 12).astype(np.float32)
+    sess.run_steps([batch] * steps)
+    return sess
+
+
+def main():
+    from autodist_tpu import telemetry
+    from autodist_tpu.analysis.reaction_audit import reaction_audit
+    from autodist_tpu.telemetry.events import (EVENTS_NAME,
+                                               ClusterEventLog)
+    from autodist_tpu.telemetry.metrics import JsonlWriter
+    from autodist_tpu.telemetry.stream import TelemetryCollector
+    from tools import monitor
+    from tools.telemetry_report import follow
+
+    problems = []
+    run_dir = tempfile.mkdtemp(prefix="monitor_check_")
+
+    # 1. live stream: collector up, session pointed at it via env
+    collector = TelemetryCollector()
+    os.environ["AUTODIST_TELEMETRY_STREAM"] = collector.start()
+
+    # 2. a causal event pair mirrored to events.jsonl BEFORE the session
+    #    finalizes, so the chief merge folds it into manifest.jsonl
+    log = ClusterEventLog(writer=JsonlWriter(
+        os.path.join(run_dir, EVENTS_NAME), worker=0))
+    cause = log.note_signal("straggler", worker="10.0.0.2", step=2,
+                            code="T002", persistent=True, skew_s=0.3)
+    log.record("hook_fired", step=2, hook="on_straggler",
+               worker="10.0.0.2", cause=cause)
+    log.close()
+
+    try:
+        sess = _run_session(run_dir)
+    finally:
+        os.environ.pop("AUTODIST_TELEMETRY_STREAM", None)
+
+    # the publisher flushed on finalize; give the reader thread a beat
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if (collector.view.last_steps().get(0) or 0) >= STEPS - 1:
+            break
+        time.sleep(0.05)
+    snap = collector.view.snapshot()
+    w0 = (snap.get("workers") or {}).get(0)
+    if not w0:
+        problems.append("collector never saw worker 0")
+    else:
+        if (w0.get("last_step") or 0) < STEPS - 1:
+            problems.append(f"live view front step {w0.get('last_step')} "
+                            f"< {STEPS - 1}")
+        if w0.get("heartbeat_age_s") is None:
+            problems.append("live view saw no heartbeat frame")
+    if collector.frames <= 0:
+        problems.append("collector received no frames")
+    st = sess._telemetry.stream.stats() if sess._telemetry.stream else {}
+    if not st.get("sent"):
+        problems.append(f"publisher sent nothing: {st}")
+    collector.stop()
+
+    # 3. merged manifest: schema v3 with the cluster events folded in
+    manifest = os.path.join(run_dir, "manifest.jsonl")
+    records, errors = telemetry.validate_manifest(manifest,
+                                                  require_steps=True)
+    if errors:
+        problems.extend(f"schema: {e}" for e in errors[:5])
+    cluster_events = [r for r in records
+                      if r.get("kind") == "cluster_event"]
+    if len(cluster_events) < 2:
+        problems.append(f"merged manifest holds {len(cluster_events)} "
+                        f"cluster_event record(s), expected the "
+                        f"signal+action pair")
+    findings = reaction_audit(cluster_events)
+    codes = {f.code for f in findings}
+    if "E005" not in codes:
+        problems.append(f"reaction audit emitted no E005 table ({codes})")
+    if codes & {"E001", "E002", "E003", "E004"}:
+        problems.append(f"reaction audit flagged the clean control run: "
+                        f"{sorted(codes)}")
+
+    # 4. the operator views render the same run dir
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = monitor.main([run_dir, "--once"])
+    if rc != 0 or "cluster view" not in buf.getvalue():
+        problems.append(f"monitor --once failed (rc {rc})")
+    buf = io.StringIO()
+    if follow(run_dir, interval_s=0.01, max_updates=2, out=buf) != 2 \
+            or "live:" not in buf.getvalue():
+        problems.append("telemetry_report --follow rendered nothing")
+
+    # 5. dead collector: the publisher must degrade to file-only with a
+    #    counted warning — never block, never raise
+    run_dir2 = tempfile.mkdtemp(prefix="monitor_check_dead_")
+    os.environ["AUTODIST_TELEMETRY_STREAM"] = "127.0.0.1:9"  # nothing listens
+    try:
+        sess2 = _run_session(run_dir2, steps=3)
+    finally:
+        os.environ.pop("AUTODIST_TELEMETRY_STREAM", None)
+    st2 = sess2._telemetry.stream.stats() if sess2._telemetry.stream \
+        else None
+    if not st2 or not st2.get("dead"):
+        problems.append(f"dead-collector publisher not marked dead: {st2}")
+    elif not st2.get("dropped"):
+        problems.append(f"dead-collector publisher counted no drops: {st2}")
+    _, errors2 = telemetry.validate_manifest(
+        os.path.join(run_dir2, "manifest.jsonl"), require_steps=True)
+    if errors2:
+        problems.append(f"file-only path broke under a dead collector: "
+                        f"{errors2[:3]}")
+
+    if problems:
+        print(f"FAIL: {run_dir}")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"OK: live view tracked worker 0 to step {w0['last_step']} "
+          f"({collector.frames} frame(s), heartbeat seen); "
+          f"{len(cluster_events)} cluster event(s) merged + schema-valid; "
+          f"monitor/--follow render; dead collector dropped "
+          f"{st2['dropped']} frame(s) file-only ({manifest})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

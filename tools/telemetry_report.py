@@ -44,6 +44,12 @@ Merge hygiene: when the per-worker manifests are merged (or a chief
 manifest is parsed), lines the reader skipped (torn writes) and
 duplicate records dropped are surfaced as ``merge_hygiene`` — nonzero
 counts mean the manifest needs attention before its numbers are trusted.
+
+Live runs: ``--follow`` tails a GROWING run dir (per-worker manifests
+plus the ``events.jsonl`` cluster event log) and re-renders a compact
+status line every ``--interval`` seconds — no finalized summary trailer
+is required, so it works mid-run; ``--max-updates N`` bounds the loop
+for CI (default: until interrupted).
 """
 import argparse
 import json
@@ -526,9 +532,101 @@ def render_health(health_findings, regression_findings, summary=None):
     return "\n".join(lines)
 
 
+def render_live(records, stats=None):
+    """One compact status block for a GROWING manifest (no summary
+    trailer required): per-worker front step, wall p50 so far, health
+    counts, and the tail of the cluster event log."""
+    lines = []
+    steps = [r for r in records if r.get("kind") == "step"]
+    events = [r for r in records if r.get("kind") == "cluster_event"]
+    by_worker = {}
+    for r in steps:
+        w = r.get("w", 0)
+        if isinstance(r.get("step"), (int, float)):
+            by_worker[w] = max(by_worker.get(w, -1), int(r["step"]))
+    walls = [r.get("wall_cancelled_s", r.get("wall_s"))
+             for r in steps if r.get("step") not in (0, None)]
+    walls = [w for w in walls if w is not None]
+    p50 = percentiles(walls)[0.5] if walls else None
+    front = max(by_worker.values()) if by_worker else None
+    lines.append(
+        f"live: {len(steps)} step record(s), front step {front}, "
+        f"workers " + (", ".join(
+            f"w{w}@{s}" for w, s in sorted(by_worker.items()))
+            if by_worker else "-")
+        + (f", wall p50 {_fmt_s(p50)}" if p50 is not None else ""))
+    health = {}
+    for r in records:
+        if r.get("kind") == "health_finding":
+            health[r.get("check")] = health.get(r.get("check"), 0) + 1
+    if health:
+        lines.append("  health: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(health.items())))
+    if events:
+        by_event = {}
+        for e in events:
+            by_event[e.get("event")] = by_event.get(e.get("event"), 0) + 1
+        lines.append(f"  events: {len(events)} (" + ", ".join(
+            f"{k}={v}" for k, v in sorted(by_event.items())) + ")")
+        for e in events[-3:]:
+            cause = e.get("cause") or {}
+            lines.append(
+                f"    {e.get('event')}"
+                + (f"@{e.get('step')}" if e.get("step") is not None
+                   else "")
+                + (f" signal={e.get('signal')}"
+                   if e.get("event") == "signal" else "")
+                + (f" <- {cause.get('signal')}({cause.get('worker')})"
+                   if cause else "")
+                + (f" latency {e['latency_s'] * 1e3:.1f}ms"
+                   if isinstance(e.get("latency_s"), (int, float))
+                   else ""))
+    if stats and (stats.get("skipped_lines") or stats.get("rotated_files")):
+        lines.append(f"  hygiene: {stats.get('skipped_lines', 0)} torn "
+                     f"line(s), {stats.get('rotated_files', 0)} rotated "
+                     f"segment(s)")
+    return "\n".join(lines)
+
+
+def follow(path, interval_s=1.0, max_updates=None, out=None):
+    """Tail a growing run dir / manifest: re-read and re-render every
+    ``interval_s`` until interrupted (or ``max_updates`` renders).
+    Returns the number of renders."""
+    import time as _time
+
+    out = out or sys.stdout
+    n = 0
+    try:
+        while True:
+            try:
+                records, stats = load_manifest_with_stats(path)
+            except (OSError, ValueError):
+                records, stats = [], {}
+            if records:
+                print(render_live(records, stats), file=out, flush=True)
+            else:
+                print(f"(waiting for records under {path})", file=out,
+                      flush=True)
+            n += 1
+            if max_updates is not None and n >= max_updates:
+                return n
+            _time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return n
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("path", help="telemetry run dir or manifest.jsonl")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail a GROWING run dir: re-render a compact "
+                         "live status every --interval seconds (no "
+                         "finalized summary trailer needed)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="--follow refresh period in seconds (default 1)")
+    ap.add_argument("--max-updates", type=int, default=None,
+                    help="stop --follow after N renders (default: until "
+                         "interrupted)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of text")
     ap.add_argument("--audit", default=None,
@@ -558,6 +656,10 @@ def main(argv=None):
                          "records/baselines or a JSON path; default: "
                          "look one up by the run id)")
     args = ap.parse_args(argv)
+    if args.follow:
+        follow(args.path, interval_s=args.interval,
+               max_updates=args.max_updates)
+        return 0
     records, stats = load_manifest_with_stats(args.path)
     if not records:
         print(f"no telemetry records under {args.path}", file=sys.stderr)

@@ -39,6 +39,16 @@ Targets:
   ``--selftest``, the golden fixtures under ``tests/data/regression``
   must fire R001 on the seeded slow manifest and R002 on the NaN
   manifest while the control stays clean.
+- ``--events [EVENTS_JSONL]`` — run the CONTROL-PLANE reaction tier
+  (E-codes) over a causal cluster event log (the ``events.jsonl`` the
+  :class:`~autodist_tpu.telemetry.events.ClusterEventLog` mirrors, or a
+  merged manifest holding ``cluster_event`` records): a persistent
+  signal nobody acted on is E001, a reaction past the MTTR budget E002,
+  a throughput-regressing re-plan E003, a heartbeat gap without a
+  membership event E004 — and every audited log must emit its E005
+  event/causality table; with ``--selftest``, the golden fixtures under
+  ``tests/data/events`` must fire E001 on the unacted log and E002 on
+  the slow-MTTR log while the control stays clean.
 - ``--runtime [TRACE_DIR]`` — run the RUNTIME audit tier (T-codes): a
   ``jax.profiler`` chrome-trace capture is parsed, its collective
   events matched against the strategy's intended channel table, and
@@ -158,6 +168,14 @@ def main(argv=None):
                          "(R-codes): diff each record against its "
                          "blessed baseline in records/baselines/; every "
                          "target must emit its R006 table")
+    ap.add_argument("--events", nargs="?", const="", default=None,
+                    metavar="EVENTS_JSONL",
+                    help="also run the CONTROL-PLANE reaction tier "
+                         "(E-codes) over a causal cluster event log: "
+                         "unacted persistent signals are E001, "
+                         "reactions past the MTTR budget E002; every "
+                         "audited log must emit its E005 causality "
+                         "table")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write all reports as JSON to this path")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -165,9 +183,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     _force_cpu_devices()
-    from autodist_tpu.analysis import (LOWERED_PASSES, REGRESSION_PASSES,
-                                       RUNTIME_PASSES, STATIC_PASSES,
-                                       TRACE_PASSES, verify_strategy)
+    from autodist_tpu.analysis import (EVENT_PASSES, LOWERED_PASSES,
+                                       REGRESSION_PASSES, RUNTIME_PASSES,
+                                       STATIC_PASSES, TRACE_PASSES,
+                                       verify_strategy)
     from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
                                              EXPECTED_DONATION_CODE,
                                              EXPECTED_ERROR_CODES,
@@ -207,7 +226,16 @@ def main(argv=None):
         base = passes if passes is not None else \
             STATIC_PASSES + TRACE_PASSES
         passes = base + REGRESSION_PASSES
+    if args.events is not None:
+        base = passes if passes is not None else \
+            STATIC_PASSES + TRACE_PASSES
+        passes = base + EVENT_PASSES
     trace_dir = args.runtime or None
+    event_records = None
+    if args.events:
+        from autodist_tpu.telemetry.events import load_events
+
+        event_records = load_events(args.events)
     # with a lowered compute pass selected, every record target must
     # produce its machine-readable F006 compute table
     want_f006 = bool(passes) and "compute-audit" in passes
@@ -217,8 +245,29 @@ def main(argv=None):
     # with the regression tier selected, every record target must produce
     # its machine-readable R006 run-vs-baseline table
     want_r006 = bool(passes) and "regression-audit" in passes
+    # with the reaction tier selected, every audited event log must
+    # produce its machine-readable E005 event/causality table
+    want_e005 = bool(passes) and "reaction-audit" in passes
     results = {}
     failed = False
+
+    if args.events:
+        # a standalone event-log target: audit the log itself, with or
+        # without record targets alongside
+        from autodist_tpu.analysis.reaction_audit import \
+            audit_fixture as reaction_fixture
+        from autodist_tpu.analysis.report import Report
+
+        findings = reaction_fixture(args.events)
+        report = Report(strategy_id="cluster-events")
+        report.extend(findings)
+        results[args.events] = report
+        _print_report(os.path.basename(args.events), report, args.verbose)
+        failed = failed or not report.ok
+        if not any(f.code == "E005" for f in findings):
+            print(f"[ERROR] {os.path.basename(args.events)}: reaction "
+                  f"audit produced no E005 table")
+            failed = True
 
     for path in args.targets:
         try:
@@ -245,10 +294,18 @@ def main(argv=None):
             if stem.endswith(".json"):
                 stem = stem[:-len(".json")]
             case["current_metrics"] = {"name": stem}
-        report = verify_strategy(passes=passes, trace_dir=trace_dir, **case)
+        report = verify_strategy(passes=passes, trace_dir=trace_dir,
+                                 event_records=event_records, **case)
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
         failed = failed or not report.ok
+        if want_e005:
+            e5 = next((f for f in report.findings if f.code == "E005"),
+                      None)
+            if e5 is None:
+                print(f"[ERROR] {os.path.basename(path)}: reaction "
+                      f"audit produced no E005 table")
+                failed = True
         if want_r006:
             r6 = next((f for f in report.findings if f.code == "R006"),
                       None)
@@ -395,6 +452,49 @@ def main(argv=None):
                     else:
                         print("regression selftest passed: the control "
                               "stays clean with its R006 table")
+        if args.events is not None:
+            # the golden event-log fixtures (tests/data/events): the
+            # persistently-ignored straggler must fire E001, the
+            # 9-second membership reaction must fire E002 (MTTR budget
+            # 5s), and the promptly-hooked control must stay clean with
+            # its E005 causality table
+            from autodist_tpu.analysis.reaction_audit import \
+                audit_fixture as reaction_fixture
+            from autodist_tpu.analysis.report import Report
+
+            fixdir = os.path.join(REPO, "tests", "data", "events")
+            checks = (
+                ("unacted", "unacted.jsonl", "E001"),
+                ("slow-mttr", "slow_mttr.jsonl", "E002"),
+                ("control", "clean.jsonl", None),
+            )
+            for label, fname, want in checks:
+                findings = reaction_fixture(os.path.join(fixdir, fname))
+                report = Report()
+                report.extend(findings)
+                results[f"<reaction-{label}-selftest>"] = report
+                _print_report(f"reaction selftest ({label})", report,
+                              args.verbose)
+                codes = {f.code for f in findings}
+                if want is not None:
+                    if want not in codes:
+                        print(f"[ERROR] reaction selftest ({label}): "
+                              f"expected {want} did not fire "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print(f"reaction selftest passed: the {label} "
+                              f"fixture fires {want}")
+                else:
+                    bad = codes & {"E001", "E002", "E003", "E004"}
+                    if bad or "E005" not in codes:
+                        print(f"[ERROR] reaction selftest (control): "
+                              f"expected a clean E005 "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print("reaction selftest passed: the control "
+                              "stays clean with its E005 table")
         if args.runtime is not None:
             # the golden trace fixtures (tests/data/trace): the
             # exposed-comm step must be caught as T001, the skewed
