@@ -432,15 +432,21 @@ def audit_collectives(ops: List[CollectiveOp], channels: List[Channel], *,
         cands = [c for c in channels if c.admits(op)]
         if cands:
             # best-fit assignment: prefer channels whose declared groups
-            # match the op's layout, that still NEED bytes, and whose
-            # remaining need is closest to the op's volume — a large
-            # channel's tolerance slack must not swallow a smaller
-            # channel's only collective (which would misreport X002)
+            # match the op's layout, that still NEED bytes, where the op
+            # FITS the remaining need (a channel covers several ops — a
+            # multi-op channel's half-volume collective must not land on
+            # a smaller channel just because the totals are closer), and
+            # whose remaining need is then closest to the op's volume —
+            # a large channel's tolerance slack must not swallow a
+            # smaller channel's only collective (which would misreport
+            # X002)
             def score(c):
                 grp_ok = (not c.group_sizes or not op.group_size
                           or op.group_size in c.group_sizes)
                 need = c.bytes - c.realized
-                return (grp_ok, need > 0, -abs(need - op.total_bytes))
+                fits = need >= op.total_bytes
+                return (grp_ok, need > 0, fits,
+                        -abs(need - op.total_bytes))
 
             best = max(cands, key=score)
             best.take(op)

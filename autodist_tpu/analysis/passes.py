@@ -58,6 +58,12 @@ Finding codes (stable; tests and tools match on them):
                tiny vars, or a coarser bucket group)
   Y009 INFO    sharded-update summary (shard↔mesh factorization, per-var
                padding plan, 1/R opt-state fraction)
+  Y010 ERROR   schedule_ir program is malformed (parse/grammar failure,
+               or references a mesh axis the strategy does not declare)
+  Y011 ERROR   schedule_ir places a block codec (int8) on a fast (non-DCN)
+               hop: block codecs are confined to the slow wire
+  Y012 INFO    searched-schedule summary (node count + the distinct
+               synthesized programs)
   X000 INFO    HLO audit skipped (no lowered module / no transformer)
   X001 ERROR   unintended (resharding) collective in the lowered module,
                absent from the strategy's plan
@@ -529,11 +535,47 @@ def hierarchy_pass(ctx):
     R = max(1, ctx.num_replicas)
     two_level_nodes = dcn_codecs = 0
     sharded_nodes = sharded_fallbacks = 0
+    searched_nodes = 0
+    searched_programs = set()
     for node in proto.node_config:
         for src in (node, *node.part_config):
             if src.WhichOneof("synchronizer") != "AllReduceSynchronizer":
                 continue
             ar = src.AllReduceSynchronizer
+            ir_text = getattr(ar, "schedule_ir", "")
+            if ir_text:
+                from autodist_tpu.kernel.synchronization import (
+                    schedule_ir as sir,
+                )
+
+                searched_nodes += 1
+                try:
+                    prog = sir.loads(ir_text)
+                    sir.validate_structure(prog)
+                except ValueError as e:
+                    findings.append(_f(
+                        Severity.ERROR, "Y010", "hierarchy",
+                        f"schedule_ir program {ir_text!r} is malformed: {e}",
+                        node.var_name))
+                    continue
+                missing = [a for ph in prog.phases for a in ph.axes
+                           if axis_sizes and a not in axis_sizes]
+                if missing:
+                    findings.append(_f(
+                        Severity.ERROR, "Y010", "hierarchy",
+                        f"schedule_ir program {ir_text!r} references mesh "
+                        f"axis(es) {sorted(set(missing))} the strategy does "
+                        f"not declare (mesh: {dict(axis_sizes)})",
+                        node.var_name))
+                for ph in sir.block_codec_violations(prog):
+                    findings.append(_f(
+                        Severity.ERROR, "Y011", "hierarchy",
+                        f"schedule_ir phase '{ph.op}@{'+'.join(ph.axes)}' "
+                        f"places a block codec on a fast (non-DCN) hop: "
+                        f"the int8 all_to_all recipe only pays off on the "
+                        f"slow wire, and the executor confines it there",
+                        node.var_name))
+                searched_programs.add(sir.dumps(prog))
             if ar.sharded_update:
                 sharded_nodes += 1
                 wire = (ar.dcn_compressor or ar.compressor
@@ -610,6 +652,15 @@ def hierarchy_pass(ctx):
             f"replica_dcn={axis_sizes[AXIS_REPLICA_DCN]} x "
             f"replica_ici={axis_sizes[AXIS_REPLICA_ICI]} "
             f"({dcn_codecs} with an explicit DCN-hop codec)", "mesh"))
+    if searched_nodes:
+        findings.append(_f(
+            Severity.INFO, "Y012", "hierarchy",
+            f"searched collective schedules: {searched_nodes} node(s) run "
+            f"synthesized programs "
+            f"{sorted(searched_programs) or '(all malformed)'} "
+            f"(strategy/schedule_search.py; canonical FLAT/TWO_LEVEL-shaped "
+            f"programs are normalized onto the legacy knobs by the engine)",
+            "mesh"))
     if sharded_nodes:
         factorization = (
             f"replica_dcn={axis_sizes.get(AXIS_REPLICA_DCN)} x "

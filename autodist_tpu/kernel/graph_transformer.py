@@ -119,11 +119,49 @@ class GraphTransformer:
                 ici=AXIS_REPLICA_ICI,
                 dcn=tuple(a for a in axes if a != AXIS_REPLICA_ICI))
         _AR = ar_sync._AR
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
         for name in self.names:
             plan = self.plans[name]
             if (plan.sync != SyncKind.ALL_REDUCE
                     or plan.placement != Placement.REPLICATED or plan.sparse):
                 continue
+            ir = getattr(plan, "schedule_ir", "")
+            if ir:
+                # searched collective schedule: validate against the mesh
+                # (the analysis hierarchy pass mirrors these checks as
+                # Y010/Y011), then normalize programs canonical to
+                # FLAT/TWO_LEVEL back to the legacy knobs so sharded-
+                # update composition and the per-hop channel accounting
+                # take the battle-tested paths
+                try:
+                    prog = sir.loads(ir)
+                    sir.validate(prog, data_axes=self.data_axes,
+                                 axis_sizes=mesh.shape)
+                except ValueError as e:
+                    raise ValueError(
+                        f"{name!r}: invalid schedule_ir: {e}") from None
+                kind = sir.canonical_hierarchy(prog)
+                core = sir.core_codec(prog)
+                if kind == _AR.FLAT:
+                    plan.schedule_ir = ""
+                    plan.hierarchy = _AR.FLAT
+                    plan.compressor = core
+                    plan.dcn_compressor = 0
+                elif (kind == _AR.TWO_LEVEL and self.hier_spec is not None
+                      and prog.phases[0].axes == (self.hier_spec.ici,)
+                      and set(prog.phases[1].axes) == set(self.hier_spec.dcn)
+                      and (core or not plan.compressor)):
+                    plan.schedule_ir = ""
+                    plan.hierarchy = _AR.TWO_LEVEL
+                    plan.dcn_compressor = core
+                else:
+                    # genuinely synthesized: the IR supersedes the
+                    # hierarchy knobs end to end; pin FLAT so no
+                    # two-level branch double-dips on these buckets
+                    plan.hierarchy = _AR.FLAT
+                    plan.dcn_compressor = 0
+                    continue
             h = plan.hierarchy
             if h == _AR.TWO_LEVEL and self.hier_spec is None:
                 raise ValueError(
@@ -254,8 +292,11 @@ class GraphTransformer:
 
     @property
     def sync_hierarchy(self):
-        """``"two_level"`` when any AR bucket uses the hierarchical
-        schedule, else ``"flat"``."""
+        """``"searched"`` when any AR bucket runs a synthesized schedule
+        IR, ``"two_level"`` when any uses the hierarchical schedule, else
+        ``"flat"``."""
+        if any(b.schedule_ir for b in self.buckets):
+            return "searched"
         return ("two_level" if any(
             b.hierarchy == ar_sync._AR.TWO_LEVEL for b in self.buckets)
             else "flat")
@@ -319,6 +360,8 @@ class GraphTransformer:
                "ici_hop_bytes": 0.0, "dcn_hop_bytes": 0.0,
                "flat_bytes": 0.0, "dcn_compressors": []}
         out["sharded_update"] = self.sync_sharded_update
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
         for b in self.buckets:
             item = _np.dtype(b.dtype).itemsize
             nbytes = b.total * item
@@ -327,6 +370,31 @@ class GraphTransformer:
             # (codec-scaled) + FRESH-PARAM gather (native dtype) replace
             # the gradient allreduce's two ring phases
             pbytes = b.padded_total * item if sharded else nbytes
+            if b.schedule_ir:
+                # synthesized schedule: bill each phase's wire volume to
+                # its bandwidth class (any DCN-class axis -> dcn hop)
+                prog = sir.loads(b.schedule_ir)
+                elems = b.total
+                for ph in prog.phases:
+                    g = sir.phase_group_size(ph, self.mesh.shape)
+                    wf_ph = wire_byte_factor(ph.codec, b.total)
+                    tgt = "dcn_hop_bytes" if ph.dcn else "ici_hop_bytes"
+                    if ph.op == "reduce_scatter":
+                        out[tgt] += (-(-elems // g) * g) * item * wf_ph
+                        elems = -(-elems // g)
+                    elif ph.op == "all_gather":
+                        out[tgt] += elems * g * item * wf_ph
+                        elems = elems * g
+                    elif ph.op == "ppermute_ring":
+                        out[tgt] += 2.0 * (g - 1) * (-(-elems // g)) \
+                            * item * wf_ph
+                    else:  # all_reduce core
+                        out[tgt] += elems * item * wf_ph
+                    if ph.dcn and ph.codec:
+                        name = get_compressor(ph.codec).name
+                        if name not in out["dcn_compressors"]:
+                            out["dcn_compressors"].append(name)
+                continue
             if b.hierarchy == _AR.TWO_LEVEL:
                 d = ar_sync.dcn_codec(b)
                 dcn_f = wire_byte_factor(d, b.total)
@@ -418,6 +486,44 @@ class GraphTransformer:
                         pbytes * wf * mult, "flat", (R,), in_scan)
                     add(f"{b.key}/param-gather", ("all_gather",),
                         pbytes, "flat", (R,))
+                continue
+            if b.schedule_ir:
+                # synthesized schedule: one channel per IR phase, volumes
+                # tracked through the running shard size, wire bytes
+                # scaled by each hop's codec — the X-audit pins whatever
+                # the search emitted, phase for phase
+                from autodist_tpu.kernel.synchronization import (
+                    schedule_ir as sir)
+                prog = sir.loads(b.schedule_ir)
+                elems = b.total
+                for i, ph in enumerate(prog.phases):
+                    g = int(sir.phase_group_size(ph, self.mesh.shape))
+                    phase = "dcn_hop" if ph.dcn else "ici_hop"
+                    wf = wire_byte_factor(ph.codec, b.total)
+                    if ph.op == "reduce_scatter":
+                        padded = -(-elems // g) * g
+                        add(f"{b.key}/p{i}-scatter", ("reduce_scatter",),
+                            padded * item * wf * mult, phase, (g,), in_scan)
+                        elems = -(-elems // g)
+                    elif ph.op == "all_gather":
+                        add(f"{b.key}/p{i}-gather", ("all_gather",),
+                            elems * g * item * wf * mult, phase, (g,),
+                            in_scan)
+                        elems *= g
+                    elif ph.op == "ppermute_ring":
+                        piece = -(-elems // g)
+                        add(f"{b.key}/p{i}-ring", ("collective_permute",),
+                            2.0 * (g - 1) * piece * item * wf * mult,
+                            phase, (), in_scan)
+                    elif ph.codec in (_AR.Int8Compressor,
+                                      _AR.Int8CompressorEF):
+                        add(f"{b.key}/p{i}-int8",
+                            ("all_to_all", "all_gather"),
+                            int8_bytes(elems, g) * mult, phase, (g,),
+                            in_scan)
+                    else:
+                        add(f"{b.key}/p{i}-reduce", ("all_reduce",),
+                            elems * item * wf * mult, phase, (g,), in_scan)
                 continue
             if b.hierarchy == _AR.TWO_LEVEL:
                 shard = -(-b.total // R_ici)

@@ -85,6 +85,11 @@ class VarPlan:
     # style: reduce-scatter grads -> per-shard update on the flat padded
     # 1/R shard, opt state permanently sharded -> all-gather fresh params)
     sharded_update: int = 0
+    # serialized collective-schedule IR (schedule_ir.dumps format); ""
+    # = follow `hierarchy`.  Canonical FLAT/TWO_LEVEL-shaped programs are
+    # normalized back into `hierarchy`/`dcn_compressor` by the
+    # transformer; genuinely searched programs run through run_schedule
+    schedule_ir: str = ""
     # PS fields
     ps_sync: bool = True
     staleness: int = 0
@@ -198,6 +203,7 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
             plan.hierarchy = ar.hierarchy
             plan.dcn_compressor = ar.dcn_compressor
             plan.sharded_update = ar.sharded_update
+            plan.schedule_ir = ar.schedule_ir
         else:
             logging.debug("Variable %s node has no synchronizer; AllReduce default", v.name)
 
@@ -256,6 +262,17 @@ def plan_sharded_update(plan):
         return False
     if plan.compressor not in ELEMENTWISE_CODECS:
         return False
+    if getattr(plan, "schedule_ir", ""):
+        # a synthesized phase chain has no update-matrix row layout to
+        # shard; only programs canonical to FLAT/TWO_LEVEL (which the
+        # transformer normalizes back to the hierarchy knob) decompose
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+        try:
+            prog = sir.loads(plan.schedule_ir)
+        except ValueError:
+            return False
+        return (sir.canonical_hierarchy(prog) is not None
+                and sir.core_codec(prog) in ELEMENTWISE_CODECS)
     if plan.hierarchy != _AR.FLAT:
         if (plan.dcn_compressor or plan.compressor) not in ELEMENTWISE_CODECS:
             return False

@@ -60,8 +60,21 @@ Orthogonal to both, each bucket carries a WEIGHT-UPDATE mode
   wire codecs decompose into the scatter — the codec applies to the
   GRADIENT legs only; param gathers ride the native dtype (a compressed
   param gather would let replicas drift).
+
+Since the searched-schedule PR, FLAT and TWO_LEVEL are the two canonical
+programs of a serializable **schedule IR** (``schedule_ir.py``): an ordered
+phase list ``(op, axis_group, codec)`` executed by :func:`run_schedule` —
+a reduce-scatter prefix, an optional core (codec ``all_reduce`` or a
+``ppermute_ring`` bandwidth-optimal ring), and a mirrored all-gather
+suffix, with per-hop wire codecs routed through the fused
+``encode -> collective -> decode`` helper :func:`fused_wire_hop`
+(EQuARX-style, arXiv 2506.17615).  ``AllReduceSynchronizer.schedule_ir``
+carries a synthesized program verbatim (``strategy/schedule_search.py``
+enumerates and prices them); buckets without one lower their hierarchy
+knob to the canonical program, so both paths share one executor.
 """
 import dataclasses
+import hashlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -116,11 +129,17 @@ def dcn_codec(bucket) -> int:
 
 
 def wire_codec(bucket) -> int:
-    """The codec whose state the bucket carries: under TWO_LEVEL the only
-    wire transform is the DCN-hop codec (ICI phases are codec-free); flat
-    buckets use their own compressor.  PowerSGD never decomposes — a
-    PowerSGD bucket is realized flat regardless of the hierarchy knob
-    (the transformer normalizes it; see ``GraphTransformer``)."""
+    """The codec whose state the bucket carries: a schedule-IR bucket
+    carries its CORE phase's codec (hop codecs are stateless by the IR
+    grammar); under TWO_LEVEL the only wire transform is the DCN-hop
+    codec (ICI phases are codec-free); flat buckets use their own
+    compressor.  PowerSGD never decomposes — a PowerSGD bucket is
+    realized flat regardless of the hierarchy knob (the transformer
+    normalizes it; see ``GraphTransformer``)."""
+    ir = getattr(bucket, "schedule_ir", "")
+    if ir:
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+        return sir.core_codec(sir.loads(ir))
     if (bucket.hierarchy == _AR.TWO_LEVEL
             and bucket.compressor != _AR.PowerSGDCompressor):
         return dcn_codec(bucket)
@@ -135,7 +154,14 @@ def elementwise(bucket) -> bool:
     accumulated barrier reduce up to rounding.  Block codecs (int8
     blocks, PowerSGD factors) applied to PARTIAL gradients — or to
     per-chunk re-blockings — compute a genuinely different approximation,
-    so those buckets sync whole, once, on the accumulated gradient."""
+    so those buckets sync whole, once, on the accumulated gradient.  A
+    schedule-IR bucket is elementwise when every phase codec is."""
+    ir = getattr(bucket, "schedule_ir", "")
+    if ir:
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+        prog = sir.loads(ir)
+        return (all(ph.codec in _ELEMENTWISE_CODECS for ph in prog.phases)
+                and bucket.compressor in _ELEMENTWISE_CODECS)
     return wire_codec(bucket) in _ELEMENTWISE_CODECS \
         and bucket.compressor in _ELEMENTWISE_CODECS
 
@@ -163,6 +189,10 @@ class Bucket:
     # length ceil(size / num_shards) — the per-var padding plan
     num_shards: int = 1
     shard_sizes: tuple = ()
+    # serialized schedule IR (schedule_ir.dumps format); non-empty on
+    # synthesized-schedule buckets — the executor runs the phases
+    # verbatim and `hierarchy`/`dcn_compressor` are ignored
+    schedule_ir: str = ""
 
     @property
     def total(self):
@@ -199,17 +229,20 @@ def plan_buckets(plans, var_shapes, var_dtypes,
         if plan.sparse:
             continue
         key = (plan.group, str(var_dtypes[name]), plan.compressor,
-               plan.hierarchy, plan.dcn_compressor, plan.sharded_update)
+               plan.hierarchy, plan.dcn_compressor, plan.sharded_update,
+               getattr(plan, "schedule_ir", ""))
         groups.setdefault(key, []).append(name)
     buckets = []
     R = max(1, int(num_replicas))
-    for (group, dtype, comp, hier, dcn, shup), names in sorted(
+    for (group, dtype, comp, hier, dcn, shup, ir), names in sorted(
             groups.items(), key=lambda kv: kv[0]):
         # the key string keeps its pre-hierarchy format for FLAT buckets so
         # compressor-state checkpoints stay addressable
         suffix = f"_h{hier}_d{dcn}" if hier == _AR.TWO_LEVEL else ""
         if shup:
             suffix += f"_z{shup}"
+        if ir:
+            suffix += f"_s{hashlib.md5(ir.encode()).hexdigest()[:8]}"
         sizes = tuple(int(np.prod(var_shapes[n])) if var_shapes[n] else 1
                       for n in names)
         buckets.append(Bucket(
@@ -224,6 +257,7 @@ def plan_buckets(plans, var_shapes, var_dtypes,
             sharded_update=shup,
             num_shards=R if shup else 1,
             shard_sizes=tuple(-(-s // R) for s in sizes) if shup else (),
+            schedule_ir=ir,
         ))
     return buckets
 
@@ -234,8 +268,12 @@ def bucket_sharded(bucket) -> bool:
     transform is elementwise — a block codec's per-shard re-encoding
     would approximate differently from the barrier reduce, so those
     buckets keep the replicated update (the transformer normalizes the
-    plan; the analysis hierarchy pass warns with Y007)."""
+    plan; the analysis hierarchy pass warns with Y007).  Synthesized
+    (non-canonical) schedule-IR buckets never shard: their phase chain
+    has no row layout the optimizer shards could address — canonical
+    programs are normalized back to the hierarchy knob upstream."""
     return (bool(bucket.sharded_update) and bool(bucket.shard_sizes)
+            and not getattr(bucket, "schedule_ir", "")
             and elementwise(bucket))
 
 
@@ -274,8 +312,182 @@ def _unpack_bucket(b, reduced, grads_by_name, synced):
         off += sz
 
 
+def fused_wire_hop(collective, src, codec, state, offset=0):
+    """EQuARX-style fused ``encode -> collective -> decode`` wire hop: the
+    ONE replacement point for per-hop codecs (arXiv 2506.17615).  For the
+    bf16 family, casts a flat f32 view of ``src`` to bfloat16 (error-
+    feedback variant adds the ``state`` residual region at ``offset``
+    first and writes the new residual back there), runs ``collective`` on
+    the wire-dtype buffer of ``src``'s shape, and decodes the result to
+    f32.  Any other codec passes ``src`` through at native dtype (block
+    codecs own their collective recipe and never route through a hop).
+    Returns ``(collective output, new_state)``."""
+    if codec not in (_AR.BF16Compressor, _AR.BF16CompressorEF):
+        return collective(src), state
+    stateful = codec == _AR.BF16CompressorEF
+    flat = src.reshape(-1).astype(jnp.float32)
+    if stateful:
+        region = jax.lax.dynamic_slice_in_dim(state, offset, flat.shape[0])
+        corrected = flat + region
+    else:
+        corrected = flat
+    wire = corrected.astype(jnp.bfloat16)
+    if stateful:
+        new_state = jax.lax.dynamic_update_slice(
+            state, corrected - wire.astype(jnp.float32), (offset,))
+    else:
+        new_state = state
+    out = collective(wire.reshape(src.shape)).astype(jnp.float32)
+    return out, new_state
+
+
+def _axes_spec(axes):
+    """Collective ``axis_name`` argument for a phase axis group."""
+    return axes if len(axes) > 1 else axes[0]
+
+
+def _ppermute_ring_sum(buf, axis, codec):
+    """Bandwidth-optimal ring all-reduce (SUM) over one mesh axis as an
+    explicit ppermute program: ``g-1`` reduce-scatter steps each moving a
+    ``1/g`` chunk to the next device, then ``g-1`` all-gather steps
+    forwarding the completed chunks — ``2(g-1)/g`` of the buffer on the
+    wire per device, same as the factored reduce-scatter + all-gather
+    pair, but as one phase the schedule IR can place a codec on.  The
+    bf16 codec casts the whole buffer to the wire dtype for the ring and
+    decodes after (stateless by the IR grammar)."""
+    g = jax.lax.axis_size(axis)
+    if g == 1:
+        return buf
+    native = buf.dtype
+    work = buf.astype(jnp.bfloat16) if codec == _AR.BF16Compressor else buf
+    n = work.shape[0]
+    piece = -(-n // g)
+    acc = jnp.zeros((piece * g,), work.dtype).at[:n].set(work)
+    acc = acc.reshape(g, piece)
+    idx = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % g) for i in range(g)]
+    for s in range(g - 1):          # reduce-scatter phase
+        c_send = (idx - s) % g
+        chunk = jax.lax.dynamic_slice_in_dim(acc, c_send, 1, axis=0)
+        recv = jax.lax.ppermute(chunk, axis, perm)
+        c_recv = (idx - s - 1) % g
+        mine = jax.lax.dynamic_slice_in_dim(acc, c_recv, 1, axis=0)
+        acc = jax.lax.dynamic_update_slice(acc, mine + recv, (c_recv, 0))
+    # device idx now owns the fully-reduced chunk (idx + 1) % g
+    for s in range(g - 1):          # all-gather phase
+        c_send = (idx + 1 - s) % g
+        chunk = jax.lax.dynamic_slice_in_dim(acc, c_send, 1, axis=0)
+        recv = jax.lax.ppermute(chunk, axis, perm)
+        acc = jax.lax.dynamic_update_slice(acc, recv, ((idx - s) % g, 0))
+    out = acc.reshape(-1)[:n]
+    return out.astype(native) if codec == _AR.BF16Compressor else out
+
+
+def run_schedule(buf, state, bucket, program):
+    """Execute one schedule-IR program on a flat buffer; returns
+    ``(full mean, new_state)``.
+
+    The executor generalizes :func:`_two_level_reduce` to N phases:
+
+    1. each **reduce_scatter** phase pads the running buffer to a multiple
+       of its group size and scatters it (through the phase codec via
+       :func:`fused_wire_hop`), shrinking the buffer ``g``-fold; a
+       stateful core's residual is padded and sliced along the same
+       offsets (offset = group index x shard) so each device owns exactly
+       the region it will quantize;
+    2. the optional **core** runs the codec's own all-reduce recipe over
+       its axis group (returning the core-axes MEAN, as the compressor
+       protocol specifies), or the explicit :func:`_ppermute_ring_sum`
+       ring; dividing by the scattered group sizes then yields the full
+       mean — with no core, the scatter prefix already holds the full sum
+       and the division alone normalizes it;
+    3. each **all_gather** phase mirrors its scatter in reverse,
+       rebuilding (and unpadding) the full buffer, again through the
+       phase codec; residual regions write back outermost-last.
+
+    FLAT (:func:`flat_program <schedule_ir.flat_program>`) and TWO_LEVEL
+    (:func:`two_level_program <schedule_ir.two_level_program>`) reduce to
+    the legacy op sequences op-for-op, so the canonical programs are
+    bit-identical to the paths they replaced.
+    """
+    scatter, core, gathers = program.split()
+    comp = get_compressor(core.codec if core is not None
+                          else _AR.NoneCompressor)
+    stateful = core is not None and comp.stateful
+    cur = buf
+    st = state
+    lens = []       # pre-phase element counts, for the gather unpad
+    st_stack = []   # (st_pad, offset, orig_len) per stateful scatter phase
+    scatter_R = 1
+    for ph in scatter:
+        g = 1
+        for a in ph.axes:
+            g *= jax.lax.axis_size(a)
+        m = cur.shape[0]
+        shard = -(-m // g)
+        padded = jnp.zeros((shard * g,), cur.dtype).at[:m].set(cur)
+        spec = _axes_spec(ph.axes)
+        cur, _ = fused_wire_hop(
+            lambda w, spec=spec: jax.lax.psum_scatter(
+                w, spec, scatter_dimension=0, tiled=True),
+            padded, ph.codec, ())
+        lens.append(m)
+        scatter_R *= g
+        if stateful:
+            from autodist_tpu.parallel.collectives import axis_index
+            my = axis_index(spec)
+            st_pad = jnp.zeros((shard * g,), jnp.float32)
+            st_pad = st_pad.at[:st.shape[0]].set(st)
+            st_stack.append((st_pad, my * shard, st.shape[0]))
+            st = jax.lax.dynamic_slice_in_dim(st_pad, my * shard, shard)
+    if core is not None:
+        if core.op == "all_reduce":
+            cur, st = comp.all_reduce(cur, st, _axes_spec(core.axes))
+        else:
+            ring_g = jax.lax.axis_size(core.axes[0])
+            cur = _ppermute_ring_sum(cur, core.axes[0], core.codec) / ring_g
+    if scatter_R > 1:
+        cur = cur / scatter_R                                  # full mean
+    for ph, m in zip(gathers, reversed(lens)):
+        spec = _axes_spec(ph.axes)
+        out, _ = fused_wire_hop(
+            lambda w, spec=spec: jax.lax.all_gather(
+                w, spec, axis=0, tiled=True),
+            cur, ph.codec, ())
+        cur = out[:m]
+    if stateful:
+        new_state = st
+        for st_pad, off, orig in reversed(st_stack):
+            new_state = jax.lax.dynamic_update_slice(
+                st_pad, new_state, (off,))[:orig]
+    else:
+        new_state = state
+    return cur, new_state
+
+
+def bucket_program(bucket, axis_name, hier: Optional[HierAxes]):
+    """The bucket's collective program: an explicit ``schedule_ir`` runs
+    verbatim; otherwise the hierarchy knob lowers to its canonical IR
+    program (TWO_LEVEL -> scatter/core/gather over the factored mesh,
+    FLAT -> one all_reduce core over the data axes)."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    if bucket.schedule_ir:
+        return sir.loads(bucket.schedule_ir)
+    if bucket.hierarchy == _AR.TWO_LEVEL:
+        if hier is None:
+            raise ValueError(
+                f"bucket {bucket.key}: TWO_LEVEL hierarchy but no "
+                f"replica_dcn x replica_ici axes were supplied")
+        return sir.two_level_program(hier.ici, hier.dcn, dcn_codec(bucket))
+    axes = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
+        else (axis_name,)
+    return sir.flat_program(axes, bucket.compressor)
+
+
 def _two_level_reduce(buf, state, bucket, hier: HierAxes):
-    """Two-level mean of one flat buffer on a factored mesh:
+    """Two-level mean of one flat buffer on a factored mesh — the
+    canonical TWO_LEVEL program of :func:`run_schedule`:
 
     1. intra-slice **reduce-scatter** over the ICI sub-axis (native dtype,
        full precision) — every device ends up owning the slice-local SUM
@@ -285,37 +497,15 @@ def _two_level_reduce(buf, state, bucket, hier: HierAxes):
        transform of the schedule, applied where bandwidth is scarce;
     3. intra-slice **all-gather** over ICI rebuilds the full mean.
 
-    The codec returns the DCN-hop *mean* of the ICI partial sums, so a
-    final ``/ R_ici`` yields the full-axis mean.  Error-feedback codecs
-    keep their flat f32 residual at bucket size; each device slices the
-    region of the shard it quantizes (offset = ici index x shard) and
-    writes only that region back.
+    Error-feedback codecs keep their flat f32 residual at bucket size;
+    each device slices the region of the shard it quantizes (offset = ici
+    index x shard) and writes only that region back.
     """
-    comp = get_compressor(dcn_codec(bucket))
-    n = buf.shape[0]
-    R_ici = jax.lax.axis_size(hier.ici)
-    shard = -(-n // R_ici)
-    padded = jnp.zeros((shard * R_ici,), buf.dtype).at[:n].set(buf)
-    local = jax.lax.psum_scatter(padded, hier.ici, scatter_dimension=0,
-                                 tiled=True)                  # (shard,)
-    if comp.stateful:
-        my = jax.lax.axis_index(hier.ici)
-        st_pad = jnp.zeros((shard * R_ici,), jnp.float32)
-        st_pad = st_pad.at[:state.shape[0]].set(state)
-        st = jax.lax.dynamic_slice_in_dim(st_pad, my * shard, shard)
-    else:
-        st = state
-    dcn_axes = hier.dcn if len(hier.dcn) > 1 else hier.dcn[0]
-    reduced, new_st = comp.all_reduce(local, st, dcn_axes)
-    reduced = reduced / R_ici                                  # full mean
-    full = jax.lax.all_gather(reduced, hier.ici, axis=0, tiled=True)
-    if comp.stateful:
-        new_state = jax.lax.dynamic_update_slice(st_pad, new_st,
-                                                 (my * shard,))
-        new_state = new_state[:state.shape[0]]
-    else:
-        new_state = state
-    return full[:n], new_state
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    return run_schedule(buf, state, bucket,
+                        sir.two_level_program(hier.ici, hier.dcn,
+                                              dcn_codec(bucket)))
 
 
 def _pack_rows(flat, b):
@@ -374,30 +564,15 @@ def _scatter_two_level(grads_by_name, b, state, hier: HierAxes):
     local = jax.lax.psum_scatter(mat, hier.ici, scatter_dimension=0,
                                  tiled=True)                 # (R_dcn, S)
     codec = dcn_codec(b)
-    if codec in (_AR.BF16Compressor, _AR.BF16CompressorEF):
-        src = local.reshape(-1).astype(jnp.float32)
-        if comp.stateful:
-            my = jax.lax.axis_index(hier.ici)
-            region = jax.lax.dynamic_slice_in_dim(
-                state, my * R_dcn * S, R_dcn * S)
-            corrected = src + region
-        else:
-            corrected = src
-        wire = corrected.astype(jnp.bfloat16)
-        if comp.stateful:
-            new_state = jax.lax.dynamic_update_slice(
-                state, corrected - wire.astype(jnp.float32),
-                (my * R_dcn * S,))
-        else:
-            new_state = state
-        row = jax.lax.psum_scatter(wire.reshape(R_dcn, S), _dcn_tuple(hier),
-                                   scatter_dimension=0, tiled=True)
-        row = row.reshape(-1).astype(jnp.float32) / R
-    else:                       # NoneCompressor: native dtype end to end
-        row = jax.lax.psum_scatter(local, _dcn_tuple(hier),
-                                   scatter_dimension=0, tiled=True)
-        row = row.reshape(-1) / R
-        new_state = state
+    # the fused encode->collective->decode hop: EF residuals live in the
+    # padded row layout, each device's region starts at ici index x rows
+    offset = (jax.lax.axis_index(hier.ici) * R_dcn * S
+              if comp.stateful else 0)
+    row, new_state = fused_wire_hop(
+        lambda w: jax.lax.psum_scatter(w, _dcn_tuple(hier),
+                                       scatter_dimension=0, tiled=True),
+        local, codec, state, offset=offset)
+    row = row.reshape(-1) / R
     return row, new_state
 
 
@@ -413,24 +588,14 @@ def scatter_bucket(grads_by_name, b, state, axis_name, hier=None):
                 f"bucket {b.key}: TWO_LEVEL sharded update but no "
                 f"replica_dcn x replica_ici axes were supplied")
         return _scatter_two_level(grads_by_name, b, state, hier)
-    comp = get_compressor(wire_codec(b))
     codec = wire_codec(b)
     buf = _bucket_buf(grads_by_name, b)
     R = b.num_shards
-    if codec in (_AR.BF16Compressor, _AR.BF16CompressorEF):
-        src = buf.astype(jnp.float32)
-        corrected = src + state if comp.stateful else src
-        wire = corrected.astype(jnp.bfloat16)
-        new_state = (corrected - wire.astype(jnp.float32)
-                     if comp.stateful else state)
-        row = jax.lax.psum_scatter(_pack_rows(wire, b), axis_name,
-                                   scatter_dimension=0, tiled=True)
-        row = row.reshape(-1).astype(jnp.float32) / R
-    else:                       # NoneCompressor: native-dtype wire
-        row = jax.lax.psum_scatter(_pack_rows(buf, b), axis_name,
-                                   scatter_dimension=0, tiled=True)
-        row = row.reshape(-1) / R
-        new_state = state
+    row, new_state = fused_wire_hop(
+        lambda w: jax.lax.psum_scatter(_pack_rows(w, b), axis_name,
+                                       scatter_dimension=0, tiled=True),
+        buf, codec, state)
+    row = row.reshape(-1) / R
     return row, new_state
 
 
@@ -482,15 +647,11 @@ def shard_index(b, axis_name, hier=None):
 
 
 def _bucket_reduce(buf, state, bucket, axis_name, hier: Optional[HierAxes]):
-    """Reduce one flat buffer by the bucket's hierarchy: two-level on a
-    factored mesh, else the flat codec collective."""
-    if bucket.hierarchy == _AR.TWO_LEVEL:
-        if hier is None:
-            raise ValueError(
-                f"bucket {bucket.key}: TWO_LEVEL hierarchy but no "
-                f"replica_dcn x replica_ici axes were supplied")
-        return _two_level_reduce(buf, state, bucket, hier)
-    return get_compressor(bucket.compressor).all_reduce(buf, state, axis_name)
+    """Reduce one flat buffer by the bucket's collective program — a
+    synthesized schedule IR, or the canonical TWO_LEVEL/FLAT program of
+    the hierarchy knob; one executor either way."""
+    return run_schedule(buf, state, bucket,
+                        bucket_program(bucket, axis_name, hier))
 
 
 def sync_bucketed(grads_by_name, buckets, comp_states, axis_name, hier=None):

@@ -290,6 +290,51 @@ def _hier_factors(strategy, resource_spec, R):
     return 1, R
 
 
+def _schedule_ir_cost(prog, nbytes, R_dcn, R_ici, ici_bw, dcn_bw):
+    """Per-phase wire cost of a synthesized schedule program for one
+    ``nbytes``-sized gradient: ``(ici_bytes, dcn_bytes, seconds)``.
+
+    Generalizes the two-level ``hier_ici_s``/``hier_dcn_s`` terms to N
+    phases: scatter/gather phases pay a single ``(g-1)/g`` hop, cores pay
+    the full ``2(g-1)/g`` ring, each at the bandwidth class of its slowest
+    axis (``ph.dcn``) and scaled by the hop codec's wire-byte factor.
+    Everything is linear in bytes, so per-variable accumulation composes
+    with bucketing/overlap exactly like the legacy hier terms."""
+    from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+    from autodist_tpu.kernel.synchronization.compressor import wire_byte_factor
+
+    sizes = {AXIS_REPLICA_DCN: R_dcn, AXIS_REPLICA_ICI: R_ici}
+    ici_b = dcn_b = secs = 0.0
+    cur = float(nbytes)
+    for ph in prog.phases:
+        g = 1
+        for a in ph.axes:
+            g *= int(sizes.get(a, 1))
+        if g <= 1:
+            continue
+        wf = wire_byte_factor(ph.codec, 1)
+        bw = dcn_bw if ph.dcn else ici_bw
+        if ph.op == "reduce_scatter":
+            wire = cur * wf
+            secs += _gather_time(wire, g, bw)
+            cur /= g
+        elif ph.op == "all_gather":
+            cur *= g
+            wire = cur * wf           # all-gather bills result bytes
+            secs += _gather_time(wire, g, bw)
+        elif ph.op == "ppermute_ring":
+            wire = 2.0 * (g - 1) / g * cur * wf
+            secs += wire / bw
+        else:                         # all_reduce core
+            wire = cur * wf
+            secs += _ring_time(wire, g, bw)
+        if ph.dcn:
+            dcn_b += wire
+        else:
+            ici_b += wire
+    return ici_b, dcn_b, secs
+
+
 def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
              batch_per_chip=32, peak_flops=DEFAULT_PEAK_FLOPS,
              mxu_eff=DEFAULT_MXU_EFF, ici_gbps=DEFAULT_ICI_GBPS,
@@ -340,6 +385,10 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
     # ZeRO sharded-update flat wire: grad reduce-scatter (codec-scaled)
     # and fresh-param all-gather, each a single (n-1)/n phase
     shard_scatter_bytes = shard_gather_bytes = 0.0
+    # synthesized schedule-IR plans: per-phase pricing accumulates here,
+    # NOT into hier_* (those are re-priced through the two-level formulas
+    # below and would double-bill the searched phases)
+    searched_ici_bytes = searched_dcn_bytes = searched_s = 0.0
 
     ar_bytes = ps_bytes = gather_bytes = sparse_bytes = 0
     update_bytes = 0.0
@@ -408,9 +457,49 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
             _C = synchronizers_pb2.AllReduceSynchronizer
             if plan.schedule == _C.OVERLAP:
                 ar_overlap = True
+            ir_text = getattr(plan, "schedule_ir", "")
             ar_bucket_keys.add((plan.group, str(plan.dtype),
                                 plan.compressor, plan.hierarchy,
-                                plan.dcn_compressor, plan.sharded_update))
+                                plan.dcn_compressor, plan.sharded_update,
+                                ir_text))
+            # mirror the engine's IR normalization (graph_transformer):
+            # canonical FLAT/TWO_LEVEL-shaped programs collapse onto the
+            # legacy knobs; only genuinely synthesized programs take the
+            # per-phase pricing path
+            comp_enum = plan.compressor
+            dcn_enum = plan.dcn_compressor
+            prog = None
+            if ir_text:
+                from autodist_tpu.const import (AXIS_REPLICA_DCN,
+                                                AXIS_REPLICA_ICI)
+                from autodist_tpu.kernel.synchronization import (
+                    schedule_ir as _sir,
+                )
+
+                try:
+                    prog = _sir.loads(ir_text)
+                    kind = _sir.canonical_hierarchy(prog)
+                except ValueError:
+                    prog = kind = None  # malformed: Y010 flags it; price flat
+                if prog is not None:
+                    core = _sir.core_codec(prog)
+                    if kind == _C.FLAT:
+                        comp_enum = core
+                        prog = None
+                    elif (kind == _C.TWO_LEVEL and mesh_factored
+                          and prog.phases[0].axes == (AXIS_REPLICA_ICI,)
+                          and set(prog.phases[1].axes) == {AXIS_REPLICA_DCN}
+                          and (core or not plan.compressor)):
+                        dcn_enum = core
+                        prog = None
+            if prog is not None:
+                i_b, d_b, s_s = _schedule_ir_cost(
+                    prog, nbytes, R_dcn, R_ici,
+                    ici_gbps * 1e9 / 8, dcn_gbps * 1e9 / 8)
+                searched_ici_bytes += i_b
+                searched_dcn_bytes += d_b
+                searched_s += s_s
+                continue
             # wire factors keyed on the proto enum (not raw ints) so a
             # reordering in synchronizers.proto cannot skew rankings;
             # PowerSGD's factor depends on the bucket geometry
@@ -418,15 +507,15 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                 wire_byte_factor,
             )
 
-            comp_factor = wire_byte_factor(plan.compressor, max(1, v.size))
+            comp_factor = wire_byte_factor(comp_enum, max(1, v.size))
             # mirror the engine's hierarchy resolution: explicit TWO_LEVEL
             # or AUTO, on a factored mesh; PowerSGD never decomposes
             two_level = (mesh_factored
                          and plan.hierarchy != _C.FLAT
-                         and plan.compressor != _C.PowerSGDCompressor)
+                         and comp_enum != _C.PowerSGDCompressor)
             if two_level:
                 dcn_factor = wire_byte_factor(
-                    plan.dcn_compressor or plan.compressor, max(1, v.size))
+                    dcn_enum or comp_enum, max(1, v.size))
                 hier_ici_bytes += 2.0 * nbytes    # scatter + gather phases
                 if ar_sharded:
                     # ZeRO x two-level: the DCN hop pays the grad-shard
@@ -475,6 +564,7 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
                                  R_dcn, dcn_bw)
                       + _gather_time(hier_dcn_oneway_bytes, R_dcn, dcn_bw))
         comm_s += hier_ici_s + hier_dcn_s
+    comm_s += searched_s
     update_s = opt_bytes_factor * update_bytes / (hbm_gbps * 1e9)
     # overlap schedule (arXiv 2004.13336-style pipelining under the
     # latency-hiding scheduler): the per-bucket collectives hide behind
@@ -484,7 +574,7 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
     shard_scatter_s = _gather_time(shard_scatter_bytes, R, bw)
     shard_gather_s = _gather_time(shard_gather_bytes, R, bw)
     flat_ar_s = _ring_time(ar_bytes, R, bw)
-    ar_ring_s = (flat_ar_s + hier_ici_s + hier_dcn_s
+    ar_ring_s = (flat_ar_s + hier_ici_s + hier_dcn_s + searched_s
                  + shard_scatter_s + shard_gather_s)
     exposed_s = ar_ring_s / max(1, len(ar_bucket_keys))
     return CostEstimate(compute_s + update_s, comm_s, {
@@ -493,8 +583,11 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         "subset_ps_bytes": subset_ps_bytes, "subset_ps_s": subset_s,
         "hier_ici_bytes": hier_ici_bytes, "hier_dcn_bytes": hier_dcn_bytes,
         "hier_ici_s": hier_ici_s, "hier_dcn_s": hier_dcn_s,
-        "hier_replica_dcn": R_dcn if hier_ici_bytes else 1,
-        "hier_replica_ici": R_ici if hier_ici_bytes else R,
+        "hier_replica_dcn": R_dcn if hier_ici_bytes or searched_s else 1,
+        "hier_replica_ici": R_ici if hier_ici_bytes or searched_s else R,
+        "searched_ici_bytes": searched_ici_bytes,
+        "searched_dcn_bytes": searched_dcn_bytes,
+        "searched_s": searched_s,
         "sharded_scatter_bytes": shard_scatter_bytes,
         "sharded_gather_bytes": shard_gather_bytes,
         "sharded_scatter_s": shard_scatter_s,
@@ -521,8 +614,10 @@ def predicted_comm_bytes(est: "CostEstimate") -> dict:
         "flat": float(b.get("ar_bytes", 0.0)
                       + b.get("sharded_scatter_bytes", 0.0)
                       + b.get("sharded_gather_bytes", 0.0)),
-        "ici_hop": float(b.get("hier_ici_bytes", 0.0)),
-        "dcn_hop": float(b.get("hier_dcn_bytes", 0.0)),
+        "ici_hop": float(b.get("hier_ici_bytes", 0.0)
+                         + b.get("searched_ici_bytes", 0.0)),
+        "dcn_hop": float(b.get("hier_dcn_bytes", 0.0)
+                         + b.get("searched_dcn_bytes", 0.0)),
         "ps": float(b.get("ps_bytes", 0.0) + b.get("gather_bytes", 0.0)
                     + b.get("subset_ps_bytes", 0.0)),
         "sparse": float(b.get("sparse_bytes", 0.0)),
@@ -699,6 +794,8 @@ def builder_label(b):
     shup = getattr(b, "sharded_update", "replicated")
     if shup not in ("replicated", 0, None, False):
         tags.append("sharded")
+    if getattr(b, "schedule_ir", ""):
+        tags.append("searched")
     return name + (":" + ":".join(tags) if tags else "")
 
 

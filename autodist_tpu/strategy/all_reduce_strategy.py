@@ -12,7 +12,7 @@ handled by the sparse all-gather synchronizer, matching in capability.
 from autodist_tpu.proto import synchronizers_pb2
 from autodist_tpu.strategy.base import (Strategy, StrategyBuilder,
                                         resolve_compressor, resolve_hierarchy,
-                                        resolve_schedule,
+                                        resolve_schedule, resolve_schedule_ir,
                                         resolve_sharded_update)
 
 _SPECS = {
@@ -29,7 +29,7 @@ class AllReduce(StrategyBuilder):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO",
                  compressor="NoneCompressor", schedule="barrier",
                  hierarchy="auto", dcn_compressor=None,
-                 sharded_update="replicated"):
+                 sharded_update="replicated", schedule_ir=None):
         """``schedule="overlap"`` emits per-bucket collectives in reverse
         layer-topological order and compiles with XLA's latency-hiding
         scheduler so each bucket's reduce hoists behind remaining backward
@@ -61,6 +61,14 @@ class AllReduce(StrategyBuilder):
         (none/bf16/bf16-EF) decompose into the scatter; block-codec
         buckets keep the replicated update (docs/performance.md "Sharded
         weight update").
+
+        ``schedule_ir`` pins a synthesized collective-schedule program —
+        a serialized phase list ``"<op>@<axis>[:<codec>];..."`` (see
+        ``kernel/synchronization/schedule_ir.py``), usually emitted by
+        ``strategy/schedule_search``.  When set it supersedes
+        ``hierarchy``/``dcn_compressor``; canonical FLAT/TWO_LEVEL-shaped
+        programs are normalized back to those knobs by the engine
+        (docs/performance.md "Synthesized collective schedules").
         """
         if chunk_size < 1:
             raise ValueError("The chunk_size must be greater than zero")
@@ -76,6 +84,7 @@ class AllReduce(StrategyBuilder):
         self.dcn_compressor = dcn_compressor
         resolve_sharded_update(sharded_update)
         self.sharded_update = sharded_update
+        self.schedule_ir = resolve_schedule_ir(schedule_ir)
 
     def _fill_node(self, n, v, group):
         n.var_name = v.name
@@ -90,6 +99,8 @@ class AllReduce(StrategyBuilder):
         if self.dcn_compressor is not None:
             ar.dcn_compressor = resolve_compressor(self.dcn_compressor)
         ar.sharded_update = resolve_sharded_update(self.sharded_update)
+        if self.schedule_ir:
+            ar.schedule_ir = self.schedule_ir
 
     def make_graph_config(self, strategy, resource_spec):
         """Replicas + mesh, factored into ``replica_dcn x replica_ici``

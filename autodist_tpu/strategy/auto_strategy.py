@@ -53,6 +53,13 @@ def default_candidates(resource_spec=None):
                       sharded_update="sharded"),
             Parallax(hierarchy="two_level"),
         ]
+        # searched collective schedules: the sketch-constrained synthesizer's
+        # top programs (strategy/schedule_search.py) join the ranking — on
+        # asymmetric-bandwidth fabrics they beat both canonical hierarchies
+        # by placing codecs per hop (bf16 ICI phases + int8 DCN core)
+        from autodist_tpu.strategy.schedule_search import searched_candidates
+
+        cands += searched_candidates(resource_spec, top_k=2)
     return cands
 
 

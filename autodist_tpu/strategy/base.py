@@ -237,6 +237,34 @@ def resolve_sharded_update(name_or_value):
             f"{_enum_choices(_SHARDED_UPDATE_ALIASES)}") from None
 
 
+def resolve_schedule_ir(value):
+    """Normalize a user-facing ``schedule_ir`` knob — a serialized phase
+    list ``"<op>@<axis>[+<axis>...][:<codec>];..."`` (see
+    ``kernel/synchronization/schedule_ir.py``) or a parsed ``ScheduleIR``
+    — to its canonical serialized string, validating grammar and codec
+    placement at construction time.  ``None``/``""``/``0`` mean "follow
+    the hierarchy knob".  Unknown phase ops or codecs raise with the full
+    accepted name/value tables (codec names accept raw enum ints, which
+    are validated against the ``Compressor`` value set); any other raw
+    int is rejected — an integer is not a phase program."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    if value is None or value == "" or value == 0:
+        return ""
+    if isinstance(value, sir.ScheduleIR):
+        prog = value
+    elif isinstance(value, int):
+        raise ValueError(
+            f"Unknown schedule_ir value {value!r}; expected a serialized "
+            f"phase list '<op>@<axis>[+<axis>...][:<codec>];...' with ops "
+            f"{', '.join(repr(o) for o in sir.OPS)} and codec "
+            f"names/values: {_enum_choices(_COMPRESSOR_ALIASES)}")
+    else:
+        prog = sir.loads(value)
+    sir.validate(prog)
+    return sir.dumps(prog)
+
+
 class StrategyCompiler:
     """Resolve + prune a strategy against the concrete cluster.
 

@@ -1,0 +1,178 @@
+"""Searched collective-schedule synthesis over the dcn x ici mesh.
+
+Generalizes the FLAT | TWO_LEVEL binary into a sketch-constrained search
+(arXiv 2111.04867's "communication sketches"): enumerate the legal phase
+factorizations of the ``replica_dcn x replica_ici`` mesh as schedule-IR
+programs (``kernel/synchronization/schedule_ir.py``), place wire codecs
+per hop (EQuARX-style in-collective compression, arXiv 2506.17615 —
+block codecs confined to the slow DCN core), price every candidate with
+the calibrated per-hop cost model, and hand the winners to
+:class:`~autodist_tpu.strategy.all_reduce_strategy.AllReduce` as
+``schedule_ir`` programs for AutoStrategy to rank alongside the legacy
+FLAT/TWO_LEVEL candidates.
+
+The sketches (each already proven numerically equivalent to flat psum by
+the IR executor's equivalence tests):
+
+- ``rs@ici; ar@dcn:c; ag@ici`` — the two-level tree, generalized with a
+  hop codec on the ICI phases and any DCN-safe core codec ``c``.
+- ``rs@ici; ppermute_ring@dcn:c; ag@ici`` — explicit bandwidth-optimal
+  ring core (``2(g-1)/g`` wire) instead of the compiler-scheduled psum.
+- ``rs@dcn; ar@ici:c; ag@dcn`` — the inverted hierarchy: bulk phases on
+  DCN, shard ring on ICI (wins only when DCN is the FAST wire, e.g. an
+  optically-switched cross-slice fabric over a narrow ICI mesh).
+- ``rs@ici; rs@dcn; ag@dcn; ag@ici`` — the full scatter tree: no core at
+  all, the reduction completes through two nested reduce-scatters.
+
+The loop closes through measurement: the runtime audit's T006 measured
+per-hop bandwidths (``cost_model.calibrate_bandwidths``) feed back in via
+``measured_bandwidths=`` and re-rank the space (docs/performance.md
+"Synthesized collective schedules").
+"""
+from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+from autodist_tpu.kernel.synchronization import schedule_ir as sir
+from autodist_tpu.proto import synchronizers_pb2
+
+_C = synchronizers_pb2.AllReduceSynchronizer
+
+# codec placement alphabets, per hop class (schedule_ir validates the
+# same families — the search only proposes what the IR accepts)
+_HOP_CODECS = (_C.NoneCompressor, _C.BF16Compressor)
+_DCN_CORE_CODECS = (_C.NoneCompressor, _C.BF16Compressor, _C.Int8Compressor)
+_ICI_CORE_CODECS = (_C.NoneCompressor, _C.BF16Compressor)
+_RING_CODECS = (_C.NoneCompressor, _C.BF16Compressor)
+
+# nominal gradient volume the per-byte-linear cost is evaluated at; the
+# ranking is invariant to this choice
+_PROBE_BYTES = 64 * 2 ** 20
+
+
+def mesh_factorization(resource_spec):
+    """``(R_dcn, R_ici)`` the engine would realize on this spec — an
+    explicit ``mesh:`` request wins (same resolution order as
+    ``cost_model._hier_factors``), then host boundaries via
+    :func:`~autodist_tpu.parallel.mesh.hierarchical_axes`; ``(1, R)``
+    when the spec cannot factor."""
+    from autodist_tpu.parallel.mesh import hierarchical_axes
+
+    R = max(1, resource_spec.num_accelerators)
+    req = resource_spec.mesh_request or {}
+    if AXIS_REPLICA_DCN in req and AXIS_REPLICA_ICI in req:
+        return int(req[AXIS_REPLICA_DCN]), int(req[AXIS_REPLICA_ICI])
+    axes = hierarchical_axes(resource_spec, R)
+    return (int(axes.get(AXIS_REPLICA_DCN, 1)),
+            int(axes.get(AXIS_REPLICA_ICI, R)))
+
+
+def resolve_bandwidths(resource_spec=None, measured_bandwidths=None,
+                       ici_gbps=None, dcn_gbps=None):
+    """Bandwidth inputs for scoring, most-trusted first: explicit
+    overrides > T006-measured (``calibrate_bandwidths`` output) > the
+    spec's yaml ``network_bandwidth`` entries > the model defaults —
+    the same resolution order ``cost_model.estimate`` applies."""
+    from autodist_tpu.simulator import cost_model as cm
+
+    measured = measured_bandwidths or {}
+    if ici_gbps is None:
+        ici_gbps = measured.get("ici_gbps") or cm.DEFAULT_ICI_GBPS
+    if dcn_gbps is None:
+        dcn_gbps = measured.get("dcn_gbps")
+        if not dcn_gbps:
+            explicit = (getattr(resource_spec, "explicit_bandwidths", {})
+                        if resource_spec is not None else {})
+            dcn_gbps = (min(explicit.values()) if explicit
+                        else cm.DEFAULT_DCN_GBPS)
+    return float(ici_gbps), float(dcn_gbps)
+
+
+def enumerate_programs(R_dcn, R_ici):
+    """All sketch-constrained candidate programs for a factored mesh
+    (deduplicated, every one passing ``schedule_ir.validate``).  Empty
+    when ``R_dcn <= 1`` — a single-level mesh has nothing to factor."""
+    if R_dcn <= 1 or R_ici <= 1:
+        return []
+    ICI, DCN = AXIS_REPLICA_ICI, AXIS_REPLICA_DCN
+    progs = []
+    for h in _HOP_CODECS:
+        for c in _DCN_CORE_CODECS:
+            progs.append(sir.ScheduleIR((
+                sir.Phase("reduce_scatter", (ICI,), h),
+                sir.Phase("all_reduce", (DCN,), c),
+                sir.Phase("all_gather", (ICI,), h))))
+        for c in _RING_CODECS:
+            progs.append(sir.ScheduleIR((
+                sir.Phase("reduce_scatter", (ICI,), h),
+                sir.Phase("ppermute_ring", (DCN,), c),
+                sir.Phase("all_gather", (ICI,), h))))
+        for c in _ICI_CORE_CODECS:
+            progs.append(sir.ScheduleIR((
+                sir.Phase("reduce_scatter", (DCN,), h),
+                sir.Phase("all_reduce", (ICI,), c),
+                sir.Phase("all_gather", (DCN,), h))))
+        for h2 in _HOP_CODECS:
+            progs.append(sir.ScheduleIR((
+                sir.Phase("reduce_scatter", (ICI,), h),
+                sir.Phase("reduce_scatter", (DCN,), h2),
+                sir.Phase("all_gather", (DCN,), h2),
+                sir.Phase("all_gather", (ICI,), h))))
+    sizes = {DCN: R_dcn, ICI: R_ici}
+    out, seen = [], set()
+    for p in progs:
+        text = sir.dumps(p)
+        if text in seen:
+            continue
+        try:
+            sir.validate(p, data_axes=(DCN, ICI), axis_sizes=sizes)
+        except ValueError:
+            continue
+        seen.add(text)
+        out.append(p)
+    return out
+
+
+def score_program(prog, R_dcn, R_ici, ici_gbps, dcn_gbps,
+                  nbytes=_PROBE_BYTES):
+    """Predicted sync seconds of one program for an ``nbytes`` gradient —
+    the same per-phase formulas ``cost_model.estimate`` prices searched
+    plans with, so the search's ordering IS the ranker's ordering."""
+    from autodist_tpu.simulator.cost_model import _schedule_ir_cost
+
+    ici_b, dcn_b, secs = _schedule_ir_cost(
+        prog, nbytes, R_dcn, R_ici,
+        ici_gbps * 1e9 / 8, dcn_gbps * 1e9 / 8)
+    return {"ir": sir.dumps(prog), "predicted_s": secs,
+            "ici_bytes": ici_b, "dcn_bytes": dcn_b}
+
+
+def search(resource_spec, *, top_k=3, measured_bandwidths=None,
+           ici_gbps=None, dcn_gbps=None, nbytes=_PROBE_BYTES,
+           lossless_only=False):
+    """Synthesize and rank schedule programs for a spec.
+
+    Returns the ``top_k`` scored entries (cheapest first), each a dict
+    ``{ir, predicted_s, ici_bytes, dcn_bytes}``.  ``lossless_only``
+    restricts the codec alphabet to codec-free programs (exact numerics).
+    """
+    R_dcn, R_ici = mesh_factorization(resource_spec)
+    ici, dcn = resolve_bandwidths(resource_spec, measured_bandwidths,
+                                  ici_gbps, dcn_gbps)
+    scored = []
+    for prog in enumerate_programs(R_dcn, R_ici):
+        if lossless_only and any(ph.codec for ph in prog.phases):
+            continue
+        scored.append(score_program(prog, R_dcn, R_ici, ici, dcn,
+                                    nbytes=nbytes))
+    scored.sort(key=lambda e: (e["predicted_s"], e["ir"]))
+    return scored[:max(0, top_k)]
+
+
+def searched_candidates(resource_spec, *, top_k=2, **search_kw):
+    """The search's winners as :class:`AllReduce` builders for
+    AutoStrategy's candidate list.  ``hierarchy="two_level"`` rides along
+    only so the build factors the mesh into ``replica_dcn x replica_ici``
+    when the yaml has no explicit ``mesh:`` request — the program itself
+    supersedes the hierarchy knob."""
+    from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+
+    return [AllReduce(schedule_ir=e["ir"], hierarchy="two_level")
+            for e in search(resource_spec, top_k=top_k, **search_kw)]

@@ -224,7 +224,43 @@ def _bench_schedule():
             else "barrier")
 
 
-def _bench_hierarchy_spec(n_chips):
+def _bench_searched_ir(spec):
+    """``BENCH_SCHEDULE=searched`` synthesizes a collective-schedule IR
+    program for the bench mesh (``strategy/schedule_search``, priced
+    against the calibrated per-hop bandwidths) and runs the session on
+    the winner; returns the IR text, or ``""`` when the lever is off or
+    the mesh cannot factor into ``replica_dcn x replica_ici``."""
+    if os.environ.get("BENCH_SCHEDULE", "") != "searched":
+        return ""
+    from autodist_tpu.strategy.schedule_search import search
+
+    entries = search(spec, top_k=1)
+    return entries[0]["ir"] if entries else ""
+
+
+def _bench_sync(n_chips):
+    """Resolve the gradient-sync levers into ``(spec, builder_kwargs,
+    extras)``: the barrier/overlap schedule, the flat/two_level hierarchy
+    spec, and the searched schedule-IR program (which needs the factored
+    mesh, so ``BENCH_SCHEDULE=searched`` implies the two_level spec)."""
+    schedule = _bench_schedule()
+    searched = os.environ.get("BENCH_SCHEDULE", "") == "searched"
+    spec, hierarchy = _bench_hierarchy_spec(
+        n_chips, force_two_level=searched)
+    kwargs = {"schedule": schedule}
+    ir = _bench_searched_ir(spec)
+    extras = {"sync_schedule": schedule, "sync_hierarchy": hierarchy}
+    if ir:
+        kwargs.update(schedule_ir=ir, hierarchy="two_level")
+        extras["sync_hierarchy"] = "searched"
+        extras["schedule_ir"] = ir
+    elif searched:
+        extras["sync_hierarchy"] = \
+            f"{hierarchy} (searched requested; mesh did not factor)"
+    return spec, kwargs, extras
+
+
+def _bench_hierarchy_spec(n_chips, force_two_level=False):
     """``BENCH_HIERARCHY=flat|two_level`` gradient-sync hierarchy lever
     (docs/performance.md "Hierarchical sync").  ``two_level`` factors the
     mesh into ``replica_dcn x replica_ici`` — by host boundaries on a
@@ -233,13 +269,14 @@ def _bench_hierarchy_spec(n_chips):
     ICI reduce-scatter -> DCN shard ring -> ICI all-gather schedule.
     Returns ``(resource_spec, hierarchy_name)``; falls back to flat (with
     the reason recorded in the result's ``sync_hierarchy``) when the chip
-    count does not factor."""
+    count does not factor.  ``force_two_level`` factors regardless of the
+    env lever (``BENCH_SCHEDULE=searched`` needs the factored mesh)."""
     import jax
 
     from autodist_tpu.resource_spec import ResourceSpec
 
     mode = os.environ.get("BENCH_HIERARCHY", "flat")
-    if mode != "two_level":
+    if mode != "two_level" and not force_two_level:
         return ResourceSpec.from_num_chips(n_chips), "flat"
     n_slices = jax.process_count()
     if n_slices <= 1:
@@ -271,12 +308,11 @@ def _build_resnet(n_chips, batch_per_chip):
     # experiments only, never the recorded default)
     stem = os.environ.get("BENCH_STEM", "conv")
     bn_f32 = os.environ.get("BENCH_BN_STATS", "f32") != "bf16"
-    schedule = _bench_schedule()
-    spec, hierarchy = _bench_hierarchy_spec(n_chips)
+    spec, sync_kwargs, sync_extras = _bench_sync(n_chips)
     model = ResNet50(num_classes=1000, stem=stem, bn_f32_stats=bn_f32)
     loss_fn, params, state = train_lib.classifier_capture(model, (224, 224, 3))
     ad = AutoDist(resource_spec=spec,
-                  strategy_builder=AllReduce(schedule=schedule))
+                  strategy_builder=AllReduce(**sync_kwargs))
     sess = ad.distribute(loss_fn, params, train_lib.sgd_momentum(0.1),
                          mutable_state=state)
 
@@ -289,7 +325,7 @@ def _build_resnet(n_chips, batch_per_chip):
     gbatch["image"] = jnp.asarray(gbatch["image"], jnp.bfloat16)
     return sess, gbatch, MODELS["resnet50"]["train_flops_per_example"], {
         "stem": stem, "bn_stats": "f32" if bn_f32 else "bf16",
-        "sync_schedule": schedule, "sync_hierarchy": hierarchy}
+        **sync_extras}
 
 
 def _build_gpt(n_chips, batch_per_chip):
@@ -308,14 +344,13 @@ def _build_gpt(n_chips, batch_per_chip):
     S = int(os.environ.get("BENCH_SEQ_LEN", "1024"))
     streaming = os.environ.get("BENCH_STREAMING_LOSS", "1") != "0"
     remat = os.environ.get("BENCH_REMAT", "1") != "0"
-    schedule = _bench_schedule()
-    spec, hierarchy = _bench_hierarchy_spec(n_chips)
+    spec, sync_kwargs, sync_extras = _bench_sync(n_chips)
     cfg = dataclasses.replace(GPT_SMALL, max_position=max(
         S, GPT_SMALL.max_position), remat=remat)
     loss_fn, params, sparse = train_lib.gpt_capture(
         cfg, S, streaming_loss=streaming)
     ad = AutoDist(resource_spec=spec,
-                  strategy_builder=AllReduce(schedule=schedule))
+                  strategy_builder=AllReduce(**sync_kwargs))
     sess = ad.distribute(loss_fn, params, optax.adamw(1e-4),
                          sparse_vars=sparse, has_rng=True)
     B = batch_per_chip * n_chips
@@ -336,8 +371,7 @@ def _build_gpt(n_chips, batch_per_chip):
                        + 2.0 * cfg.num_layers * S * S * cfg.hidden_size)
     return sess, gbatch, 3.0 * fwd_per_example / S, {
         "seq_len": S, "streaming_loss": streaming, "remat": remat,
-        "sync_schedule": schedule, "sync_hierarchy": hierarchy,
-        "tokens_per_example": S}
+        "tokens_per_example": S, **sync_extras}
 
 
 def _bench():
@@ -495,8 +529,8 @@ def _cpu_proxy(steps=8):
 
     opt = optax.adam(1e-3)
 
-    def engine_ms(**kw):
-        ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n),
+    def engine_ms(spec=None, **kw):
+        ad = AutoDist(resource_spec=spec or ResourceSpec.from_num_chips(n),
                       strategy_builder=AllReduce(**kw))
         sess = ad.distribute(loss, params, opt)
         g = sess._shard_batch(batch)
@@ -533,6 +567,24 @@ def _cpu_proxy(steps=8):
     raw_ms = raw_dt * 1e3
     eng_ms = engine_ms()
     shard_ms = engine_ms(sharded_update="sharded")
+    # the searched collective-schedule variant (strategy/schedule_search):
+    # synthesize the top program for a 2 x n/2 factored virtual mesh and
+    # time the session executing the schedule IR — the new sync path's
+    # engine overhead rides in the same trajectory record
+    searched_ms = searched_ir = None
+    if n >= 4 and n % 2 == 0:
+        from autodist_tpu.strategy.schedule_search import search
+
+        searched_spec = ResourceSpec(resource_info={
+            "nodes": [{"address": "localhost", "chips": list(range(n)),
+                       "chief": True}],
+            "mesh": {"replica_dcn": 2, "replica_ici": n // 2}})
+        entries = search(searched_spec, top_k=1)
+        if entries:
+            searched_ir = entries[0]["ir"]
+            searched_ms = engine_ms(spec=searched_spec,
+                                    schedule_ir=searched_ir,
+                                    hierarchy="two_level")
     out = {
         "metric": CPU_PROXY_METRIC,
         "value": round(eng_ms / max(raw_ms, 1e-9), 3),
@@ -546,6 +598,10 @@ def _cpu_proxy(steps=8):
         "note": ("CPU-mesh pipeline proxy — engine dispatch/transform "
                  "overhead only, never a hardware throughput claim"),
     }
+    if searched_ms is not None:
+        out["engine_searched_step_ms"] = round(searched_ms, 3)
+        out["searched_ratio"] = round(searched_ms / max(raw_ms, 1e-9), 3)
+        out["searched_schedule_ir"] = searched_ir
     # the HLO compute audit of the same step (F006: model vs realized
     # FLOPs + predicted MFU ceiling) — priced from the lowering alone, so
     # the record keeps a hardware-independent compute story between

@@ -227,14 +227,16 @@ def _real_pipeline(args, cap, B, sess):
     return DevicePrefetcher(rebuild(), sess, depth=2)
 
 
-def _make_builder(args, strategy_name):
+def _make_builder(args, strategy_name, resource_spec=None):
     """``Name`` or ``Name:variant[:variant]`` — AllReduce-family variants:
     ``overlap``/``barrier`` (sync schedule), ``two_level``/``flat``
-    (sync hierarchy) and ``sharded_update`` (ZeRO-style sharded weight
-    update), e.g. ``AllReduce:two_level`` or
-    ``AllReduce:overlap:sharded_update``; ``--ar_chunk_size`` sets the
-    family's bucket-group granularity so the overlap term has buckets to
-    pipeline."""
+    (sync hierarchy), ``sharded_update`` (ZeRO-style sharded weight
+    update) and ``searched_schedule`` (the schedule synthesizer's top
+    program for the spec — requires a ``replica_dcn x replica_ici``
+    factorization, e.g. ``--mesh "replica_dcn=2,replica_ici=4"``), e.g.
+    ``AllReduce:two_level`` or ``AllReduce:overlap:sharded_update``;
+    ``--ar_chunk_size`` sets the family's bucket-group granularity so
+    the overlap term has buckets to pipeline."""
     from autodist_tpu import strategy as S
 
     name, _, variants = strategy_name.partition(":")
@@ -247,10 +249,24 @@ def _make_builder(args, strategy_name):
             kwargs["hierarchy"] = variant
         elif variant in ("sharded_update", "sharded"):
             kwargs["sharded_update"] = "sharded"
+        elif variant in ("searched_schedule", "searched"):
+            from autodist_tpu.strategy.schedule_search import search
+
+            entries = search(resource_spec, top_k=1) \
+                if resource_spec is not None else []
+            if not entries:
+                raise SystemExit(
+                    "searched_schedule: the spec does not factor into "
+                    "replica_dcn x replica_ici (multi-node hosts or an "
+                    "explicit --mesh \"replica_dcn=N,replica_ici=M\" "
+                    "request required)")
+            kwargs["schedule_ir"] = entries[0]["ir"]
+            kwargs.setdefault("hierarchy", "two_level")
         else:
             raise SystemExit(f"unknown strategy variant {variant!r} in "
                              f"{strategy_name!r} (overlap | barrier | "
-                             f"two_level | flat | sharded_update)")
+                             f"two_level | flat | sharded_update | "
+                             f"searched_schedule)")
     if args.ar_chunk_size and issubclass(builder_cls, S.AllReduce):
         kwargs["chunk_size"] = args.ar_chunk_size
     return builder_cls(**kwargs)
@@ -262,9 +278,9 @@ def run_one(args, strategy_name, cap, n_chips):
     from autodist_tpu.simulator.cost_model import measure_and_record
 
     B = args.batch_per_chip * n_chips
-    builder = _make_builder(args, strategy_name)
-    ad = AutoDist(resource_spec=_spec(n_chips, mesh=_parse_mesh(args.mesh)),
-                  strategy_builder=builder)
+    spec = _spec(n_chips, mesh=_parse_mesh(args.mesh))
+    builder = _make_builder(args, strategy_name, resource_spec=spec)
+    ad = AutoDist(resource_spec=spec, strategy_builder=builder)
     sess = ad.distribute(cap["loss_fn"], cap["params"], cap["optimizer"],
                          sparse_vars=cap["sparse_vars"], has_rng=cap["has_rng"],
                          mutable_state=cap["mutable_state"])

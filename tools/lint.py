@@ -73,6 +73,16 @@ codebase:
         via ``utils/network.py`` is fine — only channel *creation* is
         flagged, never a bare ``import socket``.  Tools and tests
         drive sockets legitimately.
+  AD07  hand-rolled ``replica_groups`` construction outside the schedule-IR
+        executor: a ``replica_groups=`` keyword or a ``replica_groups =``
+        assignment anywhere but ``kernel/synchronization/all_reduce.py`` /
+        ``schedule_ir.py`` (the executor that derives groups from the
+        phase program) and ``analysis/hlo_audit.py`` (the parser that
+        reads them back out of lowered HLO).  Local group construction
+        bypasses the IR's well-formedness checks (Y010/Y011) and the
+        X-audit's intended-channel pinning — the device grouping of every
+        collective must be a function of the schedule program, not of the
+        call site.  Scoped to ``autodist_tpu/`` and ``tools/``.
 
 Exit code 1 when any finding is reported.
 """
@@ -153,6 +163,19 @@ _AD06_CALLS = ("socket", "create_connection", "create_server",
 def _ad06_applies(path):
     p = Path(path)
     return "autodist_tpu" in p.parts and p.name not in _AD06_EXEMPT
+
+
+# AD07 shares AD01's engine+tool scope; the schedule-IR executor
+# (kernel/synchronization/all_reduce.py + schedule_ir.py) derives the
+# grouping from the phase program and hlo_audit.py parses it back out
+_AD07_EXEMPT = ("all_reduce.py", "schedule_ir.py", "hlo_audit.py",
+                "lint.py")
+
+
+def _ad07_applies(path):
+    p = Path(path)
+    return any(part in _AD01_PARTS for part in p.parts) \
+        and p.name not in _AD07_EXEMPT
 
 
 class Checker(ast.NodeVisitor):
@@ -277,6 +300,16 @@ class Checker(ast.NodeVisitor):
                 isinstance(t, ast.Name) for t in node.targets):
             self.add(node.lineno, "E731",
                      "lambda assigned to a name (use 'def')")
+        if _ad07_applies(self.path) and any(
+                getattr(t, "id", "") == "replica_groups"
+                for t in node.targets):
+            self.add(node.lineno, "AD07",
+                     "hand-rolled replica_groups outside the schedule-IR "
+                     "executor: derive collective device grouping from "
+                     "the phase program (kernel/synchronization/"
+                     "schedule_ir.py + all_reduce.run_schedule) so the "
+                     "Y010/Y011 well-formedness checks and the X-audit's "
+                     "intended channels stay authoritative")
         flop_target = _ad03_applies(self.path) and any(
             "flop" in getattr(t, "id", "").lower() for t in node.targets)
         self._flop_ctx += flop_target
@@ -371,6 +404,17 @@ class Checker(ast.NodeVisitor):
                      f"(HealthMonitor.observe) so non-finite steps "
                      f"become health_finding records, R002 in the "
                      f"regression audit, and on_anomaly signals")
+        # AD07: hand-rolled replica_groups construction — collective
+        # device grouping must be derived from the schedule-IR program
+        if _ad07_applies(self.path) and any(
+                kw.arg == "replica_groups" for kw in node.keywords):
+            self.add(node.lineno, "AD07",
+                     "hand-rolled replica_groups outside the schedule-IR "
+                     "executor: derive collective device grouping from "
+                     "the phase program (kernel/synchronization/"
+                     "schedule_ir.py + all_reduce.run_schedule) so the "
+                     "Y010/Y011 well-formedness checks and the X-audit's "
+                     "intended channels stay authoritative")
         # AD03: a shape-product inside flops-named code re-derives FLOP
         # accounting that must come from simulator/cost_model.py
         if (self._flop_ctx and self._is_prod_call(node)
