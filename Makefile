@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check monitor-check chaos perf-gate check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -127,13 +127,23 @@ perf-gate:
 	$(PY) tools/perf_gate.py --selftest
 	$(PY) tools/perf_gate.py
 
+# serving gate (docs/serving.md): a live CPU-mesh continuous-batching
+# run (staggered admissions over the slot-sharded mesh, plus a
+# disaggregated prefill/decode split) must bit-match generate(), leave
+# a schema-v4 manifest whose serving block passes the Q-code audit with
+# Q004 only, and the seeded over-budget decode case must fire Q001
+# while the clean fixture stays Q004-only (--serving --selftest)
+serve-check:
+	$(PY) tools/serve_check.py
+	$(PY) tools/verify_strategy.py --serving --selftest
+
 # the pre-merge gate: lint + strategy verification + HLO audit + live
 # telemetry + runtime timeline + live control plane + chaos drills + the
-# cross-run perf gate (tests/test_analysis.py + test_telemetry.py +
-# test_timeline.py + test_elastic.py + test_regression_audit.py +
-# test_stream.py + test_reaction_audit.py run the same chains, so
-# tier-1 exercises it)
-check: lint verify audit telemetry-check timeline-check monitor-check chaos perf-gate
+# cross-run perf gate + the serving gate (tests/test_analysis.py +
+# test_telemetry.py + test_timeline.py + test_elastic.py +
+# test_regression_audit.py + test_stream.py + test_reaction_audit.py +
+# test_serving.py run the same chains, so tier-1 exercises it)
+check: lint verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check
 
 clean:
 	$(MAKE) -C native clean

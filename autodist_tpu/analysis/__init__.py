@@ -24,6 +24,9 @@ pluggable passes producing a severity-ranked :class:`Report`:
 - ``regression-audit`` — CROSS-RUN tier: this analysis (F006 ceiling,
   X006 bytes, manifest walls/health) diffed against the blessed
   baseline in ``records/baselines`` — R-codes
+- ``serving-audit`` — SERVING tier: the decode service's schema-v4
+  serving telemetry (tokens/sec, TTFT, occupancy) + the decode step's
+  realized collectives vs the interconnect budget — Q-codes
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on
@@ -34,7 +37,7 @@ from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F4
                                           StrategyVerificationError)
 from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,  # noqa: F401
                                           PASS_REGISTRY, REGRESSION_PASSES,
-                                          RUNTIME_PASSES, STATIC_PASSES,
-                                          TRACE_PASSES)
+                                          RUNTIME_PASSES, SERVING_PASSES,
+                                          STATIC_PASSES, TRACE_PASSES)
 from autodist_tpu.analysis.verify import (AnalysisContext, verify_strategy,  # noqa: F401
                                           verify_transformer)

@@ -139,6 +139,12 @@ The E-codes form the CONTROL-PLANE tier
 cluster event log (schema v3 ``cluster_event`` records — live signals,
 control actions, cause, signal->action latency) against the reaction
 contract, so an ignored alarm or a slow MTTR ranks in the same Report.
+The Q-codes form the SERVING tier
+(:mod:`autodist_tpu.analysis.serving_audit`): they judge the decode
+service's schema-v4 serving telemetry (tokens/sec, TTFT, occupancy) and
+the decode step's realized collectives against the interconnect budget
+(Q001 exposed decode comm, Q002 occupancy collapse, Q003 TTFT p99,
+Q004 the machine-readable serving table).
 """
 import numpy as np
 
@@ -873,6 +879,16 @@ def reaction_audit_pass(ctx):
     return _run(ctx)
 
 
+def serving_audit_pass(ctx):
+    """Serving tier pass: judge the decode service's schema-v4 serving
+    telemetry + realized decode collectives against the serving budgets
+    (:mod:`autodist_tpu.analysis.serving_audit`)."""
+    from autodist_tpu.analysis.serving_audit import \
+        serving_audit_pass as _run
+
+    return _run(ctx)
+
+
 PASS_REGISTRY = {
     "sharding": sharding_pass,
     "hierarchy": hierarchy_pass,
@@ -885,6 +901,7 @@ PASS_REGISTRY = {
     "runtime-audit": runtime_audit_pass,
     "regression-audit": regression_audit_pass,
     "reaction-audit": reaction_audit_pass,
+    "serving-audit": serving_audit_pass,
 }
 
 STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
@@ -908,3 +925,8 @@ REGRESSION_PASSES = ("regression-audit",)
 # verify_strategy(passes=..., event_records=...), the CLI's --events,
 # ElasticTrainer's end-of-fit export, and tools/monitor_check.py
 EVENT_PASSES = ("reaction-audit",)
+# the SERVING tier: judge the decode service's serving telemetry (+ the
+# decode step's realized collectives) against the serving budgets;
+# opt-in via verify_strategy(passes=..., serving_metrics=...), the CLI's
+# --serving, and tools/serve_check.py
+SERVING_PASSES = ("serving-audit",)

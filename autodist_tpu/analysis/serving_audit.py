@@ -1,0 +1,269 @@
+"""Serving audit: the SERVING tier (Q-codes) of the verification stack.
+
+The runtime tiers judge a *training* run; this pass judges the decode
+service.  Input is the schema-v4 serving telemetry (the summary's
+``serving`` block or explicit metrics) plus, optionally, the decode
+step's realized collectives — the same X006-style accounting
+:func:`~autodist_tpu.analysis.hlo_audit.extract_collectives` pulls from
+the lowered module — priced against the interconnect budget.
+
+  Q000 INFO    serving audit skipped (no serving telemetry recorded)
+  Q001 ERROR   exposed decode comm over the interconnect budget: the
+               decode step's realized collectives cost more wire time
+               than the budgeted fraction of the step wall — the slot
+               layout is paying for sharding the decode batch cannot
+               hide
+  Q002 WARNING slot-occupancy collapse: requests queued while the table
+               ran mostly empty — the admission policy (or slot count)
+               is starving the batch
+  Q003 ERROR   TTFT p99 over budget — tail requests wait too long for
+               their first token
+  Q004 INFO    machine-readable serving table (``Finding.data``;
+               consumed by ``tools/verify_strategy.py --serving``)
+
+Budgets are module constants, overridable through the context
+(``ctx.serving_budgets``) and the fixture entry point.
+"""
+from typing import List
+
+from autodist_tpu.analysis.report import Finding, Severity
+
+# Q001: exposed decode comm may take at most this fraction of the
+# decode-step wall before the mesh split costs more than it buys (a
+# decode step is latency-bound; comm it cannot hide is pure tax).
+SERVE_COMM_FRAC = 0.35
+# Q001 wire speed when the caller gives none: the cost model's ICI
+# default (Gbit/s -> bytes/s below).
+SERVE_ICI_GBPS = None  # None = cost_model.DEFAULT_ICI_GBPS
+# Q002: mean occupancy below this while requests actually queued.
+OCCUPANCY_COLLAPSE = 0.5
+# Q003: TTFT p99 budget (seconds).  Generous default — CI meshes are
+# CPU; production overrides per deployment.
+TTFT_BUDGET_S = 2.0
+
+
+def _f(sev, code, msg, subject="", data=None):
+    return Finding(Severity(sev), code, "serving-audit", msg, subject,
+                   data=data)
+
+
+def _get(op, field, default=0.0):
+    """Field access across CollectiveOp objects and plain dicts."""
+    if isinstance(op, dict):
+        return op.get(field, default)
+    return getattr(op, field, default)
+
+
+def decode_comm_time_s(collectives, ici_gbps=None) -> float:
+    """Wire time of one decode step's realized collectives at ICI speed
+    (Gbit/s): the X006 accounting (wire bytes x static multiplicity)
+    priced like the cost model's ring terms."""
+    from autodist_tpu.simulator.cost_model import DEFAULT_ICI_GBPS
+
+    gbps = ici_gbps or DEFAULT_ICI_GBPS
+    bw = gbps * 1e9 / 8.0
+    total = 0.0
+    for op in collectives or ():
+        wire = _get(op, "total_bytes", 0.0) or _get(op, "wire_bytes", 0.0)
+        total += float(wire or 0.0)
+    return total / bw if bw > 0 else 0.0
+
+
+def serving_audit(metrics, collectives=None, *, comm_frac=SERVE_COMM_FRAC,
+                  ici_gbps=SERVE_ICI_GBPS, occupancy_floor=OCCUPANCY_COLLAPSE,
+                  ttft_budget_s=TTFT_BUDGET_S) -> List[Finding]:
+    """Judge a serving run.
+
+    ``metrics`` is the summary's ``serving`` block (or the live
+    :meth:`~autodist_tpu.serving.telemetry.ServingTelemetry.
+    serving_summary`), optionally carrying ``step_wall_p50_s``;
+    ``collectives`` are the decode step's realized collectives
+    (CollectiveOps or dicts with ``wire_bytes``/``total_bytes``).
+    """
+    findings = []
+    metrics = dict(metrics or {})
+    if not metrics:
+        findings.append(_f(
+            Severity.INFO, "Q000",
+            "serving audit has no serving telemetry — run the engine with "
+            "a ServingTelemetry attached (make serve-check records one)"))
+        return findings
+
+    # -- Q001: exposed decode comm over the interconnect budget -------------
+    wall = metrics.get("step_wall_p50_s") or metrics.get("step_time_p50_s")
+    comm_s = decode_comm_time_s(collectives, ici_gbps)
+    comm = {"comm_s": comm_s, "wall_p50_s": wall, "frac_budget": comm_frac,
+            "collectives": len(list(collectives or ()))}
+    if collectives and isinstance(wall, (int, float)) and wall > 0:
+        limit = comm_frac * wall
+        comm["limit_s"] = limit
+        if comm_s > limit:
+            findings.append(_f(
+                Severity.ERROR, "Q001",
+                f"exposed decode comm over budget: the decode step's "
+                f"{comm['collectives']} realized collective(s) cost "
+                f"{comm_s * 1e6:.1f} us of wire time vs a budget of "
+                f"{limit * 1e6:.1f} us ({comm_frac:.0%} of the "
+                f"{wall * 1e3:.2f} ms step wall) — the decode mesh split "
+                f"pays more interconnect than the batch can hide",
+                "decode step", data=comm))
+
+    # -- Q002: slot-occupancy collapse --------------------------------------
+    occ = metrics.get("occupancy_mean")
+    qmax = metrics.get("queue_depth_max") or 0
+    if isinstance(occ, (int, float)) and qmax > 0 and occ < occupancy_floor:
+        findings.append(_f(
+            Severity.WARNING, "Q002",
+            f"slot-occupancy collapse: mean occupancy {occ:.0%} (floor "
+            f"{occupancy_floor:.0%}) while up to {qmax} request(s) sat "
+            f"queued — admission starved the batch it was supposed to "
+            f"fill",
+            "slot table",
+            data={"occupancy_mean": occ, "floor": occupancy_floor,
+                  "queue_depth_max": qmax}))
+
+    # -- Q003: TTFT p99 over budget -----------------------------------------
+    ttft99 = metrics.get("ttft_p99_s")
+    if isinstance(ttft99, (int, float)) and ttft99 > ttft_budget_s:
+        findings.append(_f(
+            Severity.ERROR, "Q003",
+            f"TTFT p99 {ttft99:.3f} s over the {ttft_budget_s:.3f} s "
+            f"budget — tail requests wait too long for their first token",
+            "ttft",
+            data={"ttft_p99_s": ttft99, "budget_s": ttft_budget_s}))
+
+    # -- Q004: the machine-readable serving table ---------------------------
+    flagged = sorted({f.code for f in findings
+                      if f.code in ("Q001", "Q002", "Q003")})
+    data = {
+        "requests": metrics.get("requests"),
+        "tokens": metrics.get("tokens"),
+        "tokens_per_s": metrics.get("tokens_per_s"),
+        "ttft_p50_s": metrics.get("ttft_p50_s"),
+        "ttft_p99_s": metrics.get("ttft_p99_s"),
+        "latency_p50_s": metrics.get("latency_p50_s"),
+        "latency_p99_s": metrics.get("latency_p99_s"),
+        "occupancy_mean": occ,
+        "queue_depth_max": qmax,
+        "slots": metrics.get("slots"),
+        "decode_comm": comm,
+        "budgets": {"comm_frac": comm_frac, "ttft_s": ttft_budget_s,
+                    "occupancy_floor": occupancy_floor},
+        "flagged": flagged,
+    }
+    verdict = "flagged: " + ", ".join(flagged) if flagged else "clean"
+    tps = metrics.get("tokens_per_s")
+    findings.append(_f(
+        Severity.INFO, "Q004",
+        f"serving table: {metrics.get('requests', 0)} request(s), "
+        + (f"{tps:.1f} tok/s, " if isinstance(tps, (int, float)) else "")
+        + (f"TTFT p99 {ttft99 * 1e3:.1f} ms"
+           if isinstance(ttft99, (int, float)) else "no TTFT samples")
+        + f" — {verdict}", "serving", data=data))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points: the registered pass and the fixture/CLI path
+# ---------------------------------------------------------------------------
+
+
+def metrics_from_context(ctx):
+    """The serving metrics the context carries: explicit
+    ``ctx.serving_metrics`` wins; otherwise the ``serving`` block of the
+    aggregated manifest's summary record (folding in its step p50)."""
+    explicit = getattr(ctx, "serving_metrics", None)
+    if explicit is not None:
+        return explicit
+    for r in getattr(ctx, "manifest_records", None) or []:
+        if r.get("kind") == "summary" and isinstance(r.get("serving"), dict):
+            m = dict(r["serving"])
+            m.setdefault("step_wall_p50_s", r.get("step_time_p50_s"))
+            return m
+    return None
+
+
+def serving_audit_pass(ctx) -> List[Finding]:
+    """PASS_REGISTRY entry (the serving tier): audit the decode service
+    recorded by the schema-v4 serving telemetry."""
+    metrics = metrics_from_context(ctx)
+    if metrics is None:
+        return [_f(Severity.INFO, "Q000",
+                   "serving audit has no serving telemetry — run the "
+                   "engine with a ServingTelemetry attached")]
+    budgets = getattr(ctx, "serving_budgets", None) or {}
+    findings = serving_audit(
+        metrics, getattr(ctx, "decode_collectives", None),
+        comm_frac=budgets.get("comm_frac", SERVE_COMM_FRAC),
+        ici_gbps=budgets.get("ici_gbps", SERVE_ICI_GBPS),
+        occupancy_floor=budgets.get("occupancy_floor", OCCUPANCY_COLLAPSE),
+        ttft_budget_s=budgets.get("ttft_s", TTFT_BUDGET_S))
+    ctx.serving_summary = next(
+        (f.data for f in findings if f.code == "Q004"), None)
+    return findings
+
+
+def load_metrics(path):
+    """Serving metrics from disk for the CLI: a finalized manifest
+    (JSONL — the summary record's ``serving`` block, folding in its step
+    p50) or a bare serving-metrics JSON dict."""
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        d = json.loads(text)
+    except ValueError:
+        d = None
+    if isinstance(d, dict):
+        if isinstance(d.get("serving"), dict):  # a summary record
+            m = dict(d["serving"])
+            m.setdefault("step_wall_p50_s", d.get("step_time_p50_s"))
+            return m
+        if "kind" not in d:   # a kind-tagged dict is a manifest row,
+            return d          # not a bare metrics dict
+    for line in text.splitlines():  # a manifest: scan for the summary
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(r, dict) and r.get("kind") == "summary" \
+                and isinstance(r.get("serving"), dict):
+            m = dict(r["serving"])
+            m.setdefault("step_wall_p50_s", r.get("step_time_p50_s"))
+            return m
+    return None
+
+
+# golden fixtures (the --serving --selftest legs)
+_CLEAN_METRICS = {
+    "requests": 3, "tokens": 24, "tokens_per_s": 120.0,
+    "ttft_p50_s": 0.010, "ttft_p99_s": 0.025,
+    "latency_p50_s": 0.050, "latency_p99_s": 0.080,
+    "occupancy_mean": 0.9, "queue_depth_max": 2,
+    "step_wall_p50_s": 0.008,
+}
+# one decode step whose in-loop all-gather moves ~64 MiB: at the default
+# ICI speed that is ~335 us of wire against a 2.8 us budget (35% of an
+# 8 us step) — unambiguously over
+_OVERBUDGET_COLLECTIVES = [
+    {"kind": "all_gather", "wire_bytes": 64 << 20,
+     "total_bytes": 64 << 20, "in_loop": True},
+]
+_OVERBUDGET_METRICS = dict(_CLEAN_METRICS, step_wall_p50_s=8e-6)
+
+
+def audit_fixture(kind="clean", **budgets) -> List[Finding]:
+    """Run the audit over a seeded scenario: ``clean`` (Q004 only) or
+    ``overbudget`` (the decode step's collectives blow the interconnect
+    budget -> Q001).  ``tools/verify_strategy.py --serving --selftest``
+    drives both."""
+    if kind == "clean":
+        return serving_audit(_CLEAN_METRICS, [], **budgets)
+    if kind == "overbudget":
+        return serving_audit(_OVERBUDGET_METRICS, _OVERBUDGET_COLLECTIVES,
+                             **budgets)
+    raise ValueError(f"unknown serving fixture {kind!r}")

@@ -21,8 +21,8 @@ from typing import Any, Dict, Optional
 
 from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,
                                           PASS_REGISTRY, REGRESSION_PASSES,
-                                          RUNTIME_PASSES, STATIC_PASSES,
-                                          TRACE_PASSES)
+                                          RUNTIME_PASSES, SERVING_PASSES,
+                                          STATIC_PASSES, TRACE_PASSES)
 from autodist_tpu.analysis.report import Report, Severity
 from autodist_tpu.utils import logging
 
@@ -81,6 +81,14 @@ class AnalysisContext:
     event_records: Optional[list] = None
     mttr_budget_s: Optional[float] = None
     reaction_summary: Optional[dict] = None
+    # serving tier: explicit serving metrics (the summary's ``serving``
+    # block wins over the manifest's), the decode step's realized
+    # collectives (CollectiveOps or dicts), per-run budget overrides,
+    # and the audit's Q004 table
+    serving_metrics: Optional[dict] = None
+    decode_collectives: Optional[list] = None
+    serving_budgets: Optional[dict] = None
+    serving_summary: Optional[dict] = None
 
 
 def _mesh_info(strategy, resource_spec, mesh):
@@ -185,7 +193,9 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
                        passes=None, trace_dir=None,
                        manifest_records=None, baseline=None,
                        current_metrics=None, event_records=None,
-                       mttr_budget_s=None) -> Report:
+                       mttr_budget_s=None, serving_metrics=None,
+                       decode_collectives=None,
+                       serving_budgets=None) -> Report:
     """Verify an already-built :class:`GraphTransformer` (the engine's
     in-session entry: the runner's ``verify=`` knob, ``aot_compile``, and
     the watchdog's post-capture analysis reuse the transformer they
@@ -199,7 +209,10 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
         hbm_bytes_per_device=hbm_bytes_per_device,
         trace_dir=trace_dir, manifest_records=manifest_records,
         baseline=baseline, current_metrics=current_metrics,
-        event_records=event_records, mttr_budget_s=mttr_budget_s)
+        event_records=event_records, mttr_budget_s=mttr_budget_s,
+        serving_metrics=serving_metrics,
+        decode_collectives=decode_collectives,
+        serving_budgets=serving_budgets)
     ctx.transformer = transformer
     report = Report(strategy_id=getattr(transformer.strategy, "id", ""))
     selected = tuple(passes) if passes is not None else \
@@ -223,6 +236,11 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
     for name in selected:
         if name in EVENT_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
+    # serving tier: audits the attached serving metrics + decode
+    # collectives against the serving budgets
+    for name in selected:
+        if name in SERVING_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
     # cross-run tier last: it harvests whatever the earlier tiers left on
     # the context (F006 ceiling, X006 bytes, manifest walls/health)
     for name in selected:
@@ -237,6 +255,8 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
                     rng=None, trace_dir=None, manifest_records=None,
                     baseline=None, current_metrics=None,
                     event_records=None, mttr_budget_s=None,
+                    serving_metrics=None, decode_collectives=None,
+                    serving_budgets=None,
                     **transformer_kwargs) -> Report:
     """Statically verify a strategy before any compile.
 
@@ -270,6 +290,11 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         when ``"reaction-audit"`` is selected — the causal cluster event
         log (``cluster_event`` records; defaults to the manifest's) and
         the signal->action latency budget for E002.
+      serving_metrics / decode_collectives / serving_budgets: serving
+        tier inputs when ``"serving-audit"`` is selected — the summary's
+        ``serving`` block (defaults to the manifest's), the decode
+        step's realized collectives for Q001, and budget overrides
+        (``comm_frac`` / ``ici_gbps`` / ``occupancy_floor`` / ``ttft_s``).
       transformer_kwargs: forwarded to :class:`GraphTransformer`
         (``data_axes``, ``batch_spec``, ``accum_steps``, ...).
 
@@ -286,7 +311,10 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         transformer_kwargs=transformer_kwargs,
         trace_dir=trace_dir, manifest_records=manifest_records,
         baseline=baseline, current_metrics=current_metrics,
-        event_records=event_records, mttr_budget_s=mttr_budget_s)
+        event_records=event_records, mttr_budget_s=mttr_budget_s,
+        serving_metrics=serving_metrics,
+        decode_collectives=decode_collectives,
+        serving_budgets=serving_budgets)
     report = Report(strategy_id=getattr(strategy, "id", ""))
 
     selected = tuple(passes) if passes is not None else \
@@ -338,6 +366,12 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
     # attached to the context (or the manifest's cluster_event records)
     for name in selected:
         if name in EVENT_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
+
+    # serving tier: audits the attached serving metrics (or the
+    # manifest summary's serving block) + decode collectives
+    for name in selected:
+        if name in SERVING_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
 
     # cross-run (regression) tier last: it diffs whatever the earlier

@@ -360,6 +360,47 @@ class AutoDist:
                                 batch_shapes=batch_shapes,
                                 topology=topology, **kwargs)
 
+    def serve(self, model, params, *, max_total, num_slots=4,
+              temperature=0.0, policy=None, telemetry=True,
+              prefill_fraction=0.0, event_log=None, run_dir=None,
+              **kwargs):
+        """Serving entrypoint (``docs/serving.md``): a continuous-
+        batching decode :class:`~autodist_tpu.serving.engine.
+        ServingEngine` over this AutoDist's devices.
+
+        ``model`` is the ``decode=True`` flax module, ``params`` its
+        trained parameters (e.g. from a finished :meth:`distribute`
+        session); ``max_total`` bounds prompt + new tokens per slot.
+        ``prefill_fraction > 0`` carves that share of the devices off as
+        a disaggregated prefill subset; the rest shard the slot axis
+        (when ``num_slots`` divides them evenly).  ``telemetry=True``
+        attaches a schema-v4 :class:`~autodist_tpu.serving.telemetry.
+        ServingTelemetry`; submit with ``engine.submit(prompt, n)``,
+        drive with ``engine.run()``, close with ``engine.finalize()``.
+        """
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from autodist_tpu.serving import ServingEngine, ServingTelemetry
+        from autodist_tpu.serving.slots import SLOT_AXIS
+
+        devs = list(self.mesh.devices.flat)
+        prefill = []
+        if prefill_fraction > 0 and len(devs) > 1:
+            k = min(max(1, int(len(devs) * prefill_fraction)),
+                    len(devs) - 1)
+            prefill, devs = devs[-k:], devs[:-k]
+        mesh = None
+        if len(devs) > 1 and num_slots % len(devs) == 0:
+            mesh = Mesh(np.asarray(devs), (SLOT_AXIS,))
+        tel = ServingTelemetry(run_dir=run_dir, num_devices=len(devs)) \
+            if telemetry else None
+        return ServingEngine(
+            model, params, max_total=max_total, num_slots=num_slots,
+            temperature=temperature, policy=policy, telemetry=tel,
+            mesh=mesh, prefill_devices=prefill, event_log=event_log,
+            **kwargs)
+
     @contextlib.contextmanager
     def scope(self):
         """Parity with the reference's ``ad.scope()`` (autodist.py:309-322).

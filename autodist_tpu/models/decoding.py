@@ -7,6 +7,11 @@ whose ``decode=True`` variant consumes one token per call and threads a
 — compiled once per (module, total-length, temperature); the prompt
 length is a traced scalar so variable-length prompts share the
 executable, with prompt tokens staying authoritative during replay.
+
+:func:`decode_step` is the single step of that scan, factored out so the
+serving engine (:mod:`autodist_tpu.serving.engine`) runs the IDENTICAL
+token recurrence per slot — the bitwise token-match contract of ``make
+serve-check`` holds because both paths trace exactly this function.
 """
 import functools
 
@@ -29,6 +34,51 @@ def fresh_cache(model, B):
                         is_leaf=lambda x: isinstance(x, tuple))
 
 
+def clear_decode_caches():
+    """Drop every cached rollout executable and cache-shape template.
+
+    ``_make_rollout`` / ``_cache_shapes`` are lru_caches keyed by the
+    (hashable) flax module — each live entry pins a compiled executable
+    (and, transitively, its device buffers) alive.  Long-lived serving
+    processes that cycle through many (model, length) pairs call this
+    between model swaps to bound that growth."""
+    _make_rollout.cache_clear()
+    _cache_shapes.cache_clear()
+
+
+def decode_step(model, params, cache, buf, t, prompt_len, total,
+                temperature, rng):
+    """One token step of the autoregressive recurrence.
+
+    Reads the token at position ``t`` from ``buf`` (B, total), applies
+    the ``decode=True`` module against ``cache``, and writes position
+    ``t + 1``: the prompt token when still replaying (``t + 1 <
+    prompt_len`` — prompt tokens stay authoritative), else the sampled /
+    greedy next token.  ``total`` and ``temperature`` are Python
+    statics; ``t`` and ``prompt_len`` trace.  Returns ``(buf, cache,
+    rng)`` — the carry of :func:`_make_rollout`'s scan, and the per-slot
+    state of the serving engine's continuously-batched step.
+    """
+    tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              tok, mutable=["cache"])
+    logits = logits[:, 0]
+    rng, sub = jax.random.split(rng)
+    if temperature > 0:
+        nxt = jax.random.categorical(sub, logits / temperature)
+    else:
+        nxt = jnp.argmax(logits, axis=-1)
+    # only write past the prompt (prompt tokens stay authoritative)
+    write_at = jnp.minimum(t + 1, total - 1)
+    write = jnp.where(
+        t + 1 < prompt_len,
+        jax.lax.dynamic_slice_in_dim(buf, write_at, 1, axis=1)[:, 0],
+        nxt.astype(jnp.int32))
+    buf = jax.lax.dynamic_update_slice_in_dim(
+        buf, write[:, None], write_at, axis=1)
+    return buf, mut["cache"], rng
+
+
 @functools.lru_cache(maxsize=64)
 def _make_rollout(model, total, temperature):
     """Jitted decode loop for a ``decode=True`` module (flax modules are
@@ -38,24 +88,10 @@ def _make_rollout(model, total, temperature):
     def rollout(params, cache, buf0, prompt_len, rng):
         def step(carry, t):
             buf, cache, rng = carry
-            tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
-            logits, mut = model.apply({"params": params, "cache": cache},
-                                      tok, mutable=["cache"])
-            logits = logits[:, 0]
-            rng, sub = jax.random.split(rng)
-            if temperature > 0:
-                nxt = jax.random.categorical(sub, logits / temperature)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            # only write past the prompt (prompt tokens stay authoritative)
-            write_at = jnp.minimum(t + 1, total - 1)
-            write = jnp.where(
-                t + 1 < prompt_len,
-                jax.lax.dynamic_slice_in_dim(buf, write_at, 1, axis=1)[:, 0],
-                nxt.astype(jnp.int32))
-            buf = jax.lax.dynamic_update_slice_in_dim(
-                buf, write[:, None], write_at, axis=1)
-            return (buf, mut["cache"], rng), None
+            buf, cache, rng = decode_step(model, params, cache, buf, t,
+                                          prompt_len, total, temperature,
+                                          rng)
+            return (buf, cache, rng), None
 
         (buf, cache, rng), _ = jax.lax.scan(
             step, (buf0, cache, rng), jnp.arange(total - 1))

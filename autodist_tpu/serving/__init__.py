@@ -1,0 +1,17 @@
+"""Continuous-batching serving tier.
+
+Slot-based KV caches (:mod:`slots`), a jitted continuously-batched
+decode engine with admission between steps (:mod:`engine`), the request
+queue / batching policy (:mod:`admission`), and schema-v4 serving
+telemetry (:mod:`telemetry`).  Entry point: ``AutoDist.serve()``.
+"""
+from autodist_tpu.serving.admission import (AdmissionQueue, BatchPolicy,
+                                            Request)
+from autodist_tpu.serving.engine import ServingEngine
+from autodist_tpu.serving.slots import SlotPlan, SlotTable, plan_slots
+from autodist_tpu.serving.telemetry import ServingTelemetry
+
+__all__ = [
+    "AdmissionQueue", "BatchPolicy", "Request", "ServingEngine",
+    "SlotPlan", "SlotTable", "plan_slots", "ServingTelemetry",
+]

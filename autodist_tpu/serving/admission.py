@@ -1,0 +1,126 @@
+"""Request queue, batching policy, and per-request lifecycle records.
+
+The admission layer is pure host-side bookkeeping: the engine asks it
+*between* decode steps which queued requests to admit into freed slots.
+Policy knobs mirror the usual continuous-batching levers — ``max_slots``
+bounds concurrent occupancy below the table size (headroom for bursts),
+``max_wait_s`` forces admission of aging requests even when batching
+more would be cheaper.
+
+Every request carries a lifecycle record (enqueue / admit / first token
+/ finish timestamps) that :mod:`autodist_tpu.serving.telemetry` turns
+into the schema-v4 ``serving_request`` manifest rows and the TTFT /
+latency percentiles the Q-code audit gates.
+"""
+import collections
+import dataclasses
+import time
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class Request:
+    """One decode request plus its lifecycle timestamps (host clock)."""
+
+    rid: int
+    prompt: tuple                  # token ids
+    max_new_tokens: int
+    enqueue_s: float = 0.0
+    admit_s: Optional[float] = None
+    first_token_s: Optional[float] = None
+    finish_s: Optional[float] = None
+    slot: Optional[int] = None
+    tokens: Optional[tuple] = None  # final (prompt + generated) ids
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def total(self) -> int:
+        return self.prompt_len + self.max_new_tokens
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_s is None:
+            return None
+        return self.first_token_s - self.enqueue_s
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finish_s is None:
+            return None
+        return self.finish_s - self.enqueue_s
+
+    def record(self) -> dict:
+        """Lifecycle dict for the ``serving_request`` manifest row."""
+        return {
+            "rid": self.rid,
+            "prompt_len": self.prompt_len,
+            "max_new_tokens": self.max_new_tokens,
+            "slot": self.slot,
+            "queue_s": (self.admit_s - self.enqueue_s)
+            if self.admit_s is not None else None,
+            "ttft_s": self.ttft_s,
+            "latency_s": self.latency_s,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchPolicy:
+    """Admission policy: at most ``max_slots`` concurrently live; a
+    request older than ``max_wait_s`` is admitted as soon as ANY slot
+    frees, even if the batcher would rather wait for more arrivals
+    (``min_batch``)."""
+
+    max_slots: int = 0            # 0 = table size
+    max_wait_s: float = 0.05
+    min_batch: int = 1
+
+
+class AdmissionQueue:
+    """FIFO request queue with policy-driven admission."""
+
+    def __init__(self, policy: BatchPolicy = BatchPolicy(), clock=time.time):
+        self.policy = policy
+        self._clock = clock
+        self._queue: collections.deque = collections.deque()
+        self._next_rid = 0
+        self.depth_max = 0
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, prompt, max_new_tokens) -> Request:
+        req = Request(rid=self._next_rid, prompt=tuple(int(t) for t in prompt),
+                      max_new_tokens=int(max_new_tokens),
+                      enqueue_s=self._clock())
+        self._next_rid += 1
+        self._queue.append(req)
+        self.depth_max = max(self.depth_max, len(self._queue))
+        return req
+
+    def admissible(self, free_slots: int, live: int) -> List[Request]:
+        """Pop the requests to admit this step given ``free_slots`` open
+        slots and ``live`` already-occupied ones.  Applies max-slots
+        headroom, then min-batch unless the head of the queue has aged
+        past ``max_wait_s``."""
+        cap = free_slots
+        if self.policy.max_slots:
+            cap = min(cap, self.policy.max_slots - live)
+        if cap <= 0 or not self._queue:
+            return []
+        aged = (self._clock() - self._queue[0].enqueue_s
+                >= self.policy.max_wait_s)
+        if len(self._queue) < self.policy.min_batch and not aged:
+            return []
+        out = []
+        while self._queue and len(out) < cap:
+            req = self._queue.popleft()
+            req.admit_s = self._clock()
+            out.append(req)
+        return out

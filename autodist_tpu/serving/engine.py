@@ -1,0 +1,392 @@
+"""The continuously-batched decode engine.
+
+One jitted step advances EVERY slot of the table by one token — the
+per-slot recurrence is literally :func:`autodist_tpu.models.decoding.
+decode_step` (the same function ``generate()`` scans), ``jax.vmap``-ed
+over the slot axis with the params broadcast.  Under that vmap the
+module's scalar cache counters (``idx`` / ``pos``) become per-slot
+vectors, which is exactly what continuous batching needs: each slot
+sits at its own position.  Inactive slots still compute (the executable
+never changes shape) but their state updates are masked out, so
+admitting a request into a freed slot between steps touches only that
+slot's rows — no recompile, one executable for the life of the engine.
+
+Prompt handling defaults to *prompt-authoritative replay*: a request is
+admitted at ``t=0`` and the scan replays its prompt exactly as
+``generate()`` does, which is why ``make serve-check`` can demand
+bitwise token equality.  Optionally prefill is *disaggregated*: a
+masked B=1 prefill scan runs on a prefill device subset, and the
+resulting KV block (cache at position P-1) is handed to the decode
+subset and admitted at ``t = P-1``.
+
+Autoscale: :meth:`drain` stops admission and runs the table dry;
+:meth:`rescale` drains, re-plans the slot table for the new device set,
+re-places params and state (the R->R' move), and records the
+signal->action causality in the cluster event log.
+"""
+import time
+
+import numpy as np
+
+from autodist_tpu.serving.admission import AdmissionQueue, BatchPolicy
+from autodist_tpu.serving.slots import SLOT_AXIS, SlotTable, plan_slots
+from autodist_tpu.utils import logging
+
+
+class ServingEngine:
+    """Continuous-batching decode service over a slot table.
+
+    ``model`` is the ``decode=True`` flax module (same contract as
+    :func:`autodist_tpu.models.decoding.generate`); ``max_total`` is the
+    per-slot token-buffer length (prompt + new tokens of any admitted
+    request must fit).  ``mesh`` (optional) shards the slot axis across
+    a mesh with a ``"slot"`` axis; ``prefill_devices`` (optional) turns
+    on disaggregated prefill on those devices.
+    """
+
+    def __init__(self, model, params, *, max_total, num_slots=4,
+                 temperature=0.0, policy=None, telemetry=None, mesh=None,
+                 prefill_devices=None, event_log=None, rng_seed=0):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.max_total = int(max_total)
+        self.temperature = float(temperature)
+        self.queue = AdmissionQueue(policy or BatchPolicy())
+        self.telemetry = telemetry
+        self.event_log = event_log
+        self.mesh = mesh
+        self.prefill_devices = list(prefill_devices or [])
+        self._rng_seed = int(rng_seed)
+        self.plan = plan_slots(model, num_slots, self.max_total)
+        self.table = SlotTable(self.plan)
+        if mesh is not None and num_slots % mesh.shape[SLOT_AXIS]:
+            raise ValueError(
+                f"num_slots={num_slots} not divisible by mesh "
+                f"{SLOT_AXIS}-axis size {mesh.shape[SLOT_AXIS]}")
+        self.params = self._place_replicated(params)
+        self._init_state(num_slots)
+        self._requests = {}            # slot -> Request
+        self._finished = []            # completed Requests, arrival order
+        self._steps = 0
+        self.kv_handoff_bytes = 0      # prefill->decode traffic (disagg)
+        self._build_step_fns()
+
+    # -- placement ---------------------------------------------------------
+
+    def _place_replicated(self, tree):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self.mesh is None:
+            return tree
+        return jax.device_put(tree, NamedSharding(self.mesh, P()))
+
+    def _place_table(self, tree):
+        """Shard the slot axis of every stacked state leaf over the mesh
+        using the plan's ``storage_spec`` layouts."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self.mesh is None:
+            return tree
+        def place(x):
+            return jax.device_put(
+                x, NamedSharding(self.mesh, P(*([SLOT_AXIS] + [None] *
+                                                (x.ndim - 1)))))
+
+        return jax.tree.map(place, tree)
+
+    def _init_state(self, num_slots):
+        import jax
+        import jax.numpy as jnp
+
+        from autodist_tpu.models.decoding import fresh_cache
+
+        S = int(num_slots)
+        one = fresh_cache(self.model, 1)
+        self._caches = self._place_table(jax.tree.map(
+            lambda c: jnp.zeros((S,) + c.shape, c.dtype), one))
+        self._bufs = self._place_table(
+            jnp.zeros((S, self.max_total), jnp.int32))
+        self._rngs = self._place_table(jnp.stack(
+            [jax.random.PRNGKey(self._rng_seed + i) for i in range(S)]))
+        # host mirrors: positions advance deterministically (+1 per
+        # active step), so the control loop never fetches them back
+        self._ts = np.zeros(S, np.int32)
+        self._pls = np.zeros(S, np.int32)
+        self._ends = np.zeros(S, np.int32)
+        self._active = np.zeros(S, bool)
+
+    # -- jitted executables (built once; shapes never change) --------------
+
+    def _build_step_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        from autodist_tpu.models.decoding import decode_step
+
+        model, total, temp = self.model, self.max_total, self.temperature
+
+        def one(params, cache, buf, t, pl, rng):
+            buf2, cache2, rng2 = decode_step(
+                model, params, cache, buf[None], t, pl, total, temp, rng)
+            return buf2[0], cache2, rng2
+
+        @jax.jit
+        def batch_step(params, caches, bufs, ts, pls, active, rngs):
+            bufs2, caches2, rngs2 = jax.vmap(
+                one, in_axes=(None, 0, 0, 0, 0, 0))(
+                    params, caches, bufs, ts, pls, rngs)
+            def sel(new, old):
+                return jnp.where(
+                    active.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
+
+            return (jax.tree.map(sel, caches2, caches), sel(bufs2, bufs),
+                    sel(rngs2, rngs))
+
+        @jax.jit
+        def admit(caches, bufs, rngs, slot, buf_row, rng):
+            caches = jax.tree.map(
+                lambda c: c.at[slot].set(jnp.zeros_like(c[0])), caches)
+            return caches, bufs.at[slot].set(buf_row), rngs.at[slot].set(rng)
+
+        @jax.jit
+        def admit_prefilled(caches, bufs, rngs, slot, cache_one, buf_row,
+                            rng):
+            caches = jax.tree.map(lambda c, v: c.at[slot].set(v),
+                                  caches, cache_one)
+            return caches, bufs.at[slot].set(buf_row), rngs.at[slot].set(rng)
+
+        def prefill(params, cache, buf, pl, rng):
+            # masked B=1 prefill scan: the prompt's P-1 replay steps of
+            # the SAME recurrence, frozen past position P-1 (the rng is
+            # masked too, so the handoff state matches in-slot replay)
+            def step(carry, t):
+                buf, cache, rng = carry
+                buf2, cache2, rng2 = decode_step(
+                    model, params, cache, buf, t, pl, total, temp, rng)
+                live = t < pl - 1
+
+                def sel(n, o):
+                    return jnp.where(live, n, o)
+
+                return (sel(buf2, buf), jax.tree.map(sel, cache2, cache),
+                        sel(rng2, rng)), None
+
+            (buf, cache, rng), _ = jax.lax.scan(
+                step, (buf, cache, rng), jnp.arange(total - 1))
+            return cache, buf, rng
+
+        self._batch_step = batch_step
+        self._admit_fn = admit
+        self._admit_prefilled_fn = admit_prefilled
+        self._prefill_fn = jax.jit(prefill)
+
+    def _prefill(self, req, rng):
+        """Disaggregated prefill: run the identical recurrence for the
+        prompt's P-1 replay steps as a B=1 masked scan on the prefill
+        devices, returning (cache, buf_row, rng) at position P-1."""
+        import jax
+        import jax.numpy as jnp
+
+        from autodist_tpu.models.decoding import fresh_cache
+
+        dev = self.prefill_devices[0]
+        buf_row = np.zeros((1, self.max_total), np.int32)
+        buf_row[0, :req.prompt_len] = req.prompt
+        args = jax.device_put(
+            (self.params, fresh_cache(self.model, 1),
+             jnp.asarray(buf_row), jnp.int32(req.prompt_len), rng), dev)
+        cache, buf, rng = self._prefill_fn(*args)
+        # hand the prefilled KV block to the decode subset
+        block = (cache, buf[0], rng)
+        self.kv_handoff_bytes += sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(block))
+        return block
+
+    # -- request lifecycle --------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens):
+        """Queue one decode request; returns its lifecycle Request."""
+        prompt = list(int(t) for t in prompt)
+        if not prompt or max_new_tokens < 1:
+            raise ValueError("need a non-empty prompt and >= 1 new token")
+        if len(prompt) + max_new_tokens > self.max_total:
+            raise ValueError(
+                f"prompt {len(prompt)} + {max_new_tokens} new tokens exceed "
+                f"the slot buffer length {self.max_total}")
+        return self.queue.submit(prompt, max_new_tokens)
+
+    def _admit_pending(self, admitting=True):
+        import jax
+        import jax.numpy as jnp
+
+        if not admitting:
+            return 0
+        free = self.table.num_slots - self.table.num_live
+        n = 0
+        for req in self.queue.admissible(free, self.table.num_live):
+            slot = self.table.alloc(req.rid)
+            assert slot is not None  # admissible() respected free count
+            req.slot = slot
+            rng = jax.random.PRNGKey(self._rng_seed + req.rid)
+            if self.prefill_devices:
+                cache_one, buf_row, rng = self._prefill(req, rng)
+                cache_one, buf_row, rng = self._place_replicated(
+                    (cache_one, buf_row, rng)) if self.mesh is not None \
+                    else (cache_one, buf_row, rng)
+                self._caches, self._bufs, self._rngs = \
+                    self._admit_prefilled_fn(
+                        self._caches, self._bufs, self._rngs,
+                        jnp.int32(slot), cache_one, buf_row, rng)
+                self._ts[slot] = req.prompt_len - 1
+            else:
+                buf_row = np.zeros(self.max_total, np.int32)
+                buf_row[:req.prompt_len] = req.prompt
+                self._caches, self._bufs, self._rngs = self._admit_fn(
+                    self._caches, self._bufs, self._rngs, jnp.int32(slot),
+                    jnp.asarray(buf_row), rng)
+                self._ts[slot] = 0
+            self._pls[slot] = req.prompt_len
+            self._ends[slot] = req.total
+            self._active[slot] = True
+            self._requests[slot] = req
+            n += 1
+        return n
+
+    def _step(self, admitted=0):
+        """One continuously-batched decode step over the whole table."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        self._caches, self._bufs, self._rngs = self._batch_step(
+            self.params, self._caches, self._bufs,
+            jnp.asarray(self._ts), jnp.asarray(self._pls),
+            jnp.asarray(self._active), self._rngs)
+        jax.block_until_ready(self._bufs)
+        wall = time.perf_counter() - t0
+        self._ts[self._active] += 1
+        now = time.time()
+        tokens = 0
+        finished = 0
+        for slot in list(self._requests):
+            req = self._requests[slot]
+            if not self._active[slot]:
+                continue
+            if self._ts[slot] >= req.prompt_len:
+                tokens += 1        # a generated (non-replay) token landed
+                if req.first_token_s is None:
+                    req.first_token_s = now
+            if self._ts[slot] >= self._ends[slot] - 1:
+                req.finish_s = now
+                req.tokens = tuple(
+                    int(t) for t in
+                    np.asarray(self._bufs[slot])[:self._ends[slot]])
+                self._active[slot] = False
+                self.table.free(slot)
+                del self._requests[slot]
+                self._finished.append(req)
+                finished += 1
+                if self.telemetry is not None:
+                    self.telemetry.request_finished(req)
+        self._steps += 1
+        if self.telemetry is not None:
+            self.telemetry.step(
+                wall_s=wall, active=int(self._active.sum()),
+                queue_depth=self.queue.depth,
+                occupancy=self.table.occupancy, tokens=tokens,
+                admitted=admitted, finished=finished)
+        return finished
+
+    def run(self, *, max_steps=None, admitting=True):
+        """Drive admission + decode until queue and table are empty (or
+        ``max_steps``).  Returns the requests finished during this call."""
+        done0 = len(self._finished)
+        steps = 0
+        while self.queue.depth or self.table.num_live:
+            if max_steps is not None and steps >= max_steps:
+                break
+            admitted = self._admit_pending(admitting)
+            if not self.table.num_live:
+                if not admitting or not self.queue.depth:
+                    break
+                # nothing admitted yet (batching policy holding) — wait
+                time.sleep(min(self.queue.policy.max_wait_s, 0.005))
+                continue
+            self._step(admitted)
+            steps += 1
+        return self._finished[done0:]
+
+    # -- autoscale ----------------------------------------------------------
+
+    def drain(self):
+        """Stop admission and run the in-flight slots to completion."""
+        return self.run(admitting=False)
+
+    def rescale(self, num_slots, *, mesh=None, cause=None):
+        """Elastic shrink/grow: drain in-flight slots, re-plan the table
+        at ``num_slots`` (optionally on a new mesh — the R->R' move),
+        re-place params and rebuild state.  Queued requests survive.
+        Causality lands in the cluster event log when one is attached.
+        """
+        log = self.event_log
+        if log is not None and cause is None:
+            cause = log.note_signal(
+                "serve_rescale", step=self._steps,
+                code=f"slots {self.table.num_slots}->{num_slots}")
+        drained = self.drain()
+        old = self.table.num_slots
+        if mesh is not None:
+            # caller pinned the new device set: divisibility is on them
+            if num_slots % mesh.shape[SLOT_AXIS]:
+                raise ValueError(
+                    f"num_slots={num_slots} not divisible by mesh "
+                    f"{SLOT_AXIS}-axis size {mesh.shape[SLOT_AXIS]}")
+            self.mesh = mesh
+        elif self.mesh is not None and num_slots % self.mesh.shape[SLOT_AXIS]:
+            # the retained mesh no longer divides the resized table —
+            # re-shard over the largest dividing device subset (the same
+            # choice serve() makes), replicating when none divides
+            from jax.sharding import Mesh
+            devs = list(self.mesh.devices.flat)
+            d = max(k for k in range(1, min(len(devs), num_slots) + 1)
+                    if num_slots % k == 0)
+            self.mesh = Mesh(np.asarray(devs[:d]), (SLOT_AXIS,)) \
+                if d > 1 else None
+        self.plan = plan_slots(self.model, num_slots, self.max_total)
+        self.table = SlotTable(self.plan)
+        self.params = self._place_replicated(self.params)
+        self._init_state(num_slots)
+        self._build_step_fns()
+        if log is not None:
+            rec = log.record("membership_epoch", step=self._steps,
+                             cause=cause, drained=len(drained),
+                             slots_before=old, slots_after=int(num_slots))
+            log.record("replan", step=self._steps, cause=cause,
+                       bytes_per_slot=self.plan.bytes_per_slot,
+                       blocks_per_slot=self.plan.blocks_per_slot)
+            if self.telemetry is not None:
+                self.telemetry.event(rec)
+        logging.info("serving: rescaled %d -> %d slots (%d drained)",
+                     old, num_slots, len(drained))
+        return drained
+
+    # -- reporting -----------------------------------------------------------
+
+    def finished(self):
+        return list(self._finished)
+
+    def stats(self):
+        s = self.table.stats()
+        s.update(steps=self._steps, queue_depth=self.queue.depth,
+                 kv_handoff_bytes=self.kv_handoff_bytes)
+        return s
+
+    def finalize(self):
+        """Finalize attached telemetry (no-op without telemetry)."""
+        if self.telemetry is None:
+            return None
+        return self.telemetry.finalize(slot_stats=self.table.stats())

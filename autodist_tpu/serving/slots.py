@@ -1,0 +1,197 @@
+"""Slot-based KV-cache planning over the mesh.
+
+A *slot* is one request's worth of decode state: the B=1 KV-cache tree
+of the ``decode=True`` module plus its (total,) token buffer.  The slot
+table stacks ``num_slots`` of those along a leading slot axis; this
+module plans that table with the machinery the training tiers already
+trust:
+
+* the per-slot cache leaves become :class:`~autodist_tpu.kernel.
+  partitioner.VarPlan` entries, packed into fixed-size *blocks* through
+  :func:`~autodist_tpu.kernel.synchronization.all_reduce.plan_buckets`
+  (the bucket planner's grouping doubles as the slot allocator's block
+  accounting — a freed slot returns whole blocks, never fragments);
+* the stacked (S, ...) table leaves get their mesh layout from
+  :func:`~autodist_tpu.kernel.partitioner.storage_spec` on a SHARDED
+  plan whose partition axis is the slot axis.
+
+Host-side, :class:`SlotTable` is the free-list: O(1) alloc/free with
+double-free protection and fragmentation stats for Q002.
+"""
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from autodist_tpu.kernel.partitioner import (Placement, SyncKind, VarPlan,
+                                             storage_spec)
+from autodist_tpu.kernel.synchronization.all_reduce import plan_buckets
+
+# Block-packing bound: cache leaves are greedily packed into blocks of
+# at most this many bytes (one bucket-planner group per block).  Small
+# enough that a GPT_TINY layer splits into >1 block in tests, large
+# enough that real models don't explode the block count.
+DEFAULT_BLOCK_BYTES = 4 << 20
+
+SLOT_AXIS = "slot"
+
+
+def _flatten_cache_shapes(model) -> List[Tuple[str, tuple, object]]:
+    """(name, per_slot_shape, dtype) per cache leaf of the B=1 module."""
+    import jax
+    from autodist_tpu.models.decoding import _cache_shapes
+
+    tmpl = _cache_shapes(model, 1)
+    def is_leaf(x):
+        return (isinstance(x, tuple) and len(x) == 2
+                and isinstance(x[0], tuple))
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tmpl, is_leaf=is_leaf)
+    out = []
+    for path, (shape, dtype) in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        out.append((name, tuple(shape), dtype))
+    return out
+
+
+def cache_leaf_plans(model, block_bytes=DEFAULT_BLOCK_BYTES
+                     ) -> Dict[str, VarPlan]:
+    """Per-slot VarPlans for the cache leaves, with bucket groups
+    assigned by greedy byte packing so ``plan_buckets`` emits blocks of
+    at most ``block_bytes`` each."""
+    plans = {}
+    group, acc = 0, 0
+    for name, shape, dtype in _flatten_cache_shapes(model):
+        nbytes = int(np.prod(shape) if shape else 1) * np.dtype(dtype).itemsize
+        if acc and acc + nbytes > block_bytes:
+            group, acc = group + 1, 0
+        acc += nbytes
+        plans[name] = VarPlan(
+            name=name, shape=shape, dtype=dtype,
+            placement=Placement.REPLICATED, sync=SyncKind.ALL_REDUCE,
+            group=group)
+    return plans
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotPlan:
+    """The planned slot table: leaf inventory, block packing, layout."""
+
+    num_slots: int
+    max_total: int                 # token-buffer length per slot
+    leaf_names: tuple              # cache leaves, flattened order
+    leaf_shapes: tuple             # per-slot (B=1) shapes
+    leaf_dtypes: tuple
+    blocks: tuple                  # Buckets over the per-slot leaves
+    bytes_per_slot: int            # cache + token buffer, one slot
+    table_specs: tuple             # PartitionSpec per leaf, slot axis sharded
+
+    @property
+    def total_bytes(self) -> int:
+        return self.bytes_per_slot * self.num_slots
+
+    @property
+    def blocks_per_slot(self) -> int:
+        return len(self.blocks)
+
+
+def plan_slots(model, num_slots, max_total,
+               block_bytes=DEFAULT_BLOCK_BYTES) -> SlotPlan:
+    """Plan a ``num_slots``-wide table of B=1 decode slots for ``model``.
+
+    Reuses the training planners end to end: cache leaves -> VarPlans ->
+    ``plan_buckets`` blocks (allocation granularity), stacked table
+    leaves -> SHARDED-over-slot-axis plans -> ``storage_spec`` layouts.
+    """
+    plans = cache_leaf_plans(model, block_bytes)
+    shapes = {n: p.shape for n, p in plans.items()}
+    dtypes = {n: p.dtype for n, p in plans.items()}
+    blocks = plan_buckets(plans, shapes, dtypes)
+    names = tuple(sorted(plans))
+    cache_bytes = sum(
+        int(np.prod(shapes[n]) if shapes[n] else 1)
+        * np.dtype(dtypes[n]).itemsize for n in names)
+    specs = []
+    for n in names:
+        table = VarPlan(
+            name=n, shape=(num_slots,) + shapes[n], dtype=dtypes[n],
+            placement=Placement.SHARDED, sync=SyncKind.ALL_REDUCE,
+            partition_axis=0, padded_dim=num_slots)
+        specs.append(storage_spec(table, replica_axis=SLOT_AXIS))
+    return SlotPlan(
+        num_slots=int(num_slots), max_total=int(max_total),
+        leaf_names=names,
+        leaf_shapes=tuple(shapes[n] for n in names),
+        leaf_dtypes=tuple(dtypes[n] for n in names),
+        blocks=tuple(blocks),
+        bytes_per_slot=cache_bytes + max_total * 4,  # + int32 token buf
+        table_specs=tuple(specs))
+
+
+class SlotTable:
+    """Host-side free-list over the planned slots.
+
+    Allocation is whole-slot (and therefore whole-block: every slot owns
+    the same ``plan.blocks`` packing), so the only fragmentation mode is
+    *occupancy* fragmentation — live slots scattered across a mostly-
+    free table.  :meth:`stats` reports it for the Q002 audit.
+    """
+
+    def __init__(self, plan: SlotPlan):
+        self.plan = plan
+        self._free = list(range(plan.num_slots - 1, -1, -1))  # pop() -> 0 first
+        self._live: Dict[int, object] = {}   # slot -> request id
+        self._high_water = 0
+        self.total_allocs = 0
+
+    @property
+    def num_slots(self) -> int:
+        return self.plan.num_slots
+
+    @property
+    def num_live(self) -> int:
+        return len(self._live)
+
+    @property
+    def occupancy(self) -> float:
+        return self.num_live / max(1, self.num_slots)
+
+    def live_slots(self) -> List[int]:
+        return sorted(self._live)
+
+    def owner(self, slot: int):
+        return self._live.get(slot)
+
+    def alloc(self, request_id) -> Optional[int]:
+        """Claim a free slot for ``request_id``; None when full."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._live[slot] = request_id
+        self._high_water = max(self._high_water, self.num_live)
+        self.total_allocs += 1
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._live:
+            raise ValueError(f"slot {slot} is not live (double free?)")
+        del self._live[slot]
+        self._free.append(slot)
+
+    def stats(self) -> dict:
+        """Occupancy + fragmentation summary (feeds Q002 / the serving
+        telemetry gauges).  ``fragmentation`` is the fraction of the
+        high-water span not currently live — 0.0 when the live slots
+        are packed at the low end."""
+        span = max(self._live) + 1 if self._live else 0
+        frag = 1.0 - self.num_live / span if span else 0.0
+        return {
+            "num_slots": self.num_slots,
+            "live": self.num_live,
+            "occupancy": self.occupancy,
+            "high_water": self._high_water,
+            "fragmentation": frag,
+            "total_allocs": self.total_allocs,
+            "bytes_per_slot": self.plan.bytes_per_slot,
+            "blocks_per_slot": self.plan.blocks_per_slot,
+        }

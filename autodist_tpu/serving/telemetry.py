@@ -1,0 +1,145 @@
+"""Serving telemetry: the schema-v4 manifest writer for the decode tier.
+
+Mirrors :class:`~autodist_tpu.telemetry.session.SessionTelemetry` for
+the serving engine: one ``serving_step`` JSONL row per continuously-
+batched decode step (wall, live slots, queue depth, occupancy, tokens
+decoded), one ``serving_request`` row per finished request (queue wait,
+TTFT, end-to-end latency), and a summary trailer whose ``serving``
+block carries the fleet-level numbers the Q-code audit gates:
+tokens/sec, TTFT p50/p99, latency p50/p99, mean occupancy, max queue
+depth.  The finalized manifest validates under
+:func:`~autodist_tpu.telemetry.schema.validate_manifest` as schema v4.
+"""
+import os
+import time
+
+from autodist_tpu.utils import logging
+
+
+class ServingTelemetry:
+    def __init__(self, *, run_dir=None, run_id=None, worker=0,
+                 num_devices=None, registry=None):
+        from autodist_tpu import telemetry
+        from autodist_tpu.telemetry.metrics import JsonlWriter
+        from autodist_tpu.telemetry.schema import SCHEMA_VERSION
+
+        self.run_id = run_id or time.strftime("%Y%m%d%H%M%S") + \
+            f"-serve-{os.getpid()}"
+        self.run_dir = run_dir or telemetry.default_run_dir(self.run_id)
+        self.worker = int(worker)
+        self.registry = registry or telemetry.get_registry()
+        self._writer = JsonlWriter(
+            os.path.join(self.run_dir, f"worker_{self.worker}.jsonl"),
+            worker=self.worker)
+        self._steps = 0
+        self._walls = []
+        self._tokens = 0
+        self._occs = []
+        self._queue_max = 0
+        self._requests = []            # finished-request record dicts
+        self._t_start = time.perf_counter()
+        self.finalized = False
+        import jax
+
+        self._writer.write({
+            "kind": "meta", "t": time.time(), "run_id": self.run_id,
+            "schema": SCHEMA_VERSION, "backend": jax.default_backend(),
+            "num_devices": int(num_devices if num_devices is not None
+                               else jax.device_count()),
+            "run_dir": self.run_dir, "tier": "serving",
+        })
+
+    @property
+    def path(self):
+        return self._writer.path
+
+    # -- per-step / per-request hooks (called by ServingEngine) ------------
+
+    def step(self, *, wall_s, active, queue_depth, occupancy, tokens,
+             admitted=0, finished=0):
+        rec = {"kind": "serving_step", "t": time.time(),
+               "step": self._steps, "wall_s": float(wall_s),
+               "active": int(active), "queue_depth": int(queue_depth),
+               "occupancy": float(occupancy), "tokens": int(tokens),
+               "admitted": int(admitted), "finished": int(finished)}
+        self._steps += 1
+        self._walls.append(float(wall_s))
+        self._tokens += int(tokens)
+        self._occs.append(float(occupancy))
+        self._queue_max = max(self._queue_max, int(queue_depth))
+        self._writer.write(rec)
+        self.registry.histogram("serving.step_wall_s", float(wall_s))
+        self.registry.gauge("serving.occupancy", float(occupancy))
+        self.registry.gauge("serving.queue_depth", float(queue_depth))
+        return rec
+
+    def request_finished(self, request):
+        """Record a finished :class:`~autodist_tpu.serving.admission.
+        Request`'s lifecycle trailer."""
+        rec = {"kind": "serving_request", "t": time.time(),
+               **request.record()}
+        self._requests.append(rec)
+        self._writer.write(rec)
+        self.registry.counter("serving.requests_finished")
+        return rec
+
+    def event(self, rec):
+        """Pass a cluster_event record (autoscale causality) through to
+        this manifest, so drain/rescale actions land next to the serving
+        rows they interrupt."""
+        self._writer.write(dict(rec))
+
+    # -- run trailer -------------------------------------------------------
+
+    def serving_summary(self) -> dict:
+        """The fleet-level serving block (also the Q-audit's metrics
+        input): computed live so callers can audit before finalize."""
+        from autodist_tpu.telemetry.metrics import percentiles
+
+        wall_total = sum(self._walls)
+        ttfts = sorted(r["ttft_s"] for r in self._requests
+                       if r.get("ttft_s") is not None)
+        lats = sorted(r["latency_s"] for r in self._requests
+                      if r.get("latency_s") is not None)
+        tp = percentiles(ttfts) if ttfts else {}
+        lp = percentiles(lats) if lats else {}
+        return {
+            "steps": self._steps,
+            "requests": len(self._requests),
+            "tokens": self._tokens,
+            "tokens_per_s": self._tokens / wall_total if wall_total else 0.0,
+            "ttft_p50_s": tp.get(0.5),
+            "ttft_p99_s": tp.get(0.99),
+            "latency_p50_s": lp.get(0.5),
+            "latency_p99_s": lp.get(0.99),
+            "occupancy_mean": (sum(self._occs) / len(self._occs)
+                               if self._occs else 0.0),
+            "queue_depth_max": self._queue_max,
+        }
+
+    def finalize(self, slot_stats=None):
+        """Write the summary trailer (with the ``serving`` block) and
+        merge worker manifests.  Idempotent; returns the manifest path."""
+        from autodist_tpu.telemetry.aggregate import merge_worker_manifests
+        from autodist_tpu.telemetry.metrics import percentiles
+
+        if self.finalized or self._steps == 0:
+            return None
+        ps = percentiles(self._walls)
+        serving = self.serving_summary()
+        if slot_stats:
+            serving["slots"] = dict(slot_stats)
+        summary = {"kind": "summary", "t": time.time(), "steps": self._steps,
+                   "step_time_p50_s": ps[0.5], "step_time_p90_s": ps[0.9],
+                   "step_time_p99_s": ps[0.99], "serving": serving,
+                   "aggregates": self.registry.aggregates()}
+        self._writer.write(summary)
+        manifest = None
+        if self.worker == 0:
+            manifest = merge_worker_manifests(self.run_dir)
+        self.finalized = True
+        logging.info(
+            "serving telemetry: run %s — %d steps, %d requests, %.1f tok/s "
+            "(manifest: %s)", self.run_id, self._steps, serving["requests"],
+            serving["tokens_per_s"], manifest or self._writer.path)
+        return manifest or self._writer.path

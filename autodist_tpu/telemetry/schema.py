@@ -10,8 +10,10 @@ One JSON object per line.  Every record carries:
 The ``meta`` header stamps :data:`SCHEMA_VERSION` as ``schema`` (v2
 introduced the ``health_finding`` kind and the summary's ``health``
 block; v3 the ``cluster_event`` kind — the causal control-plane log of
-:mod:`~autodist_tpu.telemetry.events`; v1 manifests carry no stamp and
-still validate — unknown kinds were always tolerated).
+:mod:`~autodist_tpu.telemetry.events`; v4 the serving tier's
+``serving_step`` / ``serving_request`` kinds and the summary's
+``serving`` block; v1 manifests carry no stamp and still validate —
+unknown kinds were always tolerated).
 
 Kinds and their required fields (``docs/observability.md`` is the prose
 version; ``make telemetry-check`` asserts a live run validates):
@@ -45,13 +47,23 @@ version; ``make telemetry-check`` asserts a live run validates):
                   ``persistent``; actions optionally add ``cause`` (the
                   triggering signal's worker/step/code/t) and the
                   measured signal->action ``latency_s``
+- ``serving_step`` — one continuously-batched decode step
+                  (:mod:`~autodist_tpu.serving.telemetry`): ``step``,
+                  ``wall_s``; optional ``active`` (live slots),
+                  ``queue_depth``, ``occupancy``, ``tokens``
+                  (decoded this step), ``admitted``, ``finished``
+- ``serving_request`` — per-request lifecycle trailer: ``rid``;
+                  optional ``prompt_len``, ``max_new_tokens``,
+                  ``slot``, ``queue_s``, ``ttft_s``, ``latency_s``
 - ``summary``   — run trailer: ``steps``, ``step_time_p50_s``;
                   optional ``mfu_p50``, ``compile_s``,
-                  ``runtime_record``, ``aggregates``, ``health``
+                  ``runtime_record``, ``aggregates``, ``health``,
+                  ``serving`` (tokens/sec, TTFT + tail-latency
+                  percentiles, occupancy mean, queue-depth max)
 """
 import json
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 REQUIRED_COMMON = ("kind",)
 
@@ -66,6 +78,8 @@ REQUIRED_BY_KIND = {
     "watchdog": ("step", "trace_dir"),
     "health_finding": ("step", "check"),
     "cluster_event": ("event",),
+    "serving_step": ("step", "wall_s"),
+    "serving_request": ("rid",),
     "summary": ("steps", "step_time_p50_s"),
 }
 
@@ -76,6 +90,10 @@ NUMERIC_FIELDS = {
     "span": ("ts", "dur"),
     "health_finding": ("step",),
     "cluster_event": ("latency_s",),
+    "serving_step": ("step", "wall_s", "active", "queue_depth", "occupancy",
+                     "tokens", "admitted", "finished"),
+    "serving_request": ("rid", "prompt_len", "max_new_tokens", "queue_s",
+                        "ttft_s", "latency_s"),
 }
 
 

@@ -103,6 +103,11 @@ MFU_PASS_BAR = 0.35
 # raw jitted step over the same math — tracks the ENGINE's overhead
 # trajectory between on-chip windows (r01-r05 all missed the TPU relay)
 CPU_PROXY_METRIC = "cpu_mesh_engine_overhead"
+# BENCH_SERVE=1: also measure the serving tier's continuous-batching
+# decode overhead vs static generate() rollouts on the CPU mesh (the
+# gpt_tiny_serve_decode record make perf-gate diffs against its blessed
+# baseline; docs/serving.md)
+SERVE_PROXY_METRIC = "serving_decode_overhead"
 # narrow OOM markers only — a bare "Allocator" matches generic XLA error
 # text and would silently halve the headline batch (ADVICE r2)
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
@@ -627,6 +632,23 @@ def _cpu_proxy(steps=8):
     return out
 
 
+def _serve_proxy():
+    """CPU-mesh serving proxy (``BENCH_SERVE=1``): the continuous-batching
+    decode engine timed against static per-request ``generate()`` rollouts
+    on the same request set — the serving tier's engine-overhead
+    trajectory point, machine-normalized like ``_cpu_proxy``."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ.setdefault("AUTODIST_IS_TESTING", "True")  # two sessions
+    _force_requested_platform()
+    from autodist_tpu.serving.benchmark import measure_serve_decode
+
+    return measure_serve_decode()
+
+
 # --------------------------------------------------------------- parent --
 
 def _run_child(env_extra, timeout_s):
@@ -644,6 +666,8 @@ def _run_child(env_extra, timeout_s):
     metric = MODELS.get(child_model, MODELS["resnet50"])["metric"]
     if "_BENCH_CPU_PROXY" in env_extra:
         metric = CPU_PROXY_METRIC
+    if "_BENCH_SERVE_PROXY" in env_extra:
+        metric = SERVE_PROXY_METRIC
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)], env=env,
@@ -686,6 +710,23 @@ def _attach_cpu_proxy(rec, budget, t_start):
     return rec
 
 
+def _attach_serve_proxy(rec, budget, t_start):
+    """``BENCH_SERVE=1``: attach the serving-tier decode-overhead record
+    (continuous batching vs static rollouts on the CPU mesh) — opt-in,
+    budget-guarded and best-effort like the cpu proxy."""
+    if os.environ.get("BENCH_SERVE", "0") == "0" \
+            or rec.get("serve_proxy") is not None:
+        return rec
+    remaining = budget - (time.monotonic() - t_start) - 30
+    if remaining > 45:
+        prox, _info, _out = _run_child({"_BENCH_SERVE_PROXY": "1",
+                                        "JAX_PLATFORMS": "cpu"},
+                                       int(min(180, remaining)))
+        if prox is not None:
+            rec["serve_proxy"] = prox
+    return rec
+
+
 def main():
     name = os.environ.get("BENCH_MODEL", "resnet50")
     if name not in MODELS:
@@ -700,6 +741,15 @@ def main():
     if os.environ.get("_BENCH_CPU_PROXY"):
         try:
             print(json.dumps(_cpu_proxy()), flush=True)
+        except BaseException:
+            import traceback
+
+            traceback.print_exc()
+            sys.exit(1)
+        return
+    if os.environ.get("_BENCH_SERVE_PROXY"):
+        try:
+            print(json.dumps(_serve_proxy()), flush=True)
         except BaseException:
             import traceback
 
@@ -768,7 +818,8 @@ def main():
         # relay down: run the CPU-mesh proxy so THIS round still records
         # an engine-overhead number (the perf trajectory r01-r05 lost) —
         # clearly a pipeline artifact, never merged into hardware claims
-        _emit(_attach_cpu_proxy(rec, budget, t_start))
+        _emit(_attach_serve_proxy(_attach_cpu_proxy(rec, budget, t_start),
+                                  budget, t_start))
         return
     probe["n_probe_attempts"] = len(attempts) + 1
 
@@ -787,7 +838,9 @@ def main():
                 rec["fallback_from"] = {
                     "metric": MODELS[_model_name()]["metric"],
                     "error": last_err[:500]}
-                _emit(_attach_cpu_proxy(rec, budget, t_start))
+                _emit(_attach_serve_proxy(
+                    _attach_cpu_proxy(rec, budget, t_start),
+                    budget, t_start))
                 return
             last_err += f" | gpt_small fallback: {gpt_err}"
         _emit(_error_rec("all_attempts_failed",
@@ -832,7 +885,8 @@ def main():
                                     t_start, max_tries=1)
             if gpt is not None:
                 rec["secondary"] = gpt
-    _emit(_attach_cpu_proxy(rec, budget, t_start))
+    _emit(_attach_serve_proxy(_attach_cpu_proxy(rec, budget, t_start),
+                              budget, t_start))
 
 
 def _measure_model(name, env_extra, probe, budget, t_start, max_tries=2):

@@ -383,6 +383,31 @@ def sweep(args):
     return summary
 
 
+def serve(args):
+    """Serving decode variant (``--serve``): continuous batching through
+    the ServingEngine vs static per-request ``generate()`` rollouts on
+    the same request set; writes the ``gpt_tiny_serve_decode`` record
+    ``make perf-gate`` diffs against its blessed baseline."""
+    import json
+
+    from autodist_tpu.serving.benchmark import (SERVE_RECORD_NAME,
+                                                measure_serve_decode)
+
+    if args.model not in ("resnet50", "gpt_tiny"):  # resnet50 = default
+        raise SystemExit(f"--serve measures the gpt_tiny decode service, "
+                         f"not {args.model}")
+    os.environ["AUTODIST_IS_TESTING"] = "True"  # engine + rollout sessions
+    rec = measure_serve_decode()
+    print(json.dumps(rec))
+    if args.records_dir:
+        os.makedirs(args.records_dir, exist_ok=True)
+        path = os.path.join(args.records_dir, f"{SERVE_RECORD_NAME}.json")
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path}")
+
+
 def _parse_mesh(mesh_arg):
     """``"replica_dcn=2,replica_ici=4"`` -> {axis: size} or None."""
     if not mesh_arg:
@@ -446,8 +471,16 @@ def main():
                          "(ops/losses.py) — no (B,S,V) logits allocation")
     ap.add_argument("--remat", action="store_true",
                     help="GPT/Llama: per-block rematerialization")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving decode variant: continuous batching "
+                         "through the ServingEngine vs static generate() "
+                         "rollouts (writes gpt_tiny_serve_decode.json "
+                         "under --records_dir)")
     args = ap.parse_args()
 
+    if args.serve:
+        serve(args)
+        return
     if args.strategies:
         sweep(args)
         return

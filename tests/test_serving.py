@@ -1,0 +1,480 @@
+"""Serving tier: slot allocation, admission policy, the continuous-
+batching engine, schema-v4 serving telemetry, and the Q-code audit.
+
+Pinned here:
+
+- :class:`~autodist_tpu.serving.slots.SlotTable` free-list edges:
+  fill-to-capacity (alloc -> None when full), admit-into-freed-slot,
+  double-free protection, occupancy/fragmentation accounting,
+- :func:`~autodist_tpu.serving.slots.plan_slots` byte/block math riding
+  the training planners (VarPlans -> ``plan_buckets`` blocks ->
+  ``storage_spec`` slot-axis layouts),
+- :class:`~autodist_tpu.serving.admission.AdmissionQueue` policy:
+  max-slots headroom, min-batch hold, max-wait aging,
+- the engine: staggered admissions with VARIABLE prompt lengths all
+  bit-matching the static ``generate()`` rollout through ONE executable,
+  admit-into-freed-slot mid-run without recompiling, drain-on-shrink
+  via ``rescale()`` (queued requests survive, causality recorded),
+- schema-v4 manifest validation of the serving telemetry,
+- the Q-code audit (Q001-Q004 + fixtures + ``load_metrics`` forms),
+- ``clear_decode_caches()`` and the AD08 lint rule, both directions.
+"""
+import importlib.util
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from autodist_tpu.serving.admission import AdmissionQueue, BatchPolicy
+from autodist_tpu.serving.engine import ServingEngine
+from autodist_tpu.serving.slots import SlotTable, plan_slots
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MAX_TOTAL = 16
+# variable prompt lengths on purpose: they must share one executable
+REQUESTS = [((5, 7, 9), 6), ((11, 3, 2, 8, 1), 4), ((42,), 8),
+            ((9, 9, 9, 9), 5)]
+
+
+@pytest.fixture(scope="module")
+def decode_setup():
+    from autodist_tpu.models.gpt import GPT, GPT_TINY
+
+    cfg = GPT_TINY
+    model = GPT(cfg, decode=True)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 1), np.int32))["params"]
+    return cfg, model, params
+
+
+def _bit_match(cfg, model, params, finished):
+    from autodist_tpu.models.decoding import generate
+
+    assert finished
+    for req in finished:
+        ref = np.asarray(generate(model, cfg.max_position, params,
+                                  np.asarray([req.prompt], np.int32),
+                                  req.max_new_tokens))[0]
+        assert np.array_equal(np.asarray(req.tokens), ref), \
+            f"request {req.rid} diverges from generate()"
+
+
+# -- SlotTable free-list -----------------------------------------------------
+
+
+def test_slot_table_fill_to_capacity(decode_setup):
+    _, model, _ = decode_setup
+    table = SlotTable(plan_slots(model, 3, MAX_TOTAL))
+    slots = [table.alloc(rid) for rid in range(3)]
+    assert slots == [0, 1, 2]            # low slots first
+    assert table.alloc(99) is None       # full: None, never an exception
+    assert table.num_live == 3 and table.occupancy == 1.0
+    assert table.owner(1) == 1
+
+
+def test_slot_table_free_then_realloc(decode_setup):
+    _, model, _ = decode_setup
+    table = SlotTable(plan_slots(model, 2, MAX_TOTAL))
+    a, b = table.alloc("r0"), table.alloc("r1")
+    table.free(a)
+    assert table.alloc("r2") == a        # the freed slot is reused
+    assert table.stats()["total_allocs"] == 3
+    assert table.stats()["high_water"] == 2
+    assert table.owner(b) == "r1"
+
+
+def test_slot_table_double_free_raises(decode_setup):
+    _, model, _ = decode_setup
+    table = SlotTable(plan_slots(model, 2, MAX_TOTAL))
+    s = table.alloc("r0")
+    table.free(s)
+    with pytest.raises(ValueError, match="double free"):
+        table.free(s)
+    with pytest.raises(ValueError):
+        table.free(1)                    # never allocated
+
+
+def test_slot_table_fragmentation(decode_setup):
+    _, model, _ = decode_setup
+    table = SlotTable(plan_slots(model, 4, MAX_TOTAL))
+    for rid in range(4):
+        table.alloc(rid)
+    for s in (0, 1, 2):
+        table.free(s)
+    st = table.stats()                   # one live slot stranded at 3
+    assert st["live"] == 1 and st["occupancy"] == 0.25
+    assert st["fragmentation"] == pytest.approx(0.75)
+    table.free(3)
+    assert table.stats()["fragmentation"] == 0.0   # empty table: packed
+
+
+# -- plan_slots accounting ---------------------------------------------------
+
+
+def test_plan_slots_byte_and_block_accounting(decode_setup):
+    _, model, _ = decode_setup
+    plan = plan_slots(model, 4, MAX_TOTAL)
+    assert plan.num_slots == 4 and plan.max_total == MAX_TOTAL
+    assert plan.leaf_names == tuple(sorted(plan.leaf_names))
+    assert len(plan.table_specs) == len(plan.leaf_names)
+    cache_bytes = sum(
+        int(np.prod(s) if s else 1) * np.dtype(d).itemsize
+        for s, d in zip(plan.leaf_shapes, plan.leaf_dtypes))
+    assert plan.bytes_per_slot == cache_bytes + MAX_TOTAL * 4
+    assert plan.total_bytes == plan.bytes_per_slot * 4
+    assert plan.blocks_per_slot >= 1
+
+
+def test_plan_slots_block_bytes_bounds_packing(decode_setup):
+    _, model, _ = decode_setup
+    coarse = plan_slots(model, 2, MAX_TOTAL)
+    fine = plan_slots(model, 2, MAX_TOTAL, block_bytes=1)
+    # a 1-byte bound forces one block per leaf; packing only merges
+    assert fine.blocks_per_slot == len(fine.leaf_names)
+    assert coarse.blocks_per_slot <= fine.blocks_per_slot
+    assert coarse.bytes_per_slot == fine.bytes_per_slot  # packing, not size
+
+
+# -- AdmissionQueue policy ---------------------------------------------------
+
+
+def test_admission_fifo_and_free_slot_cap():
+    q = AdmissionQueue(BatchPolicy(max_wait_s=0.0))
+    reqs = [q.submit((1, 2), 3) for _ in range(3)]
+    assert [r.rid for r in reqs] == [0, 1, 2]
+    assert q.depth == 3 and q.depth_max == 3
+    out = q.admissible(free_slots=2, live=0)
+    assert [r.rid for r in out] == [0, 1]      # FIFO, capped by free slots
+    assert q.depth == 1
+    assert all(r.admit_s is not None for r in out)
+
+
+def test_admission_max_slots_headroom():
+    q = AdmissionQueue(BatchPolicy(max_slots=2, max_wait_s=0.0))
+    q.submit((1,), 2)
+    assert q.admissible(free_slots=3, live=2) == []   # at the policy cap
+    assert q.depth == 1
+    assert len(q.admissible(free_slots=3, live=1)) == 1
+
+
+def test_admission_min_batch_holds_until_aged():
+    now = [100.0]
+    q = AdmissionQueue(BatchPolicy(min_batch=2, max_wait_s=5.0),
+                       clock=lambda: now[0])
+    q.submit((1,), 2)
+    assert q.admissible(free_slots=4, live=0) == []   # holding for a batch
+    now[0] += 6.0                                     # head aged past max_wait
+    assert len(q.admissible(free_slots=4, live=0)) == 1
+
+
+# -- the engine --------------------------------------------------------------
+
+
+def test_engine_staggered_admissions_bit_match_one_executable(decode_setup):
+    cfg, model, params = decode_setup
+    eng = ServingEngine(model, params, max_total=MAX_TOTAL, num_slots=4)
+    for prompt, n in REQUESTS[:2]:
+        eng.submit(prompt, n)
+    eng.run(max_steps=3)                       # mid-flight...
+    for prompt, n in REQUESTS[2:]:
+        eng.submit(prompt, n)                  # ...admitted into live table
+    finished = eng.run()
+    assert len(eng.finished()) == len(REQUESTS)
+    assert {r.rid for r in eng.finished()} == set(range(len(REQUESTS)))
+    # variable prompt lengths (1..5 tokens) all replay bit-exactly
+    _bit_match(cfg, model, params, eng.finished())
+    assert finished                            # run() returns its own batch
+    # ONE executable for the life of the engine: prompt length and
+    # position are data, so no admission ever retraced the batch step
+    if hasattr(eng._batch_step, "_cache_size"):
+        assert eng._batch_step._cache_size() == 1
+    assert eng.stats()["steps"] > 0
+    assert eng.stats()["queue_depth"] == 0
+
+
+def test_engine_admits_into_freed_slot(decode_setup):
+    cfg, model, params = decode_setup
+    eng = ServingEngine(model, params, max_total=MAX_TOTAL, num_slots=2)
+    for prompt, n in REQUESTS[:3]:             # 3 requests, 2 slots
+        eng.submit(prompt, n)
+    assert eng.queue.depth == 3
+    eng.run(max_steps=1)
+    assert eng.queue.depth == 1                # third waits for a free slot
+    eng.run()
+    assert len(eng.finished()) == 3
+    assert eng.table.total_allocs == 3         # a freed slot was reclaimed
+    assert eng.table.stats()["high_water"] == 2
+    _bit_match(cfg, model, params, eng.finished())
+
+
+def test_engine_submit_validation(decode_setup):
+    _, model, params = decode_setup
+    eng = ServingEngine(model, params, max_total=MAX_TOTAL, num_slots=2)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.submit((), 3)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.submit((1, 2), 0)
+    with pytest.raises(ValueError, match="exceed"):
+        eng.submit(tuple(range(MAX_TOTAL)), 1)
+
+
+class _FakeEventLog:
+    """Captures the rescale causality contract the engine promises."""
+
+    def __init__(self):
+        self.records = []
+
+    def note_signal(self, kind, **kw):
+        rec = {"kind": kind, **kw, "id": len(self.records)}
+        self.records.append(rec)
+        return rec["id"]
+
+    def record(self, kind, **kw):
+        rec = {"kind": kind, **kw}
+        self.records.append(rec)
+        return rec
+
+
+def test_engine_rescale_drains_then_shrinks(decode_setup):
+    cfg, model, params = decode_setup
+    log = _FakeEventLog()
+    eng = ServingEngine(model, params, max_total=MAX_TOTAL, num_slots=4,
+                        event_log=log)
+    for prompt, n in REQUESTS:
+        eng.submit(prompt, n)
+    eng.run(max_steps=2)                       # 4 requests in flight
+    in_flight = eng.table.num_live
+    assert in_flight == 4
+    queued_before = eng.submit((3, 1), 4)      # survives the rescale queued
+    drained = eng.rescale(2)
+    assert len(drained) == in_flight           # drain ran the table dry
+    assert eng.table.num_slots == 2
+    assert eng.table.num_live == 0
+    assert eng.queue.depth == 1                # the queued request survived
+    # causality: signal -> membership_epoch + replan, cause threaded
+    kinds = [r["kind"] for r in log.records]
+    assert kinds[0] == "serve_rescale"
+    assert "membership_epoch" in kinds and "replan" in kinds
+    epoch = next(r for r in log.records if r["kind"] == "membership_epoch")
+    assert epoch["cause"] == log.records[0]["id"]
+    assert epoch["slots_before"] == 4 and epoch["slots_after"] == 2
+    assert epoch["drained"] == in_flight
+    # the shrunken engine still decodes correctly end to end
+    finished = eng.run()
+    assert [r.rid for r in finished] == [queued_before.rid]
+    _bit_match(cfg, model, params, eng.finished())
+
+
+def test_engine_rescale_rederives_mesh(decode_setup):
+    from jax.sharding import Mesh
+
+    cfg, model, params = decode_setup
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = Mesh(np.asarray(devs[:8]), ("slot",))
+    eng = ServingEngine(model, params, max_total=MAX_TOTAL, num_slots=8,
+                        mesh=mesh)
+    eng.rescale(4)                 # 8-device mesh no longer divides...
+    assert eng.mesh is not None
+    assert eng.mesh.shape["slot"] == 4     # ...re-sharded over a subset
+    assert eng.table.num_slots == 4
+    eng.submit(*REQUESTS[0])
+    eng.run()
+    _bit_match(cfg, model, params, eng.finished())
+    with pytest.raises(ValueError, match="not divisible"):
+        eng.rescale(4, mesh=Mesh(np.asarray(devs[:3]), ("slot",)))
+
+
+def test_engine_rejects_indivisible_mesh(decode_setup):
+    from jax.sharding import Mesh
+
+    _, model, params = decode_setup
+    devs = jax.devices()
+    if len(devs) < 3:
+        pytest.skip("needs >= 3 devices")
+    mesh = Mesh(np.asarray(devs[:3]), ("slot",))
+    with pytest.raises(ValueError, match="not divisible"):
+        ServingEngine(model, params, max_total=MAX_TOTAL, num_slots=4,
+                      mesh=mesh)
+
+
+# -- schema-v4 serving telemetry --------------------------------------------
+
+
+def test_serving_manifest_is_schema_v4(decode_setup, tmp_path):
+    from autodist_tpu import telemetry
+    from autodist_tpu.serving.telemetry import ServingTelemetry
+    from autodist_tpu.telemetry.schema import SCHEMA_VERSION
+
+    _, model, params = decode_setup
+    tel = ServingTelemetry(run_dir=str(tmp_path), run_id="serve-test")
+    eng = ServingEngine(model, params, max_total=MAX_TOTAL, num_slots=2,
+                        telemetry=tel)
+    for prompt, n in REQUESTS[:2]:
+        eng.submit(prompt, n)
+    eng.run()
+    manifest = eng.finalize()
+    assert manifest and os.path.exists(manifest)
+    assert eng.finalize() is None              # idempotent
+
+    records, errors = telemetry.validate_manifest(manifest)
+    assert errors == [], errors
+    kinds = [r.get("kind") for r in records]
+    assert kinds.count("serving_request") == 2
+    assert "serving_step" in kinds
+    meta = next(r for r in records if r.get("kind") == "meta")
+    assert meta["schema"] == SCHEMA_VERSION == 4
+    summary = next(r for r in records if r.get("kind") == "summary")
+    serving = summary["serving"]
+    assert serving["requests"] == 2
+    assert serving["tokens"] == sum(n for _, n in REQUESTS[:2])
+    for key in ("tokens_per_s", "ttft_p50_s", "ttft_p99_s",
+                "latency_p50_s", "latency_p99_s", "occupancy_mean",
+                "queue_depth_max", "slots"):
+        assert key in serving, key
+    assert serving["slots"]["num_slots"] == 2
+
+
+# -- the Q-code audit --------------------------------------------------------
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+def test_audit_fixture_clean_is_q004_only():
+    from autodist_tpu.analysis.serving_audit import audit_fixture
+
+    codes = _codes(audit_fixture("clean"))
+    assert codes == ["Q004"]
+
+
+def test_audit_fixture_overbudget_fires_q001():
+    from autodist_tpu.analysis.serving_audit import audit_fixture
+
+    findings = audit_fixture("overbudget")
+    codes = _codes(findings)
+    assert "Q001" in codes and "Q004" in codes
+    q4 = next(f for f in findings if f.code == "Q004")
+    assert q4.data["flagged"] == ["Q001"]
+    with pytest.raises(ValueError, match="unknown serving fixture"):
+        audit_fixture("bogus")
+
+
+def test_audit_q002_occupancy_collapse():
+    from autodist_tpu.analysis.serving_audit import (_CLEAN_METRICS,
+                                                     serving_audit)
+
+    starved = dict(_CLEAN_METRICS, occupancy_mean=0.2, queue_depth_max=5)
+    codes = _codes(serving_audit(starved, []))
+    assert "Q002" in codes
+    # an empty queue never fires Q002, however low occupancy sits
+    idle = dict(_CLEAN_METRICS, occupancy_mean=0.2, queue_depth_max=0)
+    assert "Q002" not in _codes(serving_audit(idle, []))
+
+
+def test_audit_q003_ttft_budget():
+    from autodist_tpu.analysis.serving_audit import (_CLEAN_METRICS,
+                                                     serving_audit)
+
+    slow = dict(_CLEAN_METRICS, ttft_p99_s=9.0)
+    assert "Q003" in _codes(serving_audit(slow, []))
+    assert "Q003" not in _codes(
+        serving_audit(slow, [], ttft_budget_s=10.0))   # budget overridable
+
+
+def test_audit_empty_metrics_is_q000():
+    from autodist_tpu.analysis.serving_audit import serving_audit
+
+    assert _codes(serving_audit({}, [])) == ["Q000"]
+
+
+def test_load_metrics_all_three_forms(tmp_path):
+    from autodist_tpu.analysis.serving_audit import load_metrics
+
+    serving = {"requests": 2, "tokens_per_s": 50.0, "occupancy_mean": 0.8}
+
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps(serving))
+    assert load_metrics(str(bare))["tokens_per_s"] == 50.0
+
+    summary = tmp_path / "summary.json"
+    summary.write_text(json.dumps(
+        {"kind": "summary", "step_time_p50_s": 0.01, "serving": serving}))
+    m = load_metrics(str(summary))
+    assert m["requests"] == 2
+    assert m["step_wall_p50_s"] == 0.01        # step p50 folded in
+
+    manifest = tmp_path / "manifest.jsonl"
+    manifest.write_text(
+        json.dumps({"kind": "meta", "schema": 4}) + "\n"
+        + json.dumps({"kind": "serving_step", "step": 0, "wall_s": 0.01})
+        + "\n"
+        + json.dumps({"kind": "summary", "step_time_p50_s": 0.02,
+                      "serving": serving}) + "\n")
+    m = load_metrics(str(manifest))
+    assert m["occupancy_mean"] == 0.8
+    assert m["step_wall_p50_s"] == 0.02
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"kind": "meta"}) + "\n")
+    assert load_metrics(str(empty)) is None
+
+
+# -- decode-cache hygiene ----------------------------------------------------
+
+
+def test_clear_decode_caches(decode_setup):
+    from autodist_tpu.models.decoding import (_cache_shapes, _make_rollout,
+                                              clear_decode_caches, generate)
+
+    cfg, model, params = decode_setup
+    generate(model, cfg.max_position, params,
+             np.asarray([[5, 7]], np.int32), 2)
+    assert _make_rollout.cache_info().currsize > 0
+    assert _cache_shapes.cache_info().currsize > 0
+    clear_decode_caches()
+    assert _make_rollout.cache_info().currsize == 0
+    assert _cache_shapes.cache_info().currsize == 0
+
+
+# -- AD08 lint ---------------------------------------------------------------
+
+
+def _lint_snippet(tmp_path, relpath, source):
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [code for _p, _ln, code, _m in lint.lint_file(p)]
+
+
+_AD08_CACHE = ("from autodist_tpu.models.decoding import fresh_cache\n"
+               "cache = fresh_cache(model, 1)\n")
+_AD08_TABLE = ("from autodist_tpu.serving.slots import SlotTable, plan_slots\n"
+               "table = SlotTable(plan_slots(model, 4, 32))\n")
+
+
+def test_ad08_flags_raw_cache_alloc_outside_decode_layer(tmp_path):
+    assert "AD08" in _lint_snippet(
+        tmp_path, "autodist_tpu/kernel/foo.py", _AD08_CACHE)
+    assert "AD08" in _lint_snippet(
+        tmp_path, "autodist_tpu/runner_helper.py", _AD08_TABLE)
+    assert "AD08" in _lint_snippet(tmp_path, "tools/foo.py", _AD08_CACHE)
+
+
+def test_ad08_exempts_decode_layer_and_tests(tmp_path):
+    assert "AD08" not in _lint_snippet(
+        tmp_path, "autodist_tpu/serving/foo.py", _AD08_CACHE)
+    assert "AD08" not in _lint_snippet(
+        tmp_path, "autodist_tpu/serving/engine.py", _AD08_TABLE)
+    assert "AD08" not in _lint_snippet(
+        tmp_path, "autodist_tpu/models/decoding.py", _AD08_CACHE)
+    assert "AD08" not in _lint_snippet(tmp_path, "tests/t.py", _AD08_CACHE)

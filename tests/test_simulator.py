@@ -175,8 +175,14 @@ def test_committed_cpu_records_load_and_are_labeled():
                         "cpu_mesh")
     from autodist_tpu.simulator.cost_model import RuntimeRecord
 
+    def _is_runtime_record(p):
+        # sweep dirs also hold non-RuntimeRecord artifacts (the serving
+        # decode record perf_gate owns)
+        with open(p) as f:
+            return {"model_def", "strategy"} <= set(json.load(f))
+
     recs = [p for p in glob.glob(os.path.join(root, "*.json"))
-            if not p.endswith("summary.json")]
+            if not p.endswith("summary.json") and _is_runtime_record(p)]
     assert len(recs) >= 3
     for p in recs:
         rec = RuntimeRecord.load(p)

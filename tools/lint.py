@@ -84,6 +84,17 @@ codebase:
         collective must be a function of the schedule program, not of the
         call site.  Scoped to ``autodist_tpu/`` and ``tools/``.
 
+  AD08  raw KV-cache / slot-buffer allocation outside the decode layer:
+        a ``fresh_cache``/``plan_slots``/``SlotTable`` call anywhere but
+        ``models/decoding.py`` (the cache template owner) and
+        ``autodist_tpu/serving/`` (the slot planner/engine that shards
+        it).  A locally-allocated cache bypasses the slot plan's
+        byte/block accounting, the shard-layout PartitionSpecs, and the
+        free-list's occupancy/fragmentation telemetry — the serving
+        audit (Q-codes) can only price what the slot table allocated.
+        Scoped to ``autodist_tpu/`` and ``tools/``; tests construct
+        caches and tables legitimately.
+
 Exit code 1 when any finding is reported.
 """
 import ast
@@ -176,6 +187,20 @@ def _ad07_applies(path):
     p = Path(path)
     return any(part in _AD01_PARTS for part in p.parts) \
         and p.name not in _AD07_EXEMPT
+
+
+# AD08 shares AD01's engine+tool scope; models/decoding.py owns the
+# cache template and autodist_tpu/serving/ owns slot planning/allocation
+_AD08_EXEMPT_NAME = "decoding.py"
+_AD08_EXEMPT_DIR = "serving"
+_AD08_CALLS = ("fresh_cache", "plan_slots", "SlotTable")
+
+
+def _ad08_applies(path):
+    p = Path(path)
+    return any(part in _AD01_PARTS for part in p.parts) \
+        and _AD08_EXEMPT_DIR not in p.parts \
+        and p.name != _AD08_EXEMPT_NAME
 
 
 class Checker(ast.NodeVisitor):
@@ -415,6 +440,19 @@ class Checker(ast.NodeVisitor):
                      "schedule_ir.py + all_reduce.run_schedule) so the "
                      "Y010/Y011 well-formedness checks and the X-audit's "
                      "intended channels stay authoritative")
+        # AD08: raw KV-cache / slot-buffer allocation — cache templates
+        # and slot tables belong to models/decoding.py + serving/
+        if _ad08_applies(self.path):
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if name in _AD08_CALLS:
+                self.add(node.lineno, "AD08",
+                         f"raw KV-cache/slot allocation ({name}) outside "
+                         f"models/decoding.py + serving/: route cache "
+                         f"construction through the slot planner "
+                         f"(serving/slots.py) so byte/block accounting, "
+                         f"shard layout and occupancy telemetry stay "
+                         f"authoritative")
         # AD03: a shape-product inside flops-named code re-derives FLOP
         # accounting that must come from simulator/cost_model.py
         if (self._flop_ctx and self._is_prod_call(node)

@@ -16,6 +16,14 @@ baseline in ``records/baselines/<name>.json``:
   zero chips) fail the gate;
 - a case with no blessed baseline fails with instructions to bless one.
 
+The serving record (``gpt_tiny_serve_decode.json`` — not a
+RuntimeRecord) gets its own leg: the continuous-batching decode engine
+is re-measured against static ``generate()`` rollouts
+(:mod:`autodist_tpu.serving.benchmark`) and the machine-normalized
+``serving_decode_overhead`` ratio gated against its blessed baseline,
+so the serving tier's tokens/sec overhead trajectory rides the same
+gate between chip windows.
+
 ``--update-baseline`` re-blesses the measured level (run after an
 *intentional* perf change, commit the rewritten files);
 ``--selftest`` proves the tier's teeth on the golden fixtures under
@@ -24,6 +32,7 @@ NaN manifest must fire R002, the control must stay clean).
 """
 import argparse
 import glob
+import json
 import os
 import sys
 
@@ -40,6 +49,11 @@ if _REPO not in sys.path:
 
 STEPS = 5
 FIXTURE_DIR = os.path.join(_REPO, "tests", "data", "regression")
+# serving_decode_overhead gate: the engine-vs-generate wall ratio cancels
+# host speed but CPU scheduler noise on a ~60-token run is real — the
+# tolerance mirrors the cpu_mesh_engine_overhead gate's
+SERVE_TOL_REL = 0.75
+SERVE_ABS_SLACK = 1.0
 
 
 def _mesh_for(strategy, R):
@@ -161,6 +175,45 @@ def check_record(path, baseline_dir):
     return name, findings, r006, problems
 
 
+def check_serving(path, baseline_dir, update=False):
+    """Re-measure the serving decode overhead live and gate it against
+    the blessed baseline.  Returns (name, overhead, problems)."""
+    import json
+
+    from autodist_tpu.serving.benchmark import measure_serve_decode
+    from autodist_tpu.telemetry.baseline import baseline_path
+
+    name = os.path.basename(path)[:-len(".json")]
+    cur = measure_serve_decode()
+    ov = cur["serving_decode_overhead"]
+    bpath = baseline_path(name, baseline_dir=baseline_dir)
+    if update:
+        with open(bpath, "w") as f:
+            json.dump(cur, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return name, ov, []
+    problems = []
+    try:
+        with open(bpath) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        problems.append(
+            f"no blessed baseline records/baselines/{name}.json — run "
+            f"'python tools/perf_gate.py --update-baseline' and commit")
+        return name, ov, problems
+    base_ov = baseline.get("serving_decode_overhead")
+    if not isinstance(base_ov, (int, float)):
+        problems.append(f"baseline {bpath} has no serving_decode_overhead")
+        return name, ov, problems
+    limit = base_ov * (1.0 + SERVE_TOL_REL) + SERVE_ABS_SLACK
+    if ov > limit:
+        problems.append(
+            f"serving decode overhead regression: engine-vs-generate "
+            f"ratio {ov:.2f}x vs blessed {base_ov:.2f}x (limit "
+            f"{limit:.2f}x = +{SERVE_TOL_REL:.0%} + {SERVE_ABS_SLACK})")
+    return name, ov, problems
+
+
 def bless(r006, baseline_dir):
     """Write the measured level as the new blessed baseline."""
     from autodist_tpu.telemetry.baseline import save_baseline
@@ -238,6 +291,31 @@ def main(argv=None):
     failed = False
     print(f"{'strategy':40} {'overhead':>9} {'ceiling':>8} {'verdict'}")
     for path in records:
+        try:
+            with open(path) as f:
+                head = json.load(f)
+        except (OSError, ValueError):
+            head = {}
+        if not {"model_def", "strategy"} <= set(head):
+            # not a RuntimeRecord: the serving decode record gets its own
+            # leg; anything else (sweep summaries) is skipped
+            if head.get("metric") == "serving_decode_overhead":
+                name, ov, problems = check_serving(
+                    path, args.baselines, update=args.update_baseline)
+                if args.update_baseline:
+                    print(f"{name:40} {ov:>9} {'-':>8} blessed -> "
+                          f"records/baselines/{name}.json")
+                elif problems:
+                    failed = True
+                    print(f"{name:40} {ov:>9} {'-':>8} FAIL")
+                    for p in problems:
+                        print(f"  - {p}")
+                else:
+                    print(f"{name:40} {ov:>9} {'-':>8} clean")
+            else:
+                print(f"{os.path.basename(path)[:-len('.json')]:40} "
+                      f"SKIP: not a RuntimeRecord")
+            continue
         name, findings, r006, problems = check_record(path, args.baselines)
         cur = (r006 or {}).get("current", {})
         ov = cur.get("cpu_mesh_engine_overhead")

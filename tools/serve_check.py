@@ -1,0 +1,172 @@
+"""CI gate: a live CPU-mesh continuous-batching serving run must match
+``generate()`` bit for bit and leave a schema-v4 manifest a clean Q-code
+audit accepts (``make serve-check``, wired into ``make check``).
+
+Asserts the serving tier's acceptance contract end-to-end:
+
+1. ``AutoDist.serve()`` runs GPT_TINY decode with >= 3 staggered
+   admissions (two up front, more admitted into freed/live slots
+   mid-run) over a slot-sharded CPU mesh, and EVERY request's tokens
+   bit-match the static ``generate()`` rollout at temperature 0;
+2. a second, disaggregated run (prefill device subset) bit-matches too,
+   with KV handoff bytes actually counted;
+3. the finalized manifest validates as schema v4 and its summary's
+   ``serving`` block carries tokens/sec, TTFT, and slot-occupancy;
+4. the serving audit over that manifest — with the decode step's
+   realized collectives extracted from the live engine's lowering — is
+   clean: Q004 only;
+5. ``clear_decode_caches()`` empties the rollout caches.
+"""
+import os
+import sys
+import tempfile
+
+# CPU mesh, no real accelerator needed — must precede any jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4").strip()
+os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# (prompt, max_new_tokens) per request; 5 staggered admissions total
+REQUESTS = [((5, 7, 9), 8), ((11, 3, 2, 8, 1), 6), ((42,), 10),
+            ((9, 9, 9, 9), 5), ((1, 2, 3), 7)]
+MAX_TOTAL = 24
+
+
+def _bit_match(model, cfg, params, finished, problems, tag):
+    import numpy as np
+
+    from autodist_tpu.models.decoding import generate
+
+    for req in finished:
+        ref = np.asarray(generate(model, cfg.max_position, params,
+                                  np.asarray([req.prompt], np.int32),
+                                  req.max_new_tokens))[0]
+        got = np.asarray(req.tokens)
+        if not np.array_equal(ref, got):
+            problems.append(
+                f"{tag}: request {req.rid} tokens diverge from generate(): "
+                f"{got.tolist()} vs {ref.tolist()}")
+
+
+def main():
+    import numpy as np
+    import jax
+
+    from autodist_tpu.analysis.hlo_audit import extract_collectives
+    from autodist_tpu.analysis.serving_audit import serving_audit
+    from autodist_tpu.autodist import AutoDist
+    from autodist_tpu.models.decoding import (_make_rollout,
+                                              clear_decode_caches)
+    from autodist_tpu.models.gpt import GPT, GPT_TINY
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.telemetry.schema import (SCHEMA_VERSION,
+                                               validate_manifest)
+
+    problems = []
+    cfg = GPT_TINY
+    model = GPT(cfg, decode=True)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 1), np.int32))["params"]
+    ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(4))
+
+    # -- 1. staggered admissions over the slot-sharded mesh ----------------
+    run_dir = tempfile.mkdtemp(prefix="serve_check_")
+    eng = ad.serve(model, params, max_total=MAX_TOTAL, num_slots=4,
+                   run_dir=run_dir)
+    if eng.mesh is None:
+        problems.append("engine did not shard the slot axis over the mesh")
+    for prompt, n in REQUESTS[:2]:
+        eng.submit(prompt, n)
+    eng.run(max_steps=4)        # mid-flight...
+    for prompt, n in REQUESTS[2:]:
+        eng.submit(prompt, n)   # ...admitted into freed/live slots
+    eng.run()
+    finished = eng.finished()
+    if len(finished) != len(REQUESTS):
+        problems.append(f"{len(finished)}/{len(REQUESTS)} requests finished")
+    _bit_match(model, cfg, params, finished, problems, "mesh")
+
+    # realized decode-step collectives from the LIVE engine's lowering
+    # (the X006-style accounting Q001 prices)
+    import jax.numpy as jnp
+    lowered = eng._batch_step.lower(
+        eng.params, eng._caches, eng._bufs, jnp.asarray(eng._ts),
+        jnp.asarray(eng._pls), jnp.asarray(eng._active), eng._rngs)
+    collectives = extract_collectives(lowered.as_text())
+
+    manifest = eng.finalize()
+    if not manifest:
+        problems.append("finalize() produced no manifest")
+        manifest = eng.telemetry.path
+
+    # -- 2. disaggregated prefill bit-matches too --------------------------
+    eng2 = ad.serve(model, params, max_total=MAX_TOTAL, num_slots=2,
+                    telemetry=False, prefill_fraction=0.25)
+    if not eng2.prefill_devices:
+        problems.append("prefill_fraction carved off no prefill devices")
+    for prompt, n in REQUESTS[:3]:
+        eng2.submit(prompt, n)
+    eng2.run()
+    _bit_match(model, cfg, params, eng2.finished(), problems, "disagg")
+    if eng2.finished() and not eng2.kv_handoff_bytes:
+        problems.append("disaggregated prefill counted no KV handoff bytes")
+
+    # -- 3. the manifest is schema v4 with the serving metrics -------------
+    records, errors = validate_manifest(manifest)
+    for e in errors:
+        problems.append(f"manifest: {e}")
+    meta = next((r for r in records if r.get("kind") == "meta"), {})
+    if meta.get("schema") != SCHEMA_VERSION or SCHEMA_VERSION != 4:
+        problems.append(f"manifest schema {meta.get('schema')} != 4")
+    kinds = {r.get("kind") for r in records}
+    for k in ("serving_step", "serving_request", "summary"):
+        if k not in kinds:
+            problems.append(f"manifest has no '{k}' record")
+    summary = next((r for r in records if r.get("kind") == "summary"), {})
+    serving = summary.get("serving") or {}
+    for field in ("tokens_per_s", "ttft_p50_s", "occupancy_mean"):
+        if not isinstance(serving.get(field), (int, float)):
+            problems.append(f"summary.serving has no numeric '{field}'")
+
+    # -- 4. the Q-code audit over the live run is clean --------------------
+    metrics = dict(serving,
+                   step_wall_p50_s=summary.get("step_time_p50_s"))
+    # the CPU gate's first step carries XLA compile, which lands in the
+    # tail TTFT — budget for it (production budgets are per-deployment)
+    findings = serving_audit(metrics, collectives, ttft_budget_s=120.0)
+    codes = sorted(f.code for f in findings)
+    if codes != ["Q004"]:
+        problems.append(f"serving audit not clean: {codes} "
+                        + "; ".join(f"{f.code}: {f.message}"
+                                    for f in findings if f.code != "Q004"))
+    q004 = next((f for f in findings if f.code == "Q004"), None)
+
+    # -- 5. cache clearing actually empties the rollout caches -------------
+    if not _make_rollout.cache_info().currsize:
+        problems.append("expected live rollout cache entries before clear")
+    clear_decode_caches()
+    if _make_rollout.cache_info().currsize:
+        problems.append("clear_decode_caches() left rollout cache entries")
+
+    if problems:
+        print(f"FAIL: {manifest}")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"OK: {len(finished)} staggered + {len(eng2.finished())} "
+          f"disaggregated requests bit-match generate(); schema-v{SCHEMA_VERSION} "
+          f"manifest with {serving['tokens_per_s']:.1f} tok/s, TTFT p50 "
+          f"{serving['ttft_p50_s'] * 1e3:.1f} ms, occupancy "
+          f"{serving['occupancy_mean']:.0%}; audit clean "
+          f"({q004.message if q004 else 'Q004'}) — {manifest}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

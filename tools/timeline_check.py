@@ -19,6 +19,7 @@ The golden-fixture behaviors (T001/T002 firing, overlap reconciliation)
 are gated separately by ``tools/verify_strategy.py --runtime --selftest``.
 """
 import glob
+import json
 import os
 import sys
 import tempfile
@@ -109,6 +110,17 @@ def main():
     records = sorted(glob.glob(os.path.join(_REPO, "records", "cpu_mesh",
                                             "*.json")))
     records = [p for p in records if not p.endswith("_summary.json")]
+
+    def _is_record(p):
+        # sweep dirs also hold non-RuntimeRecord artifacts (the serving
+        # decode record perf_gate owns) — the timeline tier skips them
+        try:
+            with open(p) as f:
+                return {"model_def", "strategy"} <= set(json.load(f))
+        except (OSError, ValueError):
+            return False
+
+    records = [p for p in records if _is_record(p)]
     if not records:
         print("FAIL: no records under records/cpu_mesh")
         return 1

@@ -49,6 +49,14 @@ Targets:
   event/causality table; with ``--selftest``, the golden fixtures under
   ``tests/data/events`` must fire E001 on the unacted log and E002 on
   the slow-MTTR log while the control stays clean.
+- ``--serving [METRICS_JSON]`` — run the SERVING tier (Q-codes) over a
+  decode service's telemetry (a finalized schema-v4 manifest whose
+  summary carries the ``serving`` block, or a bare serving-metrics
+  JSON): exposed decode comm over the interconnect budget is Q001,
+  slot-occupancy collapse Q002, TTFT p99 over budget Q003 — and every
+  audited run must emit its Q004 serving table; with ``--selftest``,
+  the seeded over-budget decode case must fire Q001 while the clean
+  case emits Q004 only.
 - ``--runtime [TRACE_DIR]`` — run the RUNTIME audit tier (T-codes): a
   ``jax.profiler`` chrome-trace capture is parsed, its collective
   events matched against the strategy's intended channel table, and
@@ -176,6 +184,14 @@ def main(argv=None):
                          "reactions past the MTTR budget E002; every "
                          "audited log must emit its E005 causality "
                          "table")
+    ap.add_argument("--serving", nargs="?", const="", default=None,
+                    metavar="METRICS_JSON",
+                    help="also run the SERVING tier (Q-codes) over a "
+                         "decode service's telemetry (a schema-v4 "
+                         "manifest or a serving-metrics JSON): exposed "
+                         "decode comm is Q001, occupancy collapse Q002, "
+                         "TTFT p99 Q003; every audited run must emit "
+                         "its Q004 serving table")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write all reports as JSON to this path")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -185,8 +201,8 @@ def main(argv=None):
     _force_cpu_devices()
     from autodist_tpu.analysis import (EVENT_PASSES, LOWERED_PASSES,
                                        REGRESSION_PASSES, RUNTIME_PASSES,
-                                       STATIC_PASSES, TRACE_PASSES,
-                                       verify_strategy)
+                                       SERVING_PASSES, STATIC_PASSES,
+                                       TRACE_PASSES, verify_strategy)
     from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
                                              EXPECTED_DONATION_CODE,
                                              EXPECTED_ERROR_CODES,
@@ -230,6 +246,10 @@ def main(argv=None):
         base = passes if passes is not None else \
             STATIC_PASSES + TRACE_PASSES
         passes = base + EVENT_PASSES
+    if args.serving is not None:
+        base = passes if passes is not None else \
+            STATIC_PASSES + TRACE_PASSES
+        passes = base + SERVING_PASSES
     trace_dir = args.runtime or None
     event_records = None
     if args.events:
@@ -248,6 +268,18 @@ def main(argv=None):
     # with the reaction tier selected, every audited event log must
     # produce its machine-readable E005 event/causality table
     want_e005 = bool(passes) and "reaction-audit" in passes
+    # with the serving tier selected, every audited target must produce
+    # its machine-readable Q004 serving table
+    want_q004 = bool(passes) and "serving-audit" in passes
+    serving_metrics = None
+    if args.serving:
+        from autodist_tpu.analysis.serving_audit import load_metrics
+
+        serving_metrics = load_metrics(args.serving)
+        if serving_metrics is None:
+            ap.error(f"--serving {args.serving}: no serving metrics "
+                     f"found (expected a schema-v4 manifest with a "
+                     f"summary 'serving' block, or a metrics JSON)")
     results = {}
     failed = False
 
@@ -267,6 +299,23 @@ def main(argv=None):
         if not any(f.code == "E005" for f in findings):
             print(f"[ERROR] {os.path.basename(args.events)}: reaction "
                   f"audit produced no E005 table")
+            failed = True
+
+    if args.serving:
+        # a standalone serving target: audit the decode service's
+        # telemetry itself, with or without record targets alongside
+        from autodist_tpu.analysis.report import Report
+        from autodist_tpu.analysis.serving_audit import serving_audit
+
+        findings = serving_audit(serving_metrics)
+        report = Report(strategy_id="serving")
+        report.extend(findings)
+        results[args.serving] = report
+        _print_report(os.path.basename(args.serving), report, args.verbose)
+        failed = failed or not report.ok
+        if not any(f.code == "Q004" for f in findings):
+            print(f"[ERROR] {os.path.basename(args.serving)}: serving "
+                  f"audit produced no Q004 table")
             failed = True
 
     for path in args.targets:
@@ -295,10 +344,18 @@ def main(argv=None):
                 stem = stem[:-len(".json")]
             case["current_metrics"] = {"name": stem}
         report = verify_strategy(passes=passes, trace_dir=trace_dir,
-                                 event_records=event_records, **case)
+                                 event_records=event_records,
+                                 serving_metrics=serving_metrics, **case)
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
         failed = failed or not report.ok
+        if want_q004:
+            q4 = next((f for f in report.findings if f.code == "Q004"),
+                      None)
+            if q4 is None and serving_metrics is not None:
+                print(f"[ERROR] {os.path.basename(path)}: serving "
+                      f"audit produced no Q004 table")
+                failed = True
         if want_e005:
             e5 = next((f for f in report.findings if f.code == "E005"),
                       None)
@@ -495,6 +552,46 @@ def main(argv=None):
                     else:
                         print("reaction selftest passed: the control "
                               "stays clean with its E005 table")
+        if args.serving is not None:
+            # the seeded serving fixtures: the over-budget decode step
+            # (one in-loop 64 MiB all-gather against an 8 us wall) must
+            # fire Q001, and the clean run must emit Q004 only
+            from autodist_tpu.analysis.report import Report
+            from autodist_tpu.analysis.serving_audit import \
+                audit_fixture as serving_fixture
+
+            checks = (
+                ("overbudget", "Q001"),
+                ("control", None),
+            )
+            for label, want in checks:
+                findings = serving_fixture(
+                    "overbudget" if want else "clean")
+                report = Report()
+                report.extend(findings)
+                results[f"<serving-{label}-selftest>"] = report
+                _print_report(f"serving selftest ({label})", report,
+                              args.verbose)
+                codes = {f.code for f in findings}
+                if want is not None:
+                    if want not in codes:
+                        print(f"[ERROR] serving selftest ({label}): "
+                              f"expected {want} did not fire "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print(f"serving selftest passed: the {label} "
+                              f"fixture fires {want}")
+                else:
+                    bad = codes & {"Q001", "Q002", "Q003"}
+                    if bad or "Q004" not in codes:
+                        print(f"[ERROR] serving selftest (control): "
+                              f"expected a clean Q004 only "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print("serving selftest passed: the control "
+                              "emits Q004 only")
         if args.runtime is not None:
             # the golden trace fixtures (tests/data/trace): the
             # exposed-comm step must be caught as T001, the skewed
