@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check postmortem-check check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -130,20 +130,31 @@ perf-gate:
 # serving gate (docs/serving.md): a live CPU-mesh continuous-batching
 # run (staggered admissions over the slot-sharded mesh, plus a
 # disaggregated prefill/decode split) must bit-match generate(), leave
-# a schema-v4 manifest whose serving block passes the Q-code audit with
+# a schema-v5 manifest whose serving block passes the Q-code audit with
 # Q004 only, and the seeded over-budget decode case must fire Q001
 # while the clean fixture stays Q004-only (--serving --selftest)
 serve-check:
 	$(PY) tools/serve_check.py
 	$(PY) tools/verify_strategy.py --serving --selftest
 
+# postmortem gate (docs/observability.md "Postmortem tier"): a live
+# CPU-mesh chaos run (nan@2) must leave a flight-recorder bundle whose
+# P-code audit fires P001 naming the injected worker+step, the operator
+# views (tools/postmortem.py, monitor --postmortem) must reconstruct
+# it, and the golden bundle fixtures must fire P001 (NaN cascade) and
+# P002 (stall death) with a clean control (--postmortem --selftest)
+postmortem-check:
+	$(PY) tools/postmortem_check.py
+	$(PY) tools/verify_strategy.py --postmortem --selftest
+
 # the pre-merge gate: lint + strategy verification + HLO audit + live
 # telemetry + runtime timeline + live control plane + chaos drills + the
-# cross-run perf gate + the serving gate (tests/test_analysis.py +
-# test_telemetry.py + test_timeline.py + test_elastic.py +
-# test_regression_audit.py + test_stream.py + test_reaction_audit.py +
-# test_serving.py run the same chains, so tier-1 exercises it)
-check: lint verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check
+# cross-run perf gate + the serving gate + the postmortem gate
+# (tests/test_analysis.py + test_telemetry.py + test_timeline.py +
+# test_elastic.py + test_regression_audit.py + test_stream.py +
+# test_reaction_audit.py + test_serving.py + test_flight_recorder.py +
+# test_postmortem_audit.py run the same chains, so tier-1 exercises it)
+check: lint verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check postmortem-check
 
 clean:
 	$(MAKE) -C native clean

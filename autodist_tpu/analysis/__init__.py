@@ -24,9 +24,12 @@ pluggable passes producing a severity-ranked :class:`Report`:
 - ``regression-audit`` — CROSS-RUN tier: this analysis (F006 ceiling,
   X006 bytes, manifest walls/health) diffed against the blessed
   baseline in ``records/baselines`` — R-codes
-- ``serving-audit`` — SERVING tier: the decode service's schema-v4
+- ``serving-audit`` — SERVING tier: the decode service's schema-v5
   serving telemetry (tokens/sec, TTFT, occupancy) + the decode step's
   realized collectives vs the interconnect budget — Q-codes
+- ``postmortem-audit`` — POSTMORTEM tier: the assembled black-box
+  bundle a failure trigger dumped (nonfinite cascade origin, stall
+  culprit channel, bundle completeness, unanswered signals) — P-codes
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on
@@ -36,8 +39,9 @@ See ``docs/analysis.md``.
 from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
                                           StrategyVerificationError)
 from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,  # noqa: F401
-                                          PASS_REGISTRY, REGRESSION_PASSES,
-                                          RUNTIME_PASSES, SERVING_PASSES,
-                                          STATIC_PASSES, TRACE_PASSES)
+                                          PASS_REGISTRY, POSTMORTEM_PASSES,
+                                          REGRESSION_PASSES, RUNTIME_PASSES,
+                                          SERVING_PASSES, STATIC_PASSES,
+                                          TRACE_PASSES)
 from autodist_tpu.analysis.verify import (AnalysisContext, verify_strategy,  # noqa: F401
                                           verify_transformer)

@@ -118,6 +118,17 @@ Finding codes (stable; tests and tools match on them):
   E004 WARNING heartbeat gap without a membership event
   E005 INFO    machine-readable event/causality table (carried in
                Finding.data)
+  P000 INFO    postmortem audit skipped (no bundle attached)
+  P001 ERROR   nonfinite cascade: first poisoned worker + step + tensor
+               in corrected cluster time
+  P002 ERROR   stall death: stall window + likely culprit collective
+               channel (timeline tail joined against the X006 intended
+               table)
+  P003 WARNING postmortem bundle incomplete (torn files, missing
+               workers, overflowed rings)
+  P004 WARNING reaction mismatch: the black box shows a signal the
+               control plane never acted on before death
+  P005 INFO    machine-readable bundle table (carried in Finding.data)
   TR001 ERROR  tracing the strategy's train step failed
   TR002 INFO   trace skipped (trace passes did not run)
 
@@ -141,10 +152,14 @@ control actions, cause, signal->action latency) against the reaction
 contract, so an ignored alarm or a slow MTTR ranks in the same Report.
 The Q-codes form the SERVING tier
 (:mod:`autodist_tpu.analysis.serving_audit`): they judge the decode
-service's schema-v4 serving telemetry (tokens/sec, TTFT, occupancy) and
+service's schema-v5 serving telemetry (tokens/sec, TTFT, occupancy) and
 the decode step's realized collectives against the interconnect budget
 (Q001 exposed decode comm, Q002 occupancy collapse, Q003 TTFT p99,
-Q004 the machine-readable serving table).
+Q004 the machine-readable serving table).  The P-codes form the
+POSTMORTEM tier (:mod:`autodist_tpu.analysis.postmortem_audit`): they
+judge the assembled black-box bundle a failure trigger dumped
+(:mod:`autodist_tpu.telemetry.flight_recorder`) — the root-cause pass
+for runs that did not survive to be judged by any other tier.
 """
 import numpy as np
 
@@ -880,11 +895,22 @@ def reaction_audit_pass(ctx):
 
 
 def serving_audit_pass(ctx):
-    """Serving tier pass: judge the decode service's schema-v4 serving
+    """Serving tier pass: judge the decode service's schema-v5 serving
     telemetry + realized decode collectives against the serving budgets
     (:mod:`autodist_tpu.analysis.serving_audit`)."""
     from autodist_tpu.analysis.serving_audit import \
         serving_audit_pass as _run
+
+    return _run(ctx)
+
+
+def postmortem_audit_pass(ctx):
+    """Postmortem tier pass: root-cause the assembled black-box bundle a
+    failure trigger dumped — nonfinite cascade origin, stall culprit,
+    bundle completeness, unanswered signals
+    (:mod:`autodist_tpu.analysis.postmortem_audit`)."""
+    from autodist_tpu.analysis.postmortem_audit import \
+        postmortem_audit_pass as _run
 
     return _run(ctx)
 
@@ -902,6 +928,7 @@ PASS_REGISTRY = {
     "regression-audit": regression_audit_pass,
     "reaction-audit": reaction_audit_pass,
     "serving-audit": serving_audit_pass,
+    "postmortem-audit": postmortem_audit_pass,
 }
 
 STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
@@ -930,3 +957,8 @@ EVENT_PASSES = ("reaction-audit",)
 # opt-in via verify_strategy(passes=..., serving_metrics=...), the CLI's
 # --serving, and tools/serve_check.py
 SERVING_PASSES = ("serving-audit",)
+# the POSTMORTEM tier: root-cause the assembled black-box bundle of a
+# dead run; opt-in via verify_strategy(passes=..., postmortem_bundle=...),
+# the CLI's --postmortem, ElasticTrainer's dump-triggered audit, and
+# tools/postmortem_check.py
+POSTMORTEM_PASSES = ("postmortem-audit",)

@@ -1,0 +1,331 @@
+"""Postmortem audit: the ROOT-CAUSE tier (P-codes) of the verification
+stack.
+
+The reaction tier (E-codes) judges a LIVE control plane from its event
+log; this pass judges a DEAD run from its black box.  Input is an
+assembled postmortem bundle
+(:func:`autodist_tpu.telemetry.flight_recorder.assemble_bundle` /
+``load_bundle``): per-worker ring snapshots merged into one
+clock-offset-corrected cluster timeline, dumped at the moment a failure
+trigger fired.
+
+  P000 INFO    postmortem audit skipped (no bundle attached)
+  P001 ERROR   nonfinite cascade: names the FIRST poisoned worker, step
+               and tensor in corrected cluster time — everything after
+               is downstream of that update
+  P002 ERROR   stall death: names the stall window (last completed step
+               -> dump) and the likely culprit collective channel by
+               joining the timeline tail against the X006 intended
+               table (a step stalls inside its largest pending sync)
+  P003 WARNING bundle incomplete — torn worker files, missing expected
+               workers, or overflowed rings (dropped records): the
+               verdicts above rest on partial evidence
+  P004 WARNING reaction mismatch — the bundle shows a persistent or
+               repeated signal the control plane never acted on before
+               death (the E001 contract, re-checked against the black
+               box rather than the surviving event log)
+  P005 INFO    machine-readable bundle table (``Finding.data``;
+               consumed by ``tools/postmortem.py``, ``tools/monitor.py
+               --postmortem`` and ``tools/verify_strategy.py
+               --postmortem``)
+
+The tier is registered as ``POSTMORTEM_PASSES`` alongside the
+C/S/D/H/Y, X, F, T, R, E and Q tiers;
+:class:`~autodist_tpu.elastic.ElasticTrainer` attaches the P-report of
+the dump that triggered a re-plan to the replan event, so E-causality
+and P-root-cause cross-link in the merged manifest.
+"""
+from typing import List, Optional
+
+from autodist_tpu.analysis.report import Finding, Severity
+
+# triggers that indicate a stall/hang death (P002's precondition); a
+# nonfinite cascade (P001) is recognized from the findings themselves,
+# whatever trigger flushed the box
+STALL_TRIGGERS = ("straggler", "worker_exit", "watchdog")
+# a stall shorter than this is a slow step, not a death window
+STALL_MIN_S = 0.5
+# P004 mirrors the reaction tier's threshold: a single transient blip is
+# not an ignored alarm unless it was flagged persistent
+UNACTED_MIN_REPEATS = 2
+
+
+def _f(sev, code, msg, subject="", data=None):
+    return Finding(Severity(sev), code, "postmortem-audit", msg, subject,
+                   data=data)
+
+
+def _num(x):
+    return x if isinstance(x, (int, float)) else None
+
+
+def _timeline(bundle):
+    return [e for e in (bundle.get("timeline") or []) if isinstance(e, dict)]
+
+
+def _finding_tensor(rec):
+    """Name the poisoned tensor from a health finding: an explicit
+    ``metric`` key wins; otherwise the detector's message names it
+    ("non-finite loss (...)" / "non-finite grad norm (...)")."""
+    metric = rec.get("metric")
+    if metric:
+        return str(metric)
+    msg = str(rec.get("message", ""))
+    if "grad norm" in msg:
+        return "grad_norm"
+    if "loss" in msg:
+        return "loss"
+    return "?"
+
+
+def postmortem_audit(bundle, intended=None) -> List[Finding]:
+    """Judge one assembled postmortem bundle.
+
+    ``intended`` is the X006 summary (or its ``channels`` list) for the
+    P002 culprit join; a bundle may carry its own under ``intended``
+    (golden fixtures do), and the registered pass falls back to
+    ``ctx.audit_summary``."""
+    findings: List[Finding] = []
+    if not isinstance(bundle, dict):
+        return [_f(Severity.INFO, "P000",
+                   "postmortem audit skipped: no bundle attached — a "
+                   "clean run dumps nothing")]
+    trigger = bundle.get("trigger")
+    timeline = _timeline(bundle)
+    workers = bundle.get("workers") or {}
+
+    # -- P001: first poisoned worker/step/tensor of a nonfinite cascade ----
+    nonfinite = [e for e in timeline
+                 if e.get("species") == "finding"
+                 and e.get("check") == "nonfinite"]
+    # corrected time orders the cascade; step index breaks ties (two
+    # workers poisoned by the same all-reduce share one wall instant)
+    nonfinite.sort(key=lambda e: (e.get("t") or 0.0,
+                                  e.get("step") if e.get("step")
+                                  is not None else 1 << 30))
+    first_poison = None
+    if nonfinite:
+        first = nonfinite[0]
+        first_poison = {
+            "worker": first.get("w"),
+            "step": first.get("step"),
+            "tensor": _finding_tensor(first),
+            "cascade_findings": len(nonfinite),
+            "cascade_workers": sorted({e.get("w") for e in nonfinite
+                                       if e.get("w") is not None}),
+        }
+        breadth = len(first_poison["cascade_workers"])
+        findings.append(_f(
+            Severity.ERROR, "P001",
+            f"nonfinite cascade: worker {first_poison['worker']} poisoned "
+            f"first — non-finite {first_poison['tensor']} at step "
+            f"{first_poison['step']} (corrected cluster time), then "
+            f"{len(nonfinite) - 1} downstream finding(s) across "
+            f"{breadth} worker(s); every later step inherits that update",
+            f"worker {first_poison['worker']}", data=dict(first_poison)))
+
+    # -- P002: stall window + likely culprit collective channel ------------
+    stall = None
+    if trigger in STALL_TRIGGERS:
+        last_step_t = {}
+        last_step_idx = {}
+        for e in timeline:
+            if e.get("species") != "step":
+                continue
+            w, t, idx = e.get("w"), _num(e.get("t")), e.get("step")
+            if w is None or t is None:
+                continue
+            last_step_t[w] = max(last_step_t.get(w, t), t)
+            if idx is not None:
+                last_step_idx[w] = max(last_step_idx.get(w, int(idx)),
+                                       int(idx))
+        dump_t = _num(bundle.get("t"))
+        if last_step_t and dump_t is not None:
+            # the stalled worker is the one whose progress stopped first:
+            # lowest last step index when they diverge, oldest last step
+            # time otherwise
+            if last_step_idx and len(set(last_step_idx.values())) > 1:
+                stalled_w = min(last_step_idx, key=lambda w:
+                                (last_step_idx[w], last_step_t.get(w, 0.0)))
+            else:
+                stalled_w = min(last_step_t, key=last_step_t.get)
+            stall_s = dump_t - last_step_t[stalled_w]
+            if stall_s >= STALL_MIN_S:
+                culprit = None
+                channels = intended or bundle.get("intended")
+                if isinstance(channels, dict):
+                    channels = channels.get("channels")
+                for c in channels or ():
+                    if not isinstance(c, dict):
+                        continue
+                    b = _num(c.get("intended_bytes")) or 0.0
+                    if culprit is None or b > culprit[1]:
+                        culprit = (c.get("label"), b, c.get("phase"))
+                stall = {
+                    "worker": stalled_w,
+                    "last_step": last_step_idx.get(stalled_w),
+                    "stall_s": stall_s,
+                    "window_s": [last_step_t[stalled_w], dump_t],
+                    "culprit_channel": culprit[0] if culprit else None,
+                    "culprit_bytes": culprit[1] if culprit else None,
+                }
+                where = (f" — likely blocked in '{culprit[0]}' "
+                         f"({culprit[2]}, the largest pending sync "
+                         f"channel of the intended plan)"
+                         if culprit and culprit[0] else
+                         " — no intended-channel table attached to name "
+                         "the blocking collective")
+                findings.append(_f(
+                    Severity.ERROR, "P002",
+                    f"stall death ('{trigger}'): worker {stalled_w} made "
+                    f"no step for {stall_s:.2f} s after step "
+                    f"{stall.get('last_step')} before the dump"
+                    + where, f"worker {stalled_w}", data=dict(stall)))
+
+    # -- P003: incomplete bundle -------------------------------------------
+    torn = int(bundle.get("torn_files") or 0)
+    missing = list(bundle.get("missing_workers") or ())
+    dropped = {}
+    for w, rec in workers.items():
+        d = rec.get("dropped") or {}
+        total = sum(v for v in d.values() if isinstance(v, (int, float)))
+        if total:
+            dropped[str(w)] = dict(d)
+    if torn or missing or dropped:
+        parts = []
+        if torn:
+            parts.append(f"{torn} torn worker file(s)")
+        if missing:
+            parts.append("missing worker(s) "
+                         + ", ".join(str(w) for w in missing))
+        if dropped:
+            parts.append("overflowed rings on worker(s) "
+                         + ", ".join(sorted(dropped)))
+        findings.append(_f(
+            Severity.WARNING, "P003",
+            "incomplete bundle: " + "; ".join(parts)
+            + " — the root-cause verdicts above rest on partial evidence",
+            "bundle", data={"torn_files": torn,
+                            "missing_workers": missing,
+                            "dropped": dropped}))
+
+    # -- P004: signal in the box the control plane never answered ----------
+    events = [e for e in timeline if e.get("species") == "event"]
+    sig_groups = {}
+    for e in events:
+        if e.get("event") != "signal":
+            continue
+        key = (e.get("signal") or "?",
+               e.get("worker") if e.get("worker") is not None else "?")
+        g = sig_groups.setdefault(key, {"count": 0, "persistent": False,
+                                        "steps": []})
+        g["count"] += 1
+        g["persistent"] = g["persistent"] or bool(e.get("persistent"))
+        if e.get("step") is not None:
+            g["steps"].append(e["step"])
+    unacted = []
+    for e in events:
+        cause = e.get("cause")
+        if e.get("event") == "signal" or not isinstance(cause, dict):
+            continue
+        csig = cause.get("signal") or "?"
+        cworker = cause.get("worker")
+        for (signal, worker), g in sig_groups.items():
+            if csig == signal and (cworker is None or worker == "?"
+                                   or cworker == worker):
+                g["acted"] = True
+    for (signal, worker), g in sorted(sig_groups.items(),
+                                      key=lambda kv: str(kv[0])):
+        if g.get("acted"):
+            continue
+        if not (g["persistent"] or g["count"] >= UNACTED_MIN_REPEATS):
+            continue
+        unacted.append({"signal": signal, "worker": worker,
+                        "count": g["count"], "steps": g["steps"][:8]})
+        why = "flagged persistent" if g["persistent"] \
+            else f"repeated {g['count']}x"
+        findings.append(_f(
+            Severity.WARNING, "P004",
+            f"reaction mismatch: the black box recorded a '{signal}' "
+            f"signal from {worker} ({why}) with no caused action before "
+            f"death — the control plane saw the fault coming and did "
+            f"nothing the bundle can show",
+            str(worker), data={"signal": signal, "worker": worker,
+                               "count": g["count"]}))
+
+    # -- P005: the machine-readable bundle table ---------------------------
+    species_counts = {}
+    for e in timeline:
+        s = e.get("species", "?")
+        species_counts[s] = species_counts.get(s, 0) + 1
+    data = {
+        "trigger": trigger,
+        "step": bundle.get("step"),
+        "path": bundle.get("path"),
+        "workers": sorted(workers, key=str),
+        "timeline": species_counts,
+        "clock_offsets_s": bundle.get("clock_offsets_s") or {},
+        "first_poison": first_poison,
+        "stall": stall,
+        "torn_files": torn,
+        "missing_workers": missing,
+        "unacted": unacted,
+        "flagged": sorted({f.code for f in findings
+                           if f.code in ("P001", "P002", "P003", "P004")}),
+    }
+    verdict = "flagged: " + ", ".join(data["flagged"]) if data["flagged"] \
+        else "clean"
+    findings.append(_f(
+        Severity.INFO, "P005",
+        f"postmortem bundle table: trigger '{trigger}' at step "
+        f"{bundle.get('step')}, {len(workers)} worker box(es), "
+        f"{len(timeline)} timeline record(s) — {verdict}",
+        "bundle", data=data))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points: the registered pass and the fixture/CLI path
+# ---------------------------------------------------------------------------
+
+
+def bundle_from_context(ctx) -> Optional[dict]:
+    """The bundle the context carries: an explicit
+    ``ctx.postmortem_bundle`` (an assembled dict, or a path handed to
+    :func:`~autodist_tpu.telemetry.flight_recorder.load_bundle` — a
+    bundle dir, an assembled JSON, or a run dir whose latest bundle is
+    taken)."""
+    explicit = getattr(ctx, "postmortem_bundle", None)
+    if isinstance(explicit, dict):
+        return explicit
+    if isinstance(explicit, str) and explicit:
+        from autodist_tpu.telemetry.flight_recorder import load_bundle
+
+        return load_bundle(explicit)
+    return None
+
+
+def postmortem_audit_pass(ctx) -> List[Finding]:
+    """PASS_REGISTRY entry (the root-cause tier): audit the attached
+    postmortem bundle; P000 when the run left no black-box dump."""
+    bundle = bundle_from_context(ctx)
+    if bundle is None:
+        return [_f(Severity.INFO, "P000",
+                   "postmortem audit skipped: no bundle attached — a "
+                   "clean run dumps nothing")]
+    intended = bundle.get("intended") or getattr(ctx, "audit_summary", None)
+    findings = postmortem_audit(bundle, intended=intended)
+    ctx.postmortem_summary = next(
+        (f.data for f in findings if f.code == "P005"), None)
+    return findings
+
+
+def audit_fixture(bundle_path):
+    """Run the audit over a golden assembled-bundle JSON; returns the
+    findings (``tools/verify_strategy.py --postmortem --selftest``
+    drives this — the NaN-cascade fixture must yield a P001 naming the
+    injected worker/step, the stall fixture a P002)."""
+    from autodist_tpu.telemetry.flight_recorder import load_bundle
+
+    bundle = load_bundle(bundle_path)
+    return postmortem_audit(bundle, intended=(bundle or {}).get("intended"))

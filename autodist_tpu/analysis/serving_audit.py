@@ -1,7 +1,7 @@
 """Serving audit: the SERVING tier (Q-codes) of the verification stack.
 
 The runtime tiers judge a *training* run; this pass judges the decode
-service.  Input is the schema-v4 serving telemetry (the summary's
+service.  Input is the schema-v5 serving telemetry (the summary's
 ``serving`` block or explicit metrics) plus, optionally, the decode
 step's realized collectives — the same X006-style accounting
 :func:`~autodist_tpu.analysis.hlo_audit.extract_collectives` pulls from
@@ -124,13 +124,29 @@ def serving_audit(metrics, collectives=None, *, comm_frac=SERVE_COMM_FRAC,
 
     # -- Q003: TTFT p99 over budget -----------------------------------------
     ttft99 = metrics.get("ttft_p99_s")
+    phases = metrics.get("ttft_phases") or {}
     if isinstance(ttft99, (int, float)) and ttft99 > ttft_budget_s:
+        # name the dominant phase of the schema-v5 span breakdown, so
+        # the breach points at queue/prefill/handoff/first-decode
+        # instead of one opaque number
+        dominant = None
+        for name, p in phases.items():
+            m = (p or {}).get("mean")
+            if isinstance(m, (int, float)) and \
+                    (dominant is None or m > dominant[1]):
+                dominant = (name, m)
+        where = (f" — dominant phase: {dominant[0]} "
+                 f"(mean {dominant[1] * 1e3:.1f} ms)"
+                 if dominant else
+                 " — no span breakdown recorded to attribute it")
         findings.append(_f(
             Severity.ERROR, "Q003",
             f"TTFT p99 {ttft99:.3f} s over the {ttft_budget_s:.3f} s "
-            f"budget — tail requests wait too long for their first token",
-            "ttft",
-            data={"ttft_p99_s": ttft99, "budget_s": ttft_budget_s}))
+            f"budget — tail requests wait too long for their first token"
+            + where, "ttft",
+            data={"ttft_p99_s": ttft99, "budget_s": ttft_budget_s,
+                  "phases": phases,
+                  "dominant_phase": dominant[0] if dominant else None}))
 
     # -- Q004: the machine-readable serving table ---------------------------
     flagged = sorted({f.code for f in findings
@@ -143,6 +159,7 @@ def serving_audit(metrics, collectives=None, *, comm_frac=SERVE_COMM_FRAC,
         "ttft_p99_s": metrics.get("ttft_p99_s"),
         "latency_p50_s": metrics.get("latency_p50_s"),
         "latency_p99_s": metrics.get("latency_p99_s"),
+        "ttft_phases": phases,
         "occupancy_mean": occ,
         "queue_depth_max": qmax,
         "slots": metrics.get("slots"),
@@ -185,7 +202,7 @@ def metrics_from_context(ctx):
 
 def serving_audit_pass(ctx) -> List[Finding]:
     """PASS_REGISTRY entry (the serving tier): audit the decode service
-    recorded by the schema-v4 serving telemetry."""
+    recorded by the schema-v5 serving telemetry."""
     metrics = metrics_from_context(ctx)
     if metrics is None:
         return [_f(Severity.INFO, "Q000",

@@ -20,9 +20,10 @@ import dataclasses
 from typing import Any, Dict, Optional
 
 from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,
-                                          PASS_REGISTRY, REGRESSION_PASSES,
-                                          RUNTIME_PASSES, SERVING_PASSES,
-                                          STATIC_PASSES, TRACE_PASSES)
+                                          PASS_REGISTRY, POSTMORTEM_PASSES,
+                                          REGRESSION_PASSES, RUNTIME_PASSES,
+                                          SERVING_PASSES, STATIC_PASSES,
+                                          TRACE_PASSES)
 from autodist_tpu.analysis.report import Report, Severity
 from autodist_tpu.utils import logging
 
@@ -89,6 +90,11 @@ class AnalysisContext:
     decode_collectives: Optional[list] = None
     serving_budgets: Optional[dict] = None
     serving_summary: Optional[dict] = None
+    # postmortem tier: the black-box bundle to root-cause (an assembled
+    # dict or a path — bundle dir / assembled JSON / run dir whose
+    # latest bundle is taken) and the audit's P005 table
+    postmortem_bundle: Any = None
+    postmortem_summary: Optional[dict] = None
 
 
 def _mesh_info(strategy, resource_spec, mesh):
@@ -195,7 +201,8 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
                        current_metrics=None, event_records=None,
                        mttr_budget_s=None, serving_metrics=None,
                        decode_collectives=None,
-                       serving_budgets=None) -> Report:
+                       serving_budgets=None,
+                       postmortem_bundle=None) -> Report:
     """Verify an already-built :class:`GraphTransformer` (the engine's
     in-session entry: the runner's ``verify=`` knob, ``aot_compile``, and
     the watchdog's post-capture analysis reuse the transformer they
@@ -212,7 +219,8 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
         event_records=event_records, mttr_budget_s=mttr_budget_s,
         serving_metrics=serving_metrics,
         decode_collectives=decode_collectives,
-        serving_budgets=serving_budgets)
+        serving_budgets=serving_budgets,
+        postmortem_bundle=postmortem_bundle)
     ctx.transformer = transformer
     report = Report(strategy_id=getattr(transformer.strategy, "id", ""))
     selected = tuple(passes) if passes is not None else \
@@ -241,6 +249,12 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
     for name in selected:
         if name in SERVING_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
+    # postmortem tier: root-causes the attached black-box bundle (it
+    # reads the X006 table the lowered tier left on the context for the
+    # P002 culprit join, so it runs after the lowered passes)
+    for name in selected:
+        if name in POSTMORTEM_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
     # cross-run tier last: it harvests whatever the earlier tiers left on
     # the context (F006 ceiling, X006 bytes, manifest walls/health)
     for name in selected:
@@ -256,7 +270,7 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
                     baseline=None, current_metrics=None,
                     event_records=None, mttr_budget_s=None,
                     serving_metrics=None, decode_collectives=None,
-                    serving_budgets=None,
+                    serving_budgets=None, postmortem_bundle=None,
                     **transformer_kwargs) -> Report:
     """Statically verify a strategy before any compile.
 
@@ -295,6 +309,10 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         ``serving`` block (defaults to the manifest's), the decode
         step's realized collectives for Q001, and budget overrides
         (``comm_frac`` / ``ici_gbps`` / ``occupancy_floor`` / ``ttft_s``).
+      postmortem_bundle: postmortem tier input when
+        ``"postmortem-audit"`` is selected — an assembled black-box
+        bundle dict or a path (bundle dir / assembled JSON / run dir
+        whose latest bundle is taken).
       transformer_kwargs: forwarded to :class:`GraphTransformer`
         (``data_axes``, ``batch_spec``, ``accum_steps``, ...).
 
@@ -314,7 +332,8 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         event_records=event_records, mttr_budget_s=mttr_budget_s,
         serving_metrics=serving_metrics,
         decode_collectives=decode_collectives,
-        serving_budgets=serving_budgets)
+        serving_budgets=serving_budgets,
+        postmortem_bundle=postmortem_bundle)
     report = Report(strategy_id=getattr(strategy, "id", ""))
 
     selected = tuple(passes) if passes is not None else \
@@ -372,6 +391,13 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
     # manifest summary's serving block) + decode collectives
     for name in selected:
         if name in SERVING_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
+
+    # postmortem tier: root-causes the attached black-box bundle; after
+    # the lowered tier so the X006 intended table (ctx.audit_summary) is
+    # available for the P002 culprit join
+    for name in selected:
+        if name in POSTMORTEM_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
 
     # cross-run (regression) tier last: it diffs whatever the earlier

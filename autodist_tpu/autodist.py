@@ -374,7 +374,7 @@ class AutoDist:
         ``prefill_fraction > 0`` carves that share of the devices off as
         a disaggregated prefill subset; the rest shard the slot axis
         (when ``num_slots`` divides them evenly).  ``telemetry=True``
-        attaches a schema-v4 :class:`~autodist_tpu.serving.telemetry.
+        attaches a schema-v5 :class:`~autodist_tpu.serving.telemetry.
         ServingTelemetry`; submit with ``engine.submit(prompt, n)``,
         drive with ``engine.run()``, close with ``engine.finalize()``.
         """

@@ -38,6 +38,16 @@ checkpoint, preemption guard, chaos injection) lands in the causal
 the measured signal->action latency; :meth:`reaction_report` runs the
 E-code reaction audit over that table.
 
+**Black box** (docs/observability.md "Postmortem tier").  Every failure
+signal the trainer consumes also flushes the per-worker flight recorder
+(:mod:`autodist_tpu.telemetry.flight_recorder`): anomaly, persistent
+straggler, worker exit, chaos injection and preemption each dump a
+``postmortem/<trigger>_<step>/`` bundle, the action lands in the event
+log as ``postmortem_dump``, and the P-code root-cause report of the
+triggering dump (:mod:`autodist_tpu.analysis.postmortem_audit`) is
+attached to the subsequent ``replan`` event — so E-causality and
+P-root-cause cross-link in the merged manifest.
+
 **Scope.**  Within one ``jax.distributed`` process group the device set
 is fixed for the life of the processes — a live SPMD step cannot lose a
 participant.  The protocol therefore spans a *restart*: the surviving
@@ -216,6 +226,49 @@ class ElasticTrainer:
         self._self_worker = 0         # this process's stream worker index
         self._collector_owned = False
         self.last_reaction_report = None
+        self.last_postmortem_report = None   # P-report of the latest dump
+        self.last_postmortem_bundle = None   # its bundle dir
+        self._postmortem_audited = set()     # bundle dirs already audited
+
+    # -- the black box ------------------------------------------------------
+
+    def _postmortem_dump(self, trigger, step=None, cause=None, reason=None):
+        """Flush the flight recorder on a failure signal (telemetry-on
+        only; a disabled process has no recorder and this is a no-op).
+        The dump is recorded as a ``postmortem_dump`` action pointing at
+        the provoking signal, then the bundle is assembled and P-audited
+        immediately — the root-cause report must exist even if the
+        process dies on the next step.  Best-effort throughout; returns
+        the bundle dir (or None)."""
+        from autodist_tpu import telemetry
+
+        box = telemetry.flight()
+        if box is None:
+            return None
+        bundle = box.dump(trigger, step=step, reason=reason)
+        if not bundle or bundle in self._postmortem_audited:
+            return bundle
+        self._postmortem_audited.add(bundle)
+        if self.event_log is not None:
+            self.event_log.record("postmortem_dump", step=step,
+                                  trigger=str(trigger), bundle=bundle,
+                                  cause=cause)
+        try:
+            from autodist_tpu.analysis.postmortem_audit import \
+                postmortem_audit
+            from autodist_tpu.analysis.report import Report
+            from autodist_tpu.telemetry.flight_recorder import \
+                assemble_bundle
+
+            assembled = assemble_bundle(bundle)
+            self.last_postmortem_report = Report(
+                strategy_id="elastic-postmortem",
+                findings=postmortem_audit(assembled))
+            self.last_postmortem_bundle = bundle
+        except Exception as e:  # pragma: no cover - audit never kills fit
+            logging.warning("ElasticTrainer: postmortem audit failed: %s",
+                            e)
+        return bundle
 
     # -- membership signals -------------------------------------------------
 
@@ -249,6 +302,10 @@ class ElasticTrainer:
             self._pending_causes.setdefault(("straggler", addr), cause)
         if streak < self.STRAGGLER_PERSISTENCE:
             return False
+        self._postmortem_dump("straggler", step=skew.get("step"),
+                              cause=cause,
+                              reason={"straggler_addr": addr,
+                                      "skew_s": skew.get("skew_s")})
         logging.warning(
             "ElasticTrainer: persistent straggler %s (skew %.3fs over %d "
             "signals)%s", addr, skew.get("skew_s", 0.0), streak,
@@ -294,6 +351,8 @@ class ElasticTrainer:
             self._pending_causes.setdefault(("anomaly", check), cause)
         if streak < need:
             return False
+        self._postmortem_dump("anomaly", step=finding.get("step"),
+                              cause=cause, reason={"check": check})
         logging.warning(
             "ElasticTrainer: health anomaly %s at step %s (%s)%s",
             check, finding.get("step"), finding.get("message"),
@@ -318,6 +377,8 @@ class ElasticTrainer:
             "worker_exit", worker=addr, code=str(code), persistent=True)
         self._pending_causes.setdefault(("worker_exit", addr), cause)
         self._lost.append(addr)
+        self._postmortem_dump("worker_exit", cause=cause,
+                              reason={"worker": addr, "code": code})
         return True
 
     def _default_kill_target(self):
@@ -350,6 +411,8 @@ class ElasticTrainer:
             self.event_log.record("chaos_injection", step=step,
                                   chaos_kind=ev.kind, arg=ev.arg,
                                   cause=cause)
+            self._postmortem_dump("chaos", step=step, cause=cause,
+                                  reason={"kind": ev.kind, "arg": ev.arg})
             if ev.kind == "kill_worker":
                 if ev.arg:
                     self._pending_causes.setdefault(
@@ -587,9 +650,21 @@ class ElasticTrainer:
         #    (Y-codes + X-audit) before the new epoch's first step
         probe = batch_fn(int(sess.step)) if batch_fn is not None else None
         self._restore(probe)
+        # cross-link E-causality with P-root-cause: the replan event
+        # carries the P-report of the dump its trigger flushed, so the
+        # merged manifest answers "what did the box show when we
+        # re-planned" in one record
+        postmortem = None
+        if self.last_postmortem_report is not None:
+            postmortem = {
+                "bundle": self.last_postmortem_bundle,
+                "flagged": sorted({
+                    f.code for f in self.last_postmortem_report.findings
+                    if f.code in ("P001", "P002", "P003", "P004")}),
+            }
         self.event_log.record("replan", step=int(sess.step),
                               epoch=self.epoch, replans=self.replans,
-                              cause=cause)
+                              cause=cause, postmortem=postmortem)
         logging.info(
             "Epoch %d resumed at step %d on R=%d after re-plan #%d",
             self.epoch, sess.step, sess._t.num_replicas, self.replans)
@@ -621,11 +696,13 @@ class ElasticTrainer:
                     from autodist_tpu.checkpoint.saver import Saver
 
                     Saver(sess).save_sharded(self._ckpt, epoch=self.epoch)
+                    preempt_cause = self._pending_causes.pop(
+                        ("preempt", None), None)
                     self.event_log.record(
                         "preemption_guard", step=int(sess.step),
-                        epoch=self.epoch,
-                        cause=self._pending_causes.pop(("preempt", None),
-                                                       None))
+                        epoch=self.epoch, cause=preempt_cause)
+                    self._postmortem_dump("preempt", step=int(sess.step),
+                                          cause=preempt_cause)
                     logging.warning(
                         "ElasticTrainer: preempted at step %d; manifest "
                         "checkpoint written, exiting cleanly", sess.step)

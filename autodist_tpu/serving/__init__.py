@@ -2,7 +2,7 @@
 
 Slot-based KV caches (:mod:`slots`), a jitted continuously-batched
 decode engine with admission between steps (:mod:`engine`), the request
-queue / batching policy (:mod:`admission`), and schema-v4 serving
+queue / batching policy (:mod:`admission`), and schema-v5 serving
 telemetry (:mod:`telemetry`).  Entry point: ``AutoDist.serve()``.
 """
 from autodist_tpu.serving.admission import (AdmissionQueue, BatchPolicy,

@@ -7,10 +7,16 @@ bounds concurrent occupancy below the table size (headroom for bursts),
 ``max_wait_s`` forces admission of aging requests even when batching
 more would be cheaper.
 
-Every request carries a lifecycle record (enqueue / admit / first token
-/ finish timestamps) that :mod:`autodist_tpu.serving.telemetry` turns
-into the schema-v4 ``serving_request`` manifest rows and the TTFT /
-latency percentiles the Q-code audit gates.
+Every request carries a lifecycle record (enqueue / admit / prefill /
+handoff / first token / finish timestamps) that
+:mod:`autodist_tpu.serving.telemetry` turns into the schema-v5
+``serving_request`` manifest rows and the TTFT / latency percentiles
+the Q-code audit gates.  TTFT decomposes into attributable spans —
+``queue_s`` (enqueue -> admit), ``prefill_s`` (the disaggregated
+prefill scan), ``handoff_s`` (KV block placement into the decode
+slot), ``first_decode_s`` (slot live -> first generated token; on the
+replay path this includes the in-slot prompt replay) — so a Q003 TTFT
+breach can name its dominant phase instead of one opaque number.
 """
 import collections
 import dataclasses
@@ -27,6 +33,9 @@ class Request:
     max_new_tokens: int
     enqueue_s: float = 0.0
     admit_s: Optional[float] = None
+    prefill_start_s: Optional[float] = None   # disaggregated prefill only
+    prefill_done_s: Optional[float] = None
+    handoff_done_s: Optional[float] = None    # KV block placed in the slot
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
     slot: Optional[int] = None
@@ -52,6 +61,32 @@ class Request:
             return None
         return self.finish_s - self.enqueue_s
 
+    @property
+    def prefill_s(self) -> Optional[float]:
+        if self.prefill_start_s is None or self.prefill_done_s is None:
+            return None
+        return self.prefill_done_s - self.prefill_start_s
+
+    @property
+    def handoff_s(self) -> Optional[float]:
+        if self.prefill_done_s is None or self.handoff_done_s is None:
+            return None
+        return self.handoff_done_s - self.prefill_done_s
+
+    @property
+    def first_decode_s(self) -> Optional[float]:
+        """Slot-live -> first generated token: from the KV handoff when
+        prefill was disaggregated, from admission otherwise (the replay
+        path generates its first token only after replaying the prompt
+        in-slot, so the replay cost is honestly attributed here)."""
+        if self.first_token_s is None:
+            return None
+        start = self.handoff_done_s if self.handoff_done_s is not None \
+            else self.admit_s
+        if start is None:
+            return None
+        return self.first_token_s - start
+
     def record(self) -> dict:
         """Lifecycle dict for the ``serving_request`` manifest row."""
         return {
@@ -61,6 +96,9 @@ class Request:
             "slot": self.slot,
             "queue_s": (self.admit_s - self.enqueue_s)
             if self.admit_s is not None else None,
+            "prefill_s": self.prefill_s,
+            "handoff_s": self.handoff_s,
+            "first_decode_s": self.first_decode_s,
             "ttft_s": self.ttft_s,
             "latency_s": self.latency_s,
         }

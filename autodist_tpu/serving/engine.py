@@ -194,12 +194,15 @@ class ServingEngine:
         from autodist_tpu.models.decoding import fresh_cache
 
         dev = self.prefill_devices[0]
+        req.prefill_start_s = time.time()
         buf_row = np.zeros((1, self.max_total), np.int32)
         buf_row[0, :req.prompt_len] = req.prompt
         args = jax.device_put(
             (self.params, fresh_cache(self.model, 1),
              jnp.asarray(buf_row), jnp.int32(req.prompt_len), rng), dev)
         cache, buf, rng = self._prefill_fn(*args)
+        jax.block_until_ready(buf)
+        req.prefill_done_s = time.time()
         # hand the prefilled KV block to the decode subset
         block = (cache, buf[0], rng)
         self.kv_handoff_bytes += sum(
@@ -241,6 +244,7 @@ class ServingEngine:
                     self._admit_prefilled_fn(
                         self._caches, self._bufs, self._rngs,
                         jnp.int32(slot), cache_one, buf_row, rng)
+                req.handoff_done_s = time.time()
                 self._ts[slot] = req.prompt_len - 1
             else:
                 buf_row = np.zeros(self.max_total, np.int32)
@@ -253,8 +257,20 @@ class ServingEngine:
             self._ends[slot] = req.total
             self._active[slot] = True
             self._requests[slot] = req
+            self._note_flight(req, "admitted")
             n += 1
         return n
+
+    def _note_flight(self, req, state):
+        """Mirror a request lifecycle transition into the flight ring
+        (no-op when telemetry is off), so a postmortem bundle shows the
+        requests that were LIVE at the moment of death."""
+        from autodist_tpu import telemetry as _tel
+
+        box = _tel.flight()
+        if box is not None:
+            box.note_request({"kind": "serving_request", "t": time.time(),
+                              "state": state, **req.record()})
 
     def _step(self, admitted=0):
         """One continuously-batched decode step over the whole table."""
@@ -290,6 +306,7 @@ class ServingEngine:
                 del self._requests[slot]
                 self._finished.append(req)
                 finished += 1
+                self._note_flight(req, "finished")
                 if self.telemetry is not None:
                     self.telemetry.request_finished(req)
         self._steps += 1

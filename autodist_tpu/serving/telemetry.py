@@ -1,14 +1,16 @@
-"""Serving telemetry: the schema-v4 manifest writer for the decode tier.
+"""Serving telemetry: the schema-v5 manifest writer for the decode tier.
 
 Mirrors :class:`~autodist_tpu.telemetry.session.SessionTelemetry` for
 the serving engine: one ``serving_step`` JSONL row per continuously-
 batched decode step (wall, live slots, queue depth, occupancy, tokens
 decoded), one ``serving_request`` row per finished request (queue wait,
+the schema-v5 TTFT span breakdown — prefill / handoff / first-decode —
 TTFT, end-to-end latency), and a summary trailer whose ``serving``
 block carries the fleet-level numbers the Q-code audit gates:
-tokens/sec, TTFT p50/p99, latency p50/p99, mean occupancy, max queue
-depth.  The finalized manifest validates under
-:func:`~autodist_tpu.telemetry.schema.validate_manifest` as schema v4.
+tokens/sec, TTFT p50/p99 plus the per-phase ``ttft_phases`` breakdown,
+latency p50/p99, mean occupancy, max queue depth.  The finalized
+manifest validates under
+:func:`~autodist_tpu.telemetry.schema.validate_manifest` as schema v5.
 """
 import os
 import time
@@ -103,6 +105,16 @@ class ServingTelemetry:
                       if r.get("latency_s") is not None)
         tp = percentiles(ttfts) if ttfts else {}
         lp = percentiles(lats) if lats else {}
+        # the TTFT span breakdown (schema v5): per-phase mean/p99 so a
+        # Q003 breach can name the dominant phase
+        phases = {}
+        for key in ("queue_s", "prefill_s", "handoff_s", "first_decode_s"):
+            vals = sorted(r[key] for r in self._requests
+                          if r.get(key) is not None)
+            if vals:
+                pp = percentiles(vals)
+                phases[key] = {"mean": sum(vals) / len(vals),
+                               "p99": pp.get(0.99)}
         return {
             "steps": self._steps,
             "requests": len(self._requests),
@@ -115,6 +127,7 @@ class ServingTelemetry:
             "occupancy_mean": (sum(self._occs) / len(self._occs)
                                if self._occs else 0.0),
             "queue_depth_max": self._queue_max,
+            "ttft_phases": phases,
         }
 
     def finalize(self, slot_stats=None):

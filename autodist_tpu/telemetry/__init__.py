@@ -24,6 +24,10 @@ The observability layer of the stack (``docs/observability.md``):
 - :mod:`~autodist_tpu.telemetry.events` — the causal cluster event log
   (schema v3 ``cluster_event`` records: signals, actions, cause,
   signal->action latency — the E-code reaction audit's input);
+- :mod:`~autodist_tpu.telemetry.flight_recorder` — the per-worker black
+  box: bounded in-memory rings, anomaly-TRIGGERED
+  ``postmortem/<trigger>_<step>/`` bundle dumps, chief-side
+  cluster-causal assembly (the P-code postmortem audit's input);
 - :mod:`~autodist_tpu.telemetry.schema` — the JSONL schema + validator
   (``make telemetry-check``).
 
@@ -43,6 +47,7 @@ from autodist_tpu.telemetry.aggregate import (load_manifest,
                                               load_manifest_with_stats,
                                               merge_worker_manifests)
 from autodist_tpu.telemetry.events import ClusterEventLog, load_events
+from autodist_tpu.telemetry.flight_recorder import FlightRecorder
 from autodist_tpu.telemetry.health import HealthMonitor
 from autodist_tpu.telemetry.metrics import (JsonlWriter, MetricsRegistry,
                                             percentiles)
@@ -62,6 +67,7 @@ __all__ = [
     "load_manifest_with_stats", "HealthMonitor",
     "ClusterView", "StreamPublisher", "TelemetryCollector",
     "stream_address_from_env", "ClusterEventLog", "load_events",
+    "FlightRecorder", "flight",
 ]
 
 _STATE = {
@@ -130,6 +136,18 @@ def gauge(name, value, **labels):
 def histogram(name, value, **labels):
     if _STATE["enabled"]:
         get_registry().histogram(name, value, **labels)
+
+
+def flight(worker=None, run_dir=None):
+    """The process's flight recorder (black box), or ``None`` when
+    telemetry is disabled — the zero-overhead gate: a disabled process
+    never constructs a recorder, so the hot path performs no ring work
+    at all (pinned by ``tests/test_flight_recorder.py``)."""
+    if not _STATE["enabled"]:
+        return None
+    from autodist_tpu.telemetry.flight_recorder import recorder
+
+    return recorder(worker=worker, run_dir=run_dir)
 
 
 def span(name, **args):

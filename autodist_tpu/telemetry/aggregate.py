@@ -97,16 +97,23 @@ def _median(xs):
     return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
 
 
-def estimate_clock_offsets(per_worker):
+def estimate_clock_offsets(per_worker, stats=None):
     """Per-worker clock offset (seconds to SUBTRACT from ``t``) keyed on
     step boundaries.
 
     ``per_worker``: ``{rank: [records]}``.  The lowest rank present is
     the reference clock (offset 0); every other worker's offset is the
     median of ``t_w[k] - t_ref[k]`` over step indices both recorded.
-    Workers sharing no step index with the reference keep offset 0 —
-    better unadjusted than wrongly adjusted."""
+    Degenerate cases fall back to offset 0.0 with a counted stat, never
+    an exception: fewer than 2 shared step indices (one shared boundary
+    cannot separate clock offset from that step's own skew — better
+    unadjusted than wrongly adjusted) and single-worker manifests (the
+    reference needs no correction).  ``stats`` (optional dict) receives
+    ``clock_offset_fallbacks``; the ``aggregate.clock_offset_fallbacks``
+    facade counter carries the same tally."""
     if not per_worker:
+        if stats is not None:
+            stats["clock_offset_fallbacks"] = 0
         return {}
     ref = min(per_worker)
     step_t = {}
@@ -115,13 +122,20 @@ def estimate_clock_offsets(per_worker):
                      if r.get("kind") == "step" and "t" in r
                      and r.get("step") is not None}
     offsets = {w: 0.0 for w in per_worker}
+    fallbacks = 0
     for w in per_worker:
         if w == ref:
             continue
         shared = sorted(set(step_t[w]) & set(step_t[ref]))
-        if shared:
+        if len(shared) >= 2:
             offsets[w] = _median([step_t[w][k] - step_t[ref][k]
                                   for k in shared])
+        else:
+            fallbacks += 1
+    if fallbacks:
+        _count("aggregate.clock_offset_fallbacks", fallbacks)
+    if stats is not None:
+        stats["clock_offset_fallbacks"] = fallbacks
     return offsets
 
 
@@ -146,7 +160,8 @@ def merge_records(run_dir):
         rank = recs[0].get("w", i) if recs else i
         per_worker.setdefault(rank, []).extend(recs)
 
-    offsets = estimate_clock_offsets(per_worker)
+    offset_stats = {}
+    offsets = estimate_clock_offsets(per_worker, stats=offset_stats)
     records, seen_steps, dups = [], set(), 0
     for w, recs in sorted(per_worker.items()):
         off = offsets.get(w, 0.0)
@@ -171,7 +186,9 @@ def merge_records(run_dir):
     if rotated_files:
         _count("aggregate.rotated_files", rotated_files)
     stats = {"skipped_lines": skipped_lines, "skipped_duplicates": dups,
-             "rotated_files": rotated_files, "clock_offsets_s": offsets}
+             "rotated_files": rotated_files, "clock_offsets_s": offsets,
+             "clock_offset_fallbacks":
+                 offset_stats.get("clock_offset_fallbacks", 0)}
     return records, stats
 
 

@@ -33,7 +33,7 @@ EVENTS_NAME = "events.jsonl"
 # "signal" with a ``signal=`` discriminator).
 ACTION_KINDS = ("membership_epoch", "replan", "checkpoint_save",
                 "preemption_guard", "chaos_injection", "hook_fired",
-                "collector_start", "collector_stop")
+                "collector_start", "collector_stop", "postmortem_dump")
 
 SIGNAL_KINDS = ("straggler", "anomaly", "heartbeat_gap", "worker_exit",
                 "chaos")
@@ -119,6 +119,17 @@ class ClusterEventLog:
                 self._writer.write(dict(rec))
             except OSError:  # pragma: no cover - disk full etc.
                 pass
+        # mirror the tail into the flight ring (lazily via the facade —
+        # a no-op when telemetry is off) so a postmortem bundle carries
+        # the causal event log up to the moment of death
+        try:
+            from autodist_tpu import telemetry as _tel
+
+            box = _tel.flight()
+            if box is not None:
+                box.note_event(dict(rec))
+        except Exception:
+            pass
 
     # -- read side --------------------------------------------------------
     @property

@@ -12,8 +12,14 @@ introduced the ``health_finding`` kind and the summary's ``health``
 block; v3 the ``cluster_event`` kind — the causal control-plane log of
 :mod:`~autodist_tpu.telemetry.events`; v4 the serving tier's
 ``serving_step`` / ``serving_request`` kinds and the summary's
-``serving`` block; v1 manifests carry no stamp and still validate —
-unknown kinds were always tolerated).
+``serving`` block; v5 the ``serving_request`` TTFT span breakdown
+(``queue_s`` / ``prefill_s`` / ``handoff_s`` / ``first_decode_s``) and
+the ``postmortem_dump`` cluster-event action — postmortem BUNDLES carry
+their own independent stamp
+(:data:`~autodist_tpu.telemetry.flight_recorder.BUNDLE_SCHEMA_VERSION`)
+since they must be readable when the manifest never finalized; v1
+manifests carry no stamp and still validate — unknown kinds were always
+tolerated).
 
 Kinds and their required fields (``docs/observability.md`` is the prose
 version; ``make telemetry-check`` asserts a live run validates):
@@ -54,7 +60,11 @@ version; ``make telemetry-check`` asserts a live run validates):
                   (decoded this step), ``admitted``, ``finished``
 - ``serving_request`` — per-request lifecycle trailer: ``rid``;
                   optional ``prompt_len``, ``max_new_tokens``,
-                  ``slot``, ``queue_s``, ``ttft_s``, ``latency_s``
+                  ``slot``, ``queue_s``, ``ttft_s``, ``latency_s``,
+                  and the TTFT span breakdown ``prefill_s`` /
+                  ``handoff_s`` / ``first_decode_s`` (queue wait is
+                  ``queue_s``) so a Q003 breach can name its dominant
+                  phase
 - ``summary``   — run trailer: ``steps``, ``step_time_p50_s``;
                   optional ``mfu_p50``, ``compile_s``,
                   ``runtime_record``, ``aggregates``, ``health``,
@@ -63,7 +73,7 @@ version; ``make telemetry-check`` asserts a live run validates):
 """
 import json
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 REQUIRED_COMMON = ("kind",)
 
@@ -93,7 +103,8 @@ NUMERIC_FIELDS = {
     "serving_step": ("step", "wall_s", "active", "queue_depth", "occupancy",
                      "tokens", "admitted", "finished"),
     "serving_request": ("rid", "prompt_len", "max_new_tokens", "queue_s",
-                        "ttft_s", "latency_s"),
+                        "ttft_s", "latency_s", "prefill_s", "handoff_s",
+                        "first_decode_s"),
 }
 
 

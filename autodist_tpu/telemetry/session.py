@@ -63,6 +63,13 @@ class SessionTelemetry:
         self._writer = JsonlWriter(
             os.path.join(self.run_dir, f"worker_{self.worker}.jsonl"),
             worker=self.worker)
+        # the black box: bounded rings fed on the same step boundary the
+        # writer already crosses; dumps are TRIGGERED by failure signals
+        # (docs/observability.md "Postmortem tier").  A session only
+        # exists when telemetry is on, so this never costs a disabled run.
+        from autodist_tpu.telemetry.flight_recorder import recorder
+
+        self.flight = recorder(worker=self.worker, run_dir=self.run_dir)
         # live control plane (docs/observability.md): push compact frames
         # to the chief's collector when one is configured.  Best-effort
         # only — a dead collector degrades to the file-only path above.
@@ -197,7 +204,12 @@ class SessionTelemetry:
         None.  Consumes the armed flag."""
         if self.watchdog is None or not self.watchdog.should_capture():
             return None
-        return os.path.join(self.run_dir, "watchdog", f"step_{self._n}")
+        path = os.path.join(self.run_dir, "watchdog", f"step_{self._n}")
+        # arm-reason + capture path enter the flight ring NOW — a crash
+        # mid-capture must still leave the trigger in the bundle (the
+        # post-capture analyzer may never run)
+        self.flight.note_watchdog(self.watchdog.last_arm_reason, path)
+        return path
 
     def _sync_metrics(self, metrics):
         """Close the step at a REAL synchronization point: fetch one device
@@ -288,6 +300,7 @@ class SessionTelemetry:
         else:
             self._walls.append(cancelled)
         self._writer.write(rec)
+        self.flight.note_step(rec)
         frame = {"kind": "step", "step": step, "wall_s": eff}
         if loss_val is not None:
             try:
@@ -310,6 +323,8 @@ class SessionTelemetry:
             for hf in health_findings:
                 self._writer.write({"kind": "health_finding",
                                     "t": time.time(), **hf})
+                self.flight.note_finding(
+                    {"kind": "health_finding", "t": time.time(), **hf})
                 self._publish({"kind": "health_finding", **hf})
                 self.registry.counter(f"health.{hf['check']}")
                 logging.warning("telemetry health: %s", hf["message"])
@@ -339,6 +354,7 @@ class SessionTelemetry:
             self._analyze_capture(step, trace_dir)
             if self.watchdog is not None:
                 self.watchdog.capture_finished()
+            self.flight.capture_done()
         if step == 0 or (step + 1) % self._mem_every == 0:
             self._memory_snapshot(step)
             self._publish({"kind": "heartbeat", "step": step})
@@ -402,6 +418,8 @@ class SessionTelemetry:
         if peak is not None:
             rec["peak_bytes"] = peak
             self.registry.gauge("session.hbm_peak_bytes", peak)
+            self.flight.note_gauge("session.hbm_peak_bytes", peak,
+                                   step=step)
         self._writer.write(rec)
 
     # -- run trailer -------------------------------------------------------

@@ -1,0 +1,403 @@
+"""Flight recorder (autodist_tpu/telemetry/flight_recorder.py,
+docs/observability.md "Postmortem tier").
+
+Pins the black-box contract: bounded O(1) rings with drop accounting,
+triggered (never polled) ``postmortem/<trigger>_<step>/`` bundle dumps
+that are idempotent and budgeted, chief-side assembly into ONE
+clock-offset-corrected cluster timeline, the atexit/excepthook
+catch-alls, the watchdog in-flight-at-exit regression, the
+zero-overhead-when-disabled gate, lint AD09 confining bundle writes to
+the module, and the clock-offset estimator's degenerate fallbacks.
+"""
+import json
+import os
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import telemetry
+from autodist_tpu.autodist import AutoDist
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce
+from autodist_tpu.telemetry import flight_recorder
+from autodist_tpu.telemetry.flight_recorder import (
+    BUNDLE_SCHEMA_VERSION, FlightRecorder, POSTMORTEM_DIRNAME,
+    assemble_bundle, latest_bundle, list_bundles, load_bundle, recorder)
+
+SPEC8 = ResourceSpec.from_num_chips(8)
+RS = np.random.RandomState(0)
+BATCH = RS.randn(16, 12).astype(np.float32)
+FIXDIR = os.path.join(os.path.dirname(__file__), "data", "postmortem")
+
+
+def _loss(p, batch):
+    return jnp.mean((batch @ p["w"]) ** 2)
+
+
+def _session():
+    r = np.random.RandomState(7)
+    params = {"w": jnp.asarray(r.randn(12, 3), jnp.float32)}
+    ad = AutoDist(resource_spec=SPEC8, strategy_builder=AllReduce())
+    return ad.distribute(_loss, params, optax.sgd(0.1))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Telemetry + the recorder singleton are process-global; leave both
+    as found (off / empty)."""
+    yield
+    telemetry.disable()
+    telemetry._STATE["run_dir"] = None
+    telemetry.reset_registry()
+    flight_recorder.reset()
+
+
+# -- bounded rings ----------------------------------------------------------
+
+def test_rings_bounded_with_drop_accounting():
+    rec = FlightRecorder(worker=3, steps=4, findings=2, events=3,
+                         gauges=2, requests=2)
+    for i in range(10):
+        rec.note_step({"step": i, "t": float(i)})
+        rec.note_event({"event": "hb", "step": i})
+    rec.note_finding({"check": "spike", "severity": "WARNING"})
+    rec.note_gauge("hbm", 1)
+    rec.note_request({"rid": 1})
+    snap = rec.snapshot()
+    assert snap["schema"] == BUNDLE_SCHEMA_VERSION
+    assert snap["worker"] == 3
+    # newest survive, oldest fall out, every loss is counted
+    assert [r["step"] for r in snap["steps"]] == [6, 7, 8, 9]
+    assert snap["dropped"]["step"] == 6
+    assert snap["dropped"]["event"] == 7
+    assert snap["dropped"]["finding"] == 0
+    assert rec.last_step_index() == 9
+
+
+def test_error_findings_arm_the_exit_dump():
+    rec = FlightRecorder()
+    assert not rec.pending_at_exit()      # a clean run exits silently
+    rec.note_finding({"check": "drift", "severity": "WARNING"})
+    assert not rec.pending_at_exit()      # warnings are not evidence
+    rec.note_finding({"check": "nonfinite", "severity": "ERROR"})
+    assert rec.pending_at_exit()
+
+
+# -- the dump: layout, idempotence, budget ----------------------------------
+
+def test_dump_bundle_layout(tmp_path):
+    rec = FlightRecorder(worker=1, run_dir=str(tmp_path))
+    rec.note_step({"step": 5, "t": 100.0, "wall_s": 0.1})
+    rec.note_finding({"check": "nonfinite", "severity": "ERROR",
+                      "step": 5, "t": 100.05})
+    bundle = rec.dump("anomaly", reason={"why": "nan loss"})
+    assert bundle == os.path.join(str(tmp_path), POSTMORTEM_DIRNAME,
+                                  "anomaly_5")  # step from the ring
+    with open(os.path.join(bundle, "worker_1.json")) as f:
+        doc = json.load(f)
+    assert doc["kind"] == "postmortem_worker"
+    assert doc["trigger"] == "anomaly" and doc["step"] == 5
+    assert doc["reason"] == {"why": "nan loss"}
+    assert doc["schema"] == BUNDLE_SCHEMA_VERSION
+    assert doc["steps"][-1]["step"] == 5
+    assert doc["findings"][0]["check"] == "nonfinite"
+    # the dump discharged the pending-error evidence
+    assert not rec.pending_at_exit()
+
+
+def test_dump_idempotent_per_trigger_step(tmp_path):
+    rec = FlightRecorder(run_dir=str(tmp_path))
+    first = rec.dump("chaos", step=2)
+    again = rec.dump("chaos", step=2)
+    assert first == again                  # the existing dir is returned
+    assert rec.dump_skips == 1
+    assert rec.dumps == [first]            # written exactly once
+    other = rec.dump("chaos", step=3)      # a new step is a new bundle
+    assert other != first and len(rec.dumps) == 2
+
+
+def test_dump_budget_caps_trigger_storms(tmp_path):
+    rec = FlightRecorder(run_dir=str(tmp_path), max_dumps=2)
+    assert rec.dump("anomaly", step=0) is not None
+    assert rec.dump("anomaly", step=1) is not None
+    assert rec.dump("anomaly", step=2) is None   # budget spent
+    assert rec.dump_skips == 1
+    assert len(list_bundles(str(tmp_path))) == 2
+
+
+def test_dump_never_raises_without_run_dir():
+    rec = FlightRecorder()                 # no run dir anywhere
+    assert rec.dump("crash") is None
+    assert rec.dumps == []
+
+
+def test_dump_copies_in_flight_watchdog_trace(tmp_path):
+    capture = tmp_path / "watchdog" / "step_7"
+    capture.mkdir(parents=True)
+    (capture / "trace.json").write_text("{}")
+    rec = FlightRecorder(worker=0, run_dir=str(tmp_path))
+    rec.note_watchdog({"step": 7, "wall_s": 2.0}, str(capture))
+    assert rec.last_watchdog["in_flight"]
+    bundle = rec.dump("watchdog", step=7)
+    with open(os.path.join(bundle, "worker_0.json")) as f:
+        doc = json.load(f)
+    assert doc["watchdog"]["in_flight"] is True
+    assert doc["watchdog"]["reason"] == {"step": 7, "wall_s": 2.0}
+    copied = doc["trace_copied"]
+    assert os.path.isfile(os.path.join(copied, "trace.json"))
+    rec.capture_done()
+    assert not rec.last_watchdog["in_flight"]
+    assert not rec.pending_at_exit()
+
+
+# -- the process singleton + crash hooks ------------------------------------
+
+def test_recorder_singleton_fresh_per_run_dir(tmp_path):
+    flight_recorder.reset()
+    r1 = recorder(worker=2, run_dir=str(tmp_path / "a"))
+    assert recorder() is r1                # sticky within a run
+    r1.note_step({"step": 1, "t": 1.0})
+    r2 = recorder(run_dir=str(tmp_path / "b"))
+    assert r2 is not r1                    # a new run is a new flight
+    assert r2.worker == 2                  # identity survives the swap
+    assert r2.snapshot()["steps"] == []    # rings do not leak across runs
+
+
+def test_atexit_hook_dumps_only_when_pending(tmp_path):
+    flight_recorder.reset()
+    rec = recorder(worker=0, run_dir=str(tmp_path))
+    flight_recorder._atexit_dump()
+    assert list_bundles(str(tmp_path)) == []   # clean exit writes nothing
+    rec.note_step({"step": 4, "t": 1.0})
+    rec.note_finding({"check": "nonfinite", "severity": "ERROR"})
+    flight_recorder._atexit_dump()
+    (bundle,) = list_bundles(str(tmp_path))
+    assert os.path.basename(bundle) == "exit_4"
+
+
+def test_excepthook_dumps_crash_bundle(tmp_path, monkeypatch):
+    flight_recorder.reset()
+    recorder(worker=0, run_dir=str(tmp_path)).note_step(
+        {"step": 9, "t": 1.0})
+    monkeypatch.setitem(flight_recorder._HOOKS, "prev_excepthook",
+                        lambda *a: None)   # keep the traceback off stderr
+    flight_recorder._excepthook(ValueError, ValueError("boom"), None)
+    (bundle,) = list_bundles(str(tmp_path))
+    assert os.path.basename(bundle) == "crash_9"
+    with open(os.path.join(bundle, "worker_0.json")) as f:
+        doc = json.load(f)
+    assert doc["reason"] == {"exception": "ValueError", "message": "boom"}
+
+
+# -- chief-side assembly ----------------------------------------------------
+
+def _worker_dump(bundle_dir, w, steps, t_dump=200.0):
+    rec = FlightRecorder(worker=w)
+    for s, t in steps:
+        rec.note_step({"kind": "step", "step": s, "t": t, "wall_s": 0.1})
+    doc = {"kind": "postmortem_worker", "t": t_dump, "trigger": "anomaly",
+           "step": steps[-1][0], **rec.snapshot()}
+    os.makedirs(bundle_dir, exist_ok=True)
+    with open(os.path.join(bundle_dir, f"worker_{w}.json"), "w") as f:
+        json.dump(doc, f)
+
+
+def test_assemble_bundle_corrects_clock_skew(tmp_path):
+    bundle_dir = str(tmp_path / POSTMORTEM_DIRNAME / "anomaly_2")
+    # worker 1's host clock runs 0.5s ahead across both shared steps
+    _worker_dump(bundle_dir, 0, [(1, 100.0), (2, 101.0)])
+    _worker_dump(bundle_dir, 1, [(1, 100.5), (2, 101.5)])
+    bundle = assemble_bundle(bundle_dir, expected_workers=range(3))
+    assert bundle["trigger"] == "anomaly" and bundle["step"] == 2
+    assert bundle["clock_offsets_s"] == {"0": 0.0, "1": 0.5}
+    # corrected time interleaves the workers at the true instants
+    w1 = [e for e in bundle["timeline"]
+          if e["w"] == 1 and e["species"] == "step"]
+    assert [e["t"] for e in w1] == [100.0, 101.0]
+    ts = [e["t"] for e in bundle["timeline"]]
+    assert ts == sorted(ts)
+    assert bundle["missing_workers"] == [2]
+    # the assembly persisted for the operator tools
+    assert load_bundle(bundle_dir)["clock_offsets_s"]["1"] == 0.5
+    assert os.path.exists(os.path.join(bundle_dir, "assembled.json"))
+
+
+def test_assemble_bundle_counts_torn_files(tmp_path):
+    bundle_dir = str(tmp_path / POSTMORTEM_DIRNAME / "crash_0")
+    _worker_dump(bundle_dir, 0, [(0, 10.0)])
+    # a crash mid-write leaves a torn snapshot: skipped AND counted
+    with open(os.path.join(bundle_dir, "worker_1.json"), "w") as f:
+        f.write('{"kind": "postmortem_wor')
+    bundle = assemble_bundle(bundle_dir, write=False)
+    assert bundle["torn_files"] == 1
+    assert sorted(bundle["workers"]) == ["0"]
+
+
+def test_assemble_bundle_dir_name_fallback_for_torn_bundles(tmp_path):
+    bundle_dir = tmp_path / POSTMORTEM_DIRNAME / "watchdog_12"
+    bundle_dir.mkdir(parents=True)
+    (bundle_dir / "worker_0.json").write_text("{torn")
+    bundle = assemble_bundle(str(bundle_dir), write=False)
+    assert bundle["trigger"] == "watchdog" and bundle["step"] == 12
+    assert bundle["torn_files"] == 1
+
+
+def test_load_bundle_variants(tmp_path):
+    # a run dir resolves to its latest bundle
+    b1 = str(tmp_path / POSTMORTEM_DIRNAME / "chaos_1")
+    b2 = str(tmp_path / POSTMORTEM_DIRNAME / "anomaly_3")
+    _worker_dump(b1, 0, [(1, 10.0)])
+    _worker_dump(b2, 0, [(3, 30.0)])
+    os.utime(b1, (1.0, 1.0))               # deterministic mtime order
+    os.utime(b2, (2.0, 2.0))
+    assert latest_bundle(str(tmp_path)) == b2
+    assert load_bundle(str(tmp_path))["trigger"] == "anomaly"
+    # a single worker file wraps into a one-worker bundle
+    wrapped = load_bundle(os.path.join(b1, "worker_0.json"))
+    assert sorted(wrapped["workers"]) == ["0"]
+    assert wrapped["clock_offsets_s"] == {"0": 0.0}
+    # a golden assembled-bundle JSON loads as-is
+    fixture = load_bundle(os.path.join(FIXDIR, "clean.json"))
+    assert fixture["trigger"] == "preempt"
+    # nothing there -> None, never a raise
+    assert load_bundle(str(tmp_path / "nope")) is None
+    assert load_bundle(str(tmp_path / "empty_run")) is None
+
+
+# -- satellite: the watchdog arm enters the ring BEFORE the capture ---------
+
+def test_watchdog_arm_reaches_ring_before_capture_runs(tmp_path):
+    """Regression: a crash between should_capture() and the profiler
+    writing anything must still leave the arm reason + capture path in
+    the black box, and the in-flight capture must arm the exit dump."""
+    telemetry.enable(run_dir=str(tmp_path / "run"))
+    flight_recorder.reset()
+    sess = _session()
+    tele = sess._telemetry
+    assert tele is not None and tele.flight is recorder()
+
+    class ArmedWatchdog:
+        def should_capture(self):
+            return True
+
+    ArmedWatchdog.last_arm_reason = {"step": 0, "wall_s": 9.0,
+                                     "median_s": 0.1, "multiple": 3.0}
+    tele.watchdog = ArmedWatchdog()
+    path = tele.arm_capture_dir()
+    assert path is not None
+    wd = tele.flight.last_watchdog
+    assert wd["in_flight"] and wd["capture_dir"] == path
+    assert wd["reason"]["wall_s"] == 9.0
+    assert tele.flight.pending_at_exit()
+    # the process dies mid-capture: the catch-all still flushes the box
+    flight_recorder._atexit_dump()
+    (bundle,) = list_bundles(tele.run_dir)
+    assert os.path.basename(bundle).startswith("exit")
+    doc = load_bundle(bundle)
+    (wrec,) = doc["workers"].values()
+    assert wrec["watchdog"]["in_flight"] is True
+    assert wrec["watchdog"]["capture_dir"] == path
+    # the window closing clears the arm
+    tele.flight.capture_done()
+    assert not tele.flight.pending_at_exit()
+
+
+# -- the zero-overhead-when-disabled gate -----------------------------------
+
+def test_disabled_zero_overhead(monkeypatch):
+    """Acceptance pin: with telemetry off the hot path constructs no
+    recorder, touches no ring, writes no file, syncs no device."""
+    assert not telemetry.enabled()
+    assert telemetry.flight() is None
+    flight_recorder.reset()
+    sess = _session()
+    assert sess._telemetry is None
+
+    def boom(*a, **k):
+        raise AssertionError("disabled hot path touched the flight "
+                             "recorder / file I/O / device sync")
+
+    monkeypatch.setattr(flight_recorder.FlightRecorder, "__init__", boom)
+    monkeypatch.setattr(flight_recorder.FlightRecorder, "note_step", boom)
+    monkeypatch.setattr(flight_recorder.FlightRecorder, "dump", boom)
+    monkeypatch.setattr(flight_recorder, "recorder", boom)
+    monkeypatch.setattr(telemetry.JsonlWriter, "__init__", boom)
+    monkeypatch.setattr(jax, "block_until_ready", boom)
+    for _ in range(3):
+        metrics = sess.run(BATCH)
+    assert np.isfinite(float(metrics["loss"]))
+    assert telemetry.flight() is None      # the facade gate held
+
+
+# -- lint AD09: bundle writes stay confined to the module -------------------
+
+def test_ad09_flags_stray_postmortem_writers(tmp_path):
+    from tools.lint import lint_file
+
+    stray = tmp_path / "autodist_tpu" / "sneaky.py"
+    stray.parent.mkdir()
+    stray.write_text('import os\n'
+                     'BUNDLE = os.path.join("run", "postmortem")\n')
+    codes = {code for _, _, code, _ in lint_file(stray)}
+    assert "AD09" in codes
+    # the owner module and files outside the package stay exempt
+    repo = Path(__file__).resolve().parent.parent
+    owner = repo / "autodist_tpu" / "telemetry" / "flight_recorder.py"
+    assert "AD09" not in {code for _, _, code, _ in lint_file(owner)}
+    outside = tmp_path / "tool.py"
+    outside.write_text('D = "postmortem"\n')
+    assert "AD09" not in {code for _, _, code, _ in lint_file(outside)}
+
+
+# -- satellite: clock-offset estimator degenerate fallbacks -----------------
+
+def _steps(pairs):
+    return [{"kind": "step", "step": s, "t": t} for s, t in pairs]
+
+
+def test_clock_offsets_single_worker_is_zero_without_fallback():
+    from autodist_tpu.telemetry.aggregate import estimate_clock_offsets
+
+    stats = {}
+    offsets = estimate_clock_offsets(
+        {0: _steps([(0, 1.0), (1, 2.0)])}, stats)
+    assert offsets == {0: 0.0}             # the reference needs no fix
+    assert stats["clock_offset_fallbacks"] == 0
+
+
+def test_clock_offsets_fall_back_below_two_shared_steps():
+    from autodist_tpu.telemetry.aggregate import estimate_clock_offsets
+
+    telemetry.reset_registry()
+    telemetry.enable()
+    stats = {}
+    per_worker = {
+        0: _steps([(0, 1.0), (1, 2.0)]),
+        1: _steps([(1, 7.5), (5, 9.0)]),   # one shared index: ambiguous
+        2: _steps([(0, 1.1), (1, 2.1)]),   # two shared: estimable
+    }
+    offsets = estimate_clock_offsets(per_worker, stats)
+    assert offsets[1] == 0.0               # better unadjusted than wrong
+    assert offsets[2] == pytest.approx(0.1)
+    assert stats["clock_offset_fallbacks"] == 1
+    reg = telemetry.get_registry()
+    assert reg.counter_value("aggregate.clock_offset_fallbacks") == 1.0
+
+
+def test_clock_offsets_degenerate_inputs_never_raise():
+    from autodist_tpu.telemetry.aggregate import estimate_clock_offsets
+
+    stats = {}
+    assert estimate_clock_offsets({}, stats) == {}
+    assert stats["clock_offset_fallbacks"] == 0
+    # records without usable step boundaries -> zero offsets, counted
+    stats = {}
+    offsets = estimate_clock_offsets(
+        {0: [{"kind": "snapshot", "t": 1.0}],
+         1: [{"kind": "step", "step": None, "t": 2.0}]}, stats)
+    assert offsets == {0: 0.0, 1: 0.0}
+    assert stats["clock_offset_fallbacks"] == 1

@@ -1,0 +1,212 @@
+"""Postmortem audit: the P-code root-cause tier
+(autodist_tpu/analysis/postmortem_audit.py, docs/analysis.md).
+
+Pins the verdicts over the golden bundle fixtures under
+``tests/data/postmortem`` (the same bundles ``tools/verify_strategy.py
+--postmortem --selftest`` gates) plus synthetic bundles for the
+incompleteness (P003) and reaction-mismatch (P004) clauses, the pass
+registration, and the ElasticTrainer replan cross-link.
+"""
+import os
+
+import pytest
+
+from autodist_tpu.analysis.postmortem_audit import (audit_fixture,
+                                                    postmortem_audit,
+                                                    postmortem_audit_pass)
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "data", "postmortem")
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+def _one(findings, code):
+    hits = [f for f in findings if f.code == code]
+    assert len(hits) == 1, f"expected one {code}, got {_codes(findings)}"
+    return hits[0]
+
+
+# -- the golden fixtures ----------------------------------------------------
+
+def test_nan_cascade_fixture_names_first_poisoned_worker():
+    findings = audit_fixture(os.path.join(FIXDIR, "nan_cascade.json"))
+    p1 = _one(findings, "P001")
+    # worker 1 poisoned first in CORRECTED cluster time; 0 and 2 are
+    # downstream of the same all-reduce
+    assert p1.data["worker"] == 1
+    assert p1.data["step"] == 3
+    assert p1.data["tensor"] == "loss"
+    assert p1.data["cascade_findings"] == 3
+    assert p1.data["cascade_workers"] == [0, 1, 2]
+    assert "worker 1 poisoned first" in p1.message
+    p5 = _one(findings, "P005")
+    assert p5.data["flagged"] == ["P001"]
+    assert p5.data["first_poison"]["worker"] == 1
+
+
+def test_stall_fixture_names_culprit_channel():
+    findings = audit_fixture(os.path.join(FIXDIR, "stall.json"))
+    p2 = _one(findings, "P002")
+    # worker 1 stopped at step 4 while worker 0 reached 6; the largest
+    # intended sync channel is the likely blocker
+    assert p2.data["worker"] == 1
+    assert p2.data["last_step"] == 4
+    assert p2.data["stall_s"] == pytest.approx(5.0)
+    assert p2.data["culprit_channel"] == "grad-allreduce"
+    assert p2.data["culprit_bytes"] == 4194304
+    assert "likely blocked in 'grad-allreduce'" in p2.message
+    # a single transient straggler signal is not a P004
+    assert "P004" not in _codes(findings)
+
+
+def test_clean_fixture_stays_clean_with_table():
+    findings = audit_fixture(os.path.join(FIXDIR, "clean.json"))
+    assert _codes(findings) == ["P005"]
+    p5 = findings[0]
+    assert p5.data["trigger"] == "preempt"
+    assert p5.data["flagged"] == []
+    assert p5.data["workers"] == ["0", "1"]
+    assert p5.data["timeline"] == {"step": 4, "event": 3}
+
+
+# -- synthetic clauses ------------------------------------------------------
+
+def _stall_bundle(**over):
+    bundle = {
+        "trigger": "watchdog", "step": 3, "t": 110.0, "path": "x",
+        "workers": {"0": {"dropped": {}}, "1": {"dropped": {}}},
+        "timeline": [
+            {"species": "step", "w": 0, "step": 2, "t": 100.0},
+            {"species": "step", "w": 1, "step": 2, "t": 100.1},
+            {"species": "step", "w": 0, "step": 3, "t": 101.0},
+        ],
+        "missing_workers": [], "torn_files": 0,
+    }
+    bundle.update(over)
+    return bundle
+
+
+def test_p002_without_intended_table_still_names_the_window():
+    p2 = _one([f for f in postmortem_audit(_stall_bundle())
+               if f.code == "P002"], "P002")
+    assert p2.data["worker"] == 1 and p2.data["last_step"] == 2
+    assert p2.data["culprit_channel"] is None
+    assert "no intended-channel table" in p2.message
+
+
+def test_p002_respects_stall_floor_and_trigger_gate():
+    # sub-threshold stall: a slow step, not a death window
+    fast = _stall_bundle(t=100.4)
+    assert "P002" not in _codes(postmortem_audit(fast))
+    # same evidence under a non-stall trigger stays quiet
+    assert "P002" not in _codes(postmortem_audit(
+        _stall_bundle(trigger="anomaly")))
+
+
+def test_p002_joins_explicit_intended_channels():
+    channels = [{"label": "small", "intended_bytes": 10, "phase": "p"},
+                {"label": "big", "intended_bytes": 1000, "phase": "p"}]
+    p2 = _one([f for f in postmortem_audit(_stall_bundle(),
+                                           intended={"channels": channels})
+               if f.code == "P002"], "P002")
+    assert p2.data["culprit_channel"] == "big"
+
+
+def test_p003_names_every_incompleteness_source():
+    bundle = _stall_bundle(
+        trigger="preempt",
+        torn_files=2, missing_workers=[3],
+        workers={"0": {"dropped": {"step": 5, "event": 0}},
+                 "1": {"dropped": {}}})
+    findings = postmortem_audit(bundle)
+    p3 = _one(findings, "P003")
+    assert p3.data["torn_files"] == 2
+    assert p3.data["missing_workers"] == [3]
+    assert p3.data["dropped"] == {"0": {"step": 5, "event": 0}}
+    assert str(p3.severity) == "WARNING"
+
+
+def test_p004_fires_on_repeated_or_persistent_unacted_signals():
+    sig = {"species": "event", "event": "signal", "signal": "straggler",
+           "worker": "10.0.0.2", "step": 2, "t": 100.0}
+    # repeated twice, never answered -> P004
+    bundle = _stall_bundle(trigger="preempt",
+                           timeline=[sig, {**sig, "step": 3, "t": 101.0}])
+    p4 = _one(postmortem_audit(bundle), "P004")
+    assert p4.data == {"signal": "straggler", "worker": "10.0.0.2",
+                       "count": 2}
+    # a single signal flagged persistent is enough
+    bundle = _stall_bundle(trigger="preempt",
+                           timeline=[{**sig, "persistent": True}])
+    assert "P004" in _codes(postmortem_audit(bundle))
+    # the same signal WITH a caused action stays quiet
+    acted = {"species": "event", "event": "replan", "t": 102.0,
+             "cause": {"signal": "straggler", "worker": "10.0.0.2"}}
+    bundle = _stall_bundle(trigger="preempt",
+                           timeline=[sig, {**sig, "t": 101.0}, acted])
+    assert "P004" not in _codes(postmortem_audit(bundle))
+
+
+def test_no_bundle_is_an_info_skip():
+    assert _codes(postmortem_audit(None)) == ["P000"]
+
+
+# -- registration + the registered pass -------------------------------------
+
+def test_tier_registered_alongside_the_others():
+    from autodist_tpu.analysis.passes import (PASS_REGISTRY,
+                                              POSTMORTEM_PASSES)
+
+    assert POSTMORTEM_PASSES == ("postmortem-audit",)
+    # the registry wrapper delegates to this module's pass
+    class Ctx:
+        pass
+
+    assert _codes(PASS_REGISTRY["postmortem-audit"](Ctx())) == ["P000"]
+
+
+def test_pass_reads_context_bundle_and_leaves_summary():
+    class Ctx:
+        pass
+
+    ctx = Ctx()
+    findings = postmortem_audit_pass(ctx)
+    assert _codes(findings) == ["P000"]     # a clean run dumps nothing
+
+    ctx = Ctx()
+    ctx.postmortem_bundle = os.path.join(FIXDIR, "nan_cascade.json")
+    findings = postmortem_audit_pass(ctx)   # a path loads via load_bundle
+    assert "P001" in _codes(findings)
+    assert ctx.postmortem_summary["flagged"] == ["P001"]
+
+    # an X006 context table feeds the P002 culprit join when the bundle
+    # carries no intended table of its own
+    ctx = Ctx()
+    ctx.postmortem_bundle = _stall_bundle()
+    ctx.audit_summary = {"channels": [
+        {"label": "ctx-chan", "intended_bytes": 7, "phase": "p"}]}
+    findings = postmortem_audit_pass(ctx)
+    p2 = _one([f for f in findings if f.code == "P002"], "P002")
+    assert p2.data["culprit_channel"] == "ctx-chan"
+
+
+def test_verify_strategy_threads_the_bundle_through():
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.analysis import verify_strategy
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    spec = ResourceSpec.from_num_chips(8)
+    item = ModelItem(lambda p, b: jnp.mean((b["x"] @ p["w"]) ** 2),
+                     {"w": jnp.zeros((16, 4))}, optax.sgd(0.1))
+    report = verify_strategy(
+        AllReduce().build(item, spec), item, spec,
+        passes=("postmortem-audit",),
+        postmortem_bundle=os.path.join(FIXDIR, "stall.json"))
+    codes = _codes(report.findings)
+    assert "P002" in codes and "P005" in codes

@@ -1,5 +1,5 @@
 """Serving tier: slot allocation, admission policy, the continuous-
-batching engine, schema-v4 serving telemetry, and the Q-code audit.
+batching engine, schema-v5 serving telemetry, and the Q-code audit.
 
 Pinned here:
 
@@ -15,7 +15,9 @@ Pinned here:
   bit-matching the static ``generate()`` rollout through ONE executable,
   admit-into-freed-slot mid-run without recompiling, drain-on-shrink
   via ``rescale()`` (queued requests survive, causality recorded),
-- schema-v4 manifest validation of the serving telemetry,
+- schema-v5 manifest validation of the serving telemetry, including the
+  TTFT span attribution (queue -> prefill -> handoff -> first decode)
+  and the engine mirroring live requests into the flight ring,
 - the Q-code audit (Q001-Q004 + fixtures + ``load_metrics`` forms),
 - ``clear_decode_caches()`` and the AD08 lint rule, both directions.
 """
@@ -302,10 +304,10 @@ def test_engine_rejects_indivisible_mesh(decode_setup):
                       mesh=mesh)
 
 
-# -- schema-v4 serving telemetry --------------------------------------------
+# -- schema-v5 serving telemetry --------------------------------------------
 
 
-def test_serving_manifest_is_schema_v4(decode_setup, tmp_path):
+def test_serving_manifest_is_schema_v5(decode_setup, tmp_path):
     from autodist_tpu import telemetry
     from autodist_tpu.serving.telemetry import ServingTelemetry
     from autodist_tpu.telemetry.schema import SCHEMA_VERSION
@@ -327,16 +329,83 @@ def test_serving_manifest_is_schema_v4(decode_setup, tmp_path):
     assert kinds.count("serving_request") == 2
     assert "serving_step" in kinds
     meta = next(r for r in records if r.get("kind") == "meta")
-    assert meta["schema"] == SCHEMA_VERSION == 4
+    assert meta["schema"] == SCHEMA_VERSION == 5
+    # schema v5: every finished request carries its TTFT span breakdown
+    for r in records:
+        if r.get("kind") != "serving_request":
+            continue
+        assert r["queue_s"] >= 0
+        assert r["first_decode_s"] > 0        # replay path: admit -> token
+        assert r["ttft_s"] >= r["first_decode_s"]
     summary = next(r for r in records if r.get("kind") == "summary")
     serving = summary["serving"]
     assert serving["requests"] == 2
     assert serving["tokens"] == sum(n for _, n in REQUESTS[:2])
     for key in ("tokens_per_s", "ttft_p50_s", "ttft_p99_s",
                 "latency_p50_s", "latency_p99_s", "occupancy_mean",
-                "queue_depth_max", "slots"):
+                "queue_depth_max", "slots", "ttft_phases"):
         assert key in serving, key
     assert serving["slots"]["num_slots"] == 2
+    phases = serving["ttft_phases"]
+    assert set(phases) >= {"queue_s", "first_decode_s"}
+    for p in phases.values():
+        assert p["mean"] >= 0 and p["p99"] is not None
+
+
+def test_request_ttft_span_attribution():
+    from autodist_tpu.serving.admission import Request
+
+    # disaggregated path: every phase boundary stamped
+    req = Request(rid=0, prompt=(1, 2), max_new_tokens=2, enqueue_s=10.0,
+                  admit_s=10.5, prefill_start_s=10.6, prefill_done_s=10.9,
+                  handoff_done_s=11.0, first_token_s=11.2, finish_s=11.5)
+    rec = req.record()
+    assert rec["queue_s"] == pytest.approx(0.5)
+    assert rec["prefill_s"] == pytest.approx(0.3)
+    assert rec["handoff_s"] == pytest.approx(0.1)
+    assert rec["first_decode_s"] == pytest.approx(0.2)
+    assert rec["ttft_s"] == pytest.approx(1.2)
+    # the spans tile the whole TTFT: nothing is left unattributed
+    assert (rec["queue_s"] + (req.prefill_start_s - req.admit_s)
+            + rec["prefill_s"] + rec["handoff_s"] + rec["first_decode_s"]
+            ) == pytest.approx(rec["ttft_s"])
+    # replay path: no prefill/handoff stamps -> first-decode spans from
+    # admission, honestly charging the in-slot prompt replay to it
+    replay = Request(rid=1, prompt=(1,), max_new_tokens=1, enqueue_s=10.0,
+                     admit_s=10.5, first_token_s=11.2)
+    rec = replay.record()
+    assert rec["prefill_s"] is None and rec["handoff_s"] is None
+    assert rec["first_decode_s"] == pytest.approx(0.7)
+    # unfinished request: no invented numbers
+    assert Request(rid=2, prompt=(1,), max_new_tokens=1,
+                   enqueue_s=1.0).record()["first_decode_s"] is None
+
+
+def test_engine_mirrors_live_requests_into_flight_ring(decode_setup,
+                                                       tmp_path):
+    from autodist_tpu import telemetry
+    from autodist_tpu.telemetry import flight_recorder
+
+    _, model, params = decode_setup
+    telemetry.enable(run_dir=str(tmp_path))
+    flight_recorder.reset()
+    try:
+        eng = ServingEngine(model, params, max_total=MAX_TOTAL,
+                            num_slots=2)
+        eng.submit(*REQUESTS[0])
+        eng.run()
+        box = telemetry.flight()
+        assert box is not None
+        reqs = box.snapshot()["requests"]
+        states = [(r["rid"], r["state"]) for r in reqs]
+        assert (0, "admitted") in states and (0, "finished") in states
+        fin = next(r for r in reqs if r["state"] == "finished")
+        assert fin["first_decode_s"] > 0      # spans ride into the bundle
+    finally:
+        telemetry.disable()
+        telemetry._STATE["run_dir"] = None
+        telemetry.reset_registry()
+        flight_recorder.reset()
 
 
 # -- the Q-code audit --------------------------------------------------------
@@ -385,6 +454,28 @@ def test_audit_q003_ttft_budget():
     assert "Q003" in _codes(serving_audit(slow, []))
     assert "Q003" not in _codes(
         serving_audit(slow, [], ttft_budget_s=10.0))   # budget overridable
+
+
+def test_audit_q003_names_dominant_phase():
+    from autodist_tpu.analysis.serving_audit import (_CLEAN_METRICS,
+                                                     serving_audit)
+
+    phases = {"queue_s": {"mean": 6.0, "p99": 8.5},
+              "prefill_s": {"mean": 0.2, "p99": 0.3},
+              "first_decode_s": {"mean": 0.4, "p99": 0.6}}
+    slow = dict(_CLEAN_METRICS, ttft_p99_s=9.0, ttft_phases=phases)
+    findings = serving_audit(slow, [])
+    q3 = next(f for f in findings if f.code == "Q003")
+    assert q3.data["dominant_phase"] == "queue_s"
+    assert "dominant phase: queue_s" in q3.message
+    # no breakdown recorded: the breach says so instead of guessing
+    bare = dict(_CLEAN_METRICS, ttft_p99_s=9.0, ttft_phases={})
+    q3 = next(f for f in serving_audit(bare, []) if f.code == "Q003")
+    assert q3.data["dominant_phase"] is None
+    assert "no span breakdown" in q3.message
+    # the Q004 table carries the phases for the report renderer
+    q4 = next(f for f in findings if f.code == "Q004")
+    assert q4.data["ttft_phases"] == phases
 
 
 def test_audit_empty_metrics_is_q000():

@@ -221,15 +221,16 @@ def test_jsonl_writer_and_merge(tmp_path):
     w0.write({"kind": "step", "step": 0, "wall_s": 0.1, "t": 10.0})
     w1.write({"kind": "step", "step": 0, "wall_s": 0.2, "t": 5.0})
     w0.write({"kind": "step", "step": 1, "wall_s": 0.1, "t": 20.0})
+    w1.write({"kind": "step", "step": 1, "wall_s": 0.2, "t": 15.0})
     w0.close(), w1.close()
     manifest = telemetry.merge_worker_manifests(str(tmp_path))
     records = telemetry.load_manifest(str(tmp_path))
     assert manifest.endswith("manifest.jsonl")
     # clock-offset corrected (worker 1's clock runs 5s behind worker 0's
-    # — both step-0 records are simultaneous events, so the shared step
-    # index pins the offset) then time-ordered, rank annotation preserved
+    # — two shared step indices pin the offset; one alone falls back to
+    # 0.0, see estimate_clock_offsets) then time-ordered, rank preserved
     assert [(r["w"], r["t"]) for r in records] == [(0, 10.0), (1, 10.0),
-                                                  (0, 20.0)]
+                                                  (0, 20.0), (1, 20.0)]
     # the raw stamp survives for forensics
     w1_rec = next(r for r in records if r["w"] == 1)
     assert w1_rec["t_raw"] == 5.0

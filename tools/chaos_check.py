@@ -22,6 +22,10 @@ Asserts the elastic-training acceptance contract end to end, no TPU needed:
    (``check='nonfinite'``), land in the telemetry manifest as
    ``health_finding`` records + the summary's health verdict, and the
    run must still drain to its step target with membership untouched.
+   The anomaly trigger must also flush the flight recorder: a
+   ``postmortem/anomaly_<step>/`` bundle whose P-code root-cause audit
+   fires P001 naming the injected worker and the first poisoned step
+   (docs/observability.md "Postmortem tier").
 5. **live straggler stream** — the LIVE control plane (docs/
    observability.md): a synthetic peer worker publishes ``delay@N``-
    shaped step walls over the real stream socket to the chief's
@@ -307,10 +311,39 @@ def check_nan_anomaly_drill():
         summ = next((x for x in records if x.get("kind") == "summary"), {})
         counts = (summ.get("health") or {}).get("counts") or {}
         assert counts.get("nonfinite"), summ.get("health")
+        # the anomaly trigger flushed the black box: the bundle's P-code
+        # audit must name the injected worker (0, the live process) and
+        # the first poisoned step
+        from autodist_tpu.analysis.postmortem_audit import postmortem_audit
+        from autodist_tpu.telemetry.flight_recorder import (list_bundles,
+                                                            load_bundle)
+
+        first_step = (summ.get("health") or {}).get("first_nonfinite_step")
+        anomaly_bundles = [
+            b for b in list_bundles(run_dir)
+            if os.path.basename(b).startswith("anomaly")]
+        assert anomaly_bundles, \
+            f"no anomaly bundle dumped under {run_dir}/postmortem"
+        bundle = load_bundle(anomaly_bundles[-1])
+        assert bundle is not None, anomaly_bundles[-1]
+        p001 = next((f for f in postmortem_audit(bundle)
+                     if f.code == "P001"), None)
+        assert p001 is not None, "P001 did not fire on the NaN bundle"
+        assert p001.data["worker"] == 0, p001.data
+        if first_step is not None:
+            assert p001.data["step"] == first_step, (p001.data, first_step)
+        # the replan-free run still cross-links: the trainer audited the
+        # dump it triggered
+        assert trainer.last_postmortem_report is not None
+        assert "P001" in {f.code
+                          for f in trainer.last_postmortem_report.findings}
         return {"anomalies": len(anomalies),
                 "first_check": anomalies[0]["check"],
                 "manifest_health_findings": len(hf),
-                "nonfinite_count": counts["nonfinite"], "replans": 0}
+                "nonfinite_count": counts["nonfinite"], "replans": 0,
+                "postmortem_bundle": os.path.basename(anomaly_bundles[-1]),
+                "p001_worker": p001.data["worker"],
+                "p001_step": p001.data["step"]}
 
 
 def check_live_straggler_stream():

@@ -95,6 +95,18 @@ codebase:
         Scoped to ``autodist_tpu/`` and ``tools/``; tests construct
         caches and tables legitimately.
 
+  AD09  ad-hoc postmortem ring/dump plumbing in ``autodist_tpu/``: the
+        ``"postmortem"`` bundle-directory literal appearing outside the
+        blessed black-box recorder
+        (``telemetry/flight_recorder.py`` — it owns the ring buffers,
+        the bundle layout, and ``POSTMORTEM_DIRNAME``).  A locally
+        spelled bundle path silently diverges from the dump schema the
+        P-code audit reconstructs (torn-file detection, clock-offset
+        assembly, the trigger dedupe budget); import
+        ``POSTMORTEM_DIRNAME`` / call ``flight().dump`` instead.
+        Scoped to ``autodist_tpu/``; tools and tests name the
+        directory legitimately.
+
 Exit code 1 when any finding is reported.
 """
 import ast
@@ -201,6 +213,17 @@ def _ad08_applies(path):
     return any(part in _AD01_PARTS for part in p.parts) \
         and _AD08_EXEMPT_DIR not in p.parts \
         and p.name != _AD08_EXEMPT_NAME
+
+
+# AD09 applies inside the package only; telemetry/flight_recorder.py IS
+# the blessed black-box site (it defines POSTMORTEM_DIRNAME); tools and
+# tests spell the directory name legitimately
+_AD09_EXEMPT = ("flight_recorder.py", "lint.py")
+
+
+def _ad09_applies(path):
+    p = Path(path)
+    return "autodist_tpu" in p.parts and p.name not in _AD09_EXEMPT
 
 
 class Checker(ast.NodeVisitor):
@@ -491,6 +514,16 @@ class Checker(ast.NodeVisitor):
                      "(load_events/summarize_trace) so gzip handling, "
                      "device-lane detection and the runtime audit's "
                      "event model cannot drift")
+        # AD09: the postmortem bundle directory belongs to the flight
+        # recorder — everyone else imports POSTMORTEM_DIRNAME
+        if node.value == "postmortem" and _ad09_applies(self.path):
+            self.add(node.lineno, "AD09",
+                     "ad-hoc postmortem bundle path ('postmortem'): "
+                     "ring/dump writes belong to telemetry/"
+                     "flight_recorder.py — import POSTMORTEM_DIRNAME / "
+                     "call flight().dump so bundle layout, torn-file "
+                     "detection and the P-audit's reconstruction "
+                     "cannot drift")
         self.generic_visit(node)
 
     def visit_Compare(self, node):

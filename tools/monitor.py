@@ -23,6 +23,12 @@ Two sources, one render:
 ``--once`` renders a single frame and exits (the CI path —
 ``tools/monitor_check.py`` drives it); default is to refresh until
 interrupted.  Exit status 1 when there is nothing to show.
+
+``--postmortem`` switches to the black-box view: list the flight-
+recorder bundles under RUN_DIR (``postmortem/<trigger>_<step>/``),
+one line per bundle with its P-code root-cause verdict (P001 first
+poisoned worker, P002 stall culprit, ... — docs/observability.md
+"Postmortem tier"); exit 1 when the run left no bundle.
 """
 import argparse
 import json
@@ -111,6 +117,50 @@ def _load_run_dir(path):
     return records, events, (max(ts) if ts else None)
 
 
+def _postmortem_view(run_dir, as_json=False):
+    """The operator's black-box table: one line per bundle under
+    ``run_dir`` with the P-audit verdict (the flagged codes + the
+    root-cause subject when one was named)."""
+    from autodist_tpu.analysis.postmortem_audit import postmortem_audit
+    from autodist_tpu.telemetry.flight_recorder import (list_bundles,
+                                                        load_bundle)
+
+    rows = []
+    for path in list_bundles(run_dir):
+        bundle = load_bundle(path)
+        if bundle is None:
+            rows.append({"path": path, "error": "unreadable"})
+            continue
+        findings = postmortem_audit(bundle,
+                                    intended=bundle.get("intended"))
+        p5 = next((f.data for f in findings if f.code == "P005"), {})
+        root = next((f for f in findings
+                     if f.code in ("P001", "P002")), None)
+        rows.append({"path": path, "trigger": bundle.get("trigger"),
+                     "step": bundle.get("step"),
+                     "workers": len(bundle.get("workers") or {}),
+                     "flagged": p5.get("flagged", []),
+                     "root_cause": (f"{root.code} {root.subject}"
+                                    if root else None)})
+    if as_json:
+        print(json.dumps({"bundles": rows}, indent=2))
+    else:
+        print(f"postmortem bundles under {run_dir}: {len(rows)}")
+        for r in rows:
+            name = os.path.basename(r["path"])
+            if r.get("error"):
+                print(f"  {name}: {r['error']}")
+                continue
+            flagged = ",".join(r["flagged"]) if r["flagged"] else "clean"
+            print(f"  {name}: trigger={r['trigger']} step={r['step']} "
+                  f"workers={r['workers']} [{flagged}]"
+                  + (f" <- {r['root_cause']}" if r["root_cause"] else ""))
+    if not rows:
+        print(f"(no postmortem bundles under {run_dir})", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
@@ -126,9 +176,17 @@ def main(argv=None):
                     help="refresh period in seconds (default 1)")
     ap.add_argument("--json", action="store_true",
                     help="emit the snapshot as JSON instead of the table")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="list RUN_DIR's flight-recorder bundles with "
+                         "their P-code root-cause verdicts instead of "
+                         "the live view")
     args = ap.parse_args(argv)
     if (args.path is None) == (args.listen is None):
         ap.error("pass a run dir to tail OR --listen, not both/neither")
+    if args.postmortem:
+        if args.path is None:
+            ap.error("--postmortem needs a run dir, not --listen")
+        return _postmortem_view(args.path, as_json=args.json)
 
     collector = None
     if args.listen is not None:

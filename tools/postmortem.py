@@ -1,0 +1,155 @@
+"""Postmortem bundle reader (docs/observability.md "Postmortem tier").
+
+Usage::
+
+    python tools/postmortem.py BUNDLE_OR_RUN_DIR [--workers N] [--json]
+    python tools/postmortem.py RUN_DIR --list
+
+Reconstructs one flight-recorder bundle — a
+``postmortem/<trigger>_<step>/`` dir of per-worker black-box snapshots,
+an ``assembled.json``, or a telemetry run dir (its latest bundle) —
+into the cluster-causal timeline
+(:func:`~autodist_tpu.telemetry.flight_recorder.assemble_bundle`
+reuses the manifest merge's clock-offset correction), renders the
+per-worker ring state + timeline tail, and runs the P-code root-cause
+audit (:mod:`autodist_tpu.analysis.postmortem_audit`) over it: the
+first poisoned worker/step/tensor of a NaN cascade (P001), the stall
+window and culprit collective of a hang death (P002), incompleteness
+(P003), signals the reaction tier never acted on (P004), and the
+machine-readable P005 bundle table.
+
+``--list`` enumerates the bundles under a run dir instead.  ``--json``
+emits ``{"bundle": ..., "findings": [...]}``.  Exit status 1 when no
+bundle is found.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _fmt_t(t):
+    import time
+
+    if not isinstance(t, (int, float)):
+        return "-"
+    return time.strftime("%H:%M:%S", time.localtime(t)) + f".{int(t * 1e3) % 1000:03d}"
+
+
+def _timeline_line(e):
+    species = e.get("species", "?")
+    w = e.get("w", "?")
+    if species == "step":
+        body = f"step {e.get('step')} wall {e.get('wall_s')}"
+    elif species == "finding":
+        body = (f"{e.get('severity', '?')} {e.get('check', '?')}"
+                f"@{e.get('step')}: {e.get('message', '')}")
+    else:
+        body = (f"event {e.get('event')}"
+                + (f"@{e.get('step')}" if e.get("step") is not None else "")
+                + (f" signal={e.get('signal')}" if e.get("signal") else ""))
+    return f"  {_fmt_t(e.get('t'))} w{w} [{species}] {body}"
+
+
+def render_bundle(bundle, findings, tail=12):
+    """Header + per-worker ring table + offsets + timeline tail +
+    the P-audit verdicts."""
+    lines = []
+    add = lines.append
+    add(f"postmortem bundle: trigger={bundle.get('trigger')} "
+        f"step={bundle.get('step')} schema={bundle.get('schema')}")
+    add(f"  path: {bundle.get('path')}")
+    for w, rec in sorted((bundle.get("workers") or {}).items(),
+                         key=lambda kv: int(kv[0])):
+        dropped = rec.get("dropped") or {}
+        wd = rec.get("watchdog")
+        add(f"  w{w}: steps={len(rec.get('steps') or [])} "
+            f"findings={len(rec.get('findings') or [])} "
+            f"events={len(rec.get('events') or [])} "
+            f"gauges={len(rec.get('gauges') or [])} "
+            f"requests={len(rec.get('requests') or [])} "
+            f"dropped={sum(dropped.values())}"
+            + (f" watchdog={wd.get('reason', {}).get('kind', '?')}"
+               f"{' (in flight)' if wd.get('in_flight') else ''}"
+               if wd else "")
+            + (f" trace={os.path.basename(rec['trace_copied'])}"
+               if rec.get("trace_copied") else ""))
+    offsets = bundle.get("clock_offsets_s") or {}
+    if any(offsets.values()):
+        add("  clock offsets: "
+            + " ".join(f"w{w}={o * 1e3:+.1f}ms"
+                       for w, o in sorted(offsets.items())))
+    if bundle.get("missing_workers"):
+        add(f"  MISSING workers: {bundle['missing_workers']}")
+    if bundle.get("torn_files"):
+        add(f"  torn files: {bundle['torn_files']}")
+    timeline = bundle.get("timeline") or []
+    if timeline:
+        add(f"  timeline tail ({min(tail, len(timeline))} of "
+            f"{len(timeline)}):")
+        lines.extend(_timeline_line(e) for e in timeline[-tail:])
+    add("  root cause:")
+    for f in findings:
+        add(f"    {f}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path",
+                    help="bundle dir, assembled.json, or telemetry run "
+                         "dir (its latest bundle)")
+    ap.add_argument("--list", action="store_true",
+                    help="list the bundles under a run dir and exit")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="expected worker count (a smaller bundle "
+                         "fires P003 incomplete)")
+    ap.add_argument("--tail", type=int, default=12,
+                    help="timeline entries to render (default 12)")
+    ap.add_argument("--json", dest="json_out", action="store_true",
+                    help="emit {bundle, findings} as JSON")
+    args = ap.parse_args(argv)
+
+    from autodist_tpu.analysis.postmortem_audit import postmortem_audit
+    from autodist_tpu.telemetry.flight_recorder import (assemble_bundle,
+                                                        list_bundles,
+                                                        load_bundle)
+
+    if args.list:
+        bundles = list_bundles(args.path)
+        for b in bundles:
+            print(b)
+        if not bundles:
+            print(f"(no bundles under {args.path})", file=sys.stderr)
+            return 1
+        return 0
+
+    if os.path.isdir(args.path) and args.workers is not None and \
+            not os.path.exists(os.path.join(args.path, "assembled.json")):
+        bundle = assemble_bundle(args.path,
+                                 expected_workers=range(args.workers),
+                                 write=False)
+        if not bundle.get("workers") and not bundle.get("torn_files"):
+            bundle = None
+    else:
+        bundle = load_bundle(args.path)
+    if bundle is None:
+        print(f"(no postmortem bundle under {args.path})", file=sys.stderr)
+        return 1
+    findings = postmortem_audit(bundle,
+                                intended=bundle.get("intended"))
+    if args.json_out:
+        print(json.dumps({"bundle": bundle,
+                          "findings": [f.to_json() for f in findings]},
+                         indent=2, default=str))
+    else:
+        print(render_bundle(bundle, findings, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""CI gate: the postmortem tier works end to end on a CPU mesh (``make
+postmortem-check``, wired into ``make check``; docs/observability.md
+"Postmortem tier").
+
+Asserts the black-box acceptance contract without a real accelerator:
+
+1. **live NaN drill** — an :class:`~autodist_tpu.elastic.ElasticTrainer`
+   run with ``chaos='nan@2'`` and telemetry on must leave a
+   ``postmortem/anomaly_<step>/`` flight-recorder bundle whose P-code
+   root-cause audit fires P001 naming the injected worker (0, the live
+   process) and the first poisoned step, and the trainer must attach
+   the P-report of the dump it triggered
+   (``last_postmortem_report``);
+2. **operator views** — ``tools/postmortem.py`` reconstructs + renders
+   the bundle (root cause included) and ``tools/monitor.py
+   --postmortem`` lists it with its verdict;
+3. **fixture gates** — the golden assembled bundles under
+   ``tests/data/postmortem`` behave: the NaN-cascade fixture fires
+   P001 naming the seeded worker 1 / step 3, the stall fixture P002
+   naming the hung worker and culprit channel, and the clean preempt
+   fixture stays clean with its P005 table (the same checks
+   ``tools/verify_strategy.py --postmortem --selftest`` gates);
+4. **disabled gate** — with telemetry off, ``telemetry.flight()`` is
+   None: the hot path constructs no recorder and writes nothing (the
+   zero-overhead contract ``tests/test_flight_recorder.py`` pins).
+"""
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+# CPU mesh, no real accelerator needed — must precede any jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("AUTODIST_IS_TESTING", "True")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+FIXDIR = os.path.join(_REPO, "tests", "data", "postmortem")
+
+
+def _nan_drill(run_dir):
+    """The live drill: chaos='nan@2' with telemetry on; returns the
+    trainer (its dump/report attached) once the run drained."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import telemetry
+    from autodist_tpu.elastic import ElasticTrainer
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    r = np.random.RandomState(7)
+    params = {"w": jnp.asarray(r.randn(12, 3), jnp.float32)}
+
+    def batch_fn(step):
+        rr = np.random.RandomState(step)
+        return {"x": rr.randn(16, 12).astype(np.float32),
+                "y": rr.randn(16, 3).astype(np.float32)}
+
+    with tempfile.TemporaryDirectory() as ckpt:
+        telemetry.enable(run_dir=run_dir)
+        try:
+            trainer = ElasticTrainer(
+                ResourceSpec.from_num_chips(8), AllReduce(), loss, params,
+                optax.sgd(0.05), checkpoint_dir=ckpt, chaos="nan@2")
+            trainer.fit(batch_fn, steps=4)
+        finally:
+            telemetry.disable()
+            telemetry._STATE["run_dir"] = None
+    return trainer
+
+
+def main():
+    from autodist_tpu import telemetry
+    from autodist_tpu.analysis.postmortem_audit import (audit_fixture,
+                                                        postmortem_audit)
+    from autodist_tpu.telemetry.flight_recorder import (list_bundles,
+                                                        load_bundle)
+    from tools import monitor, postmortem
+
+    t0 = time.monotonic()
+    problems = []
+    run_dir = tempfile.mkdtemp(prefix="postmortem_check_")
+
+    # 1. the live NaN drill leaves a root-caused bundle
+    trainer = _nan_drill(run_dir)
+    anomaly = [b for b in list_bundles(run_dir)
+               if os.path.basename(b).startswith("anomaly")]
+    p001 = None
+    if not anomaly:
+        problems.append(f"no anomaly bundle under {run_dir}")
+    else:
+        bundle = load_bundle(anomaly[-1])
+        p001 = next((f for f in postmortem_audit(bundle)
+                     if f.code == "P001"), None)
+        if p001 is None:
+            problems.append("P001 did not fire on the live NaN bundle")
+        elif p001.data.get("worker") != 0 or \
+                not isinstance(p001.data.get("step"), int):
+            problems.append(f"P001 named the wrong worker/step: "
+                            f"{p001.data}")
+    rep = trainer.last_postmortem_report
+    if rep is None or "P001" not in {f.code for f in rep.findings}:
+        problems.append("trainer did not attach the P-report of the "
+                        "dump it triggered")
+
+    # 2. the operator views reconstruct the same bundle
+    if anomaly:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = postmortem.main([anomaly[-1]])
+        if rc != 0 or "P001" not in buf.getvalue():
+            problems.append(f"tools/postmortem.py render failed (rc {rc})")
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = monitor.main([run_dir, "--postmortem"])
+        if rc != 0 or "anomaly" not in buf.getvalue():
+            problems.append(f"monitor --postmortem failed (rc {rc})")
+
+    # 3. the golden fixture gates (the --selftest contract)
+    checks = (
+        ("nan_cascade.json", "P001",
+         lambda f: f.data.get("worker") == 1 and f.data.get("step") == 3),
+        ("stall.json", "P002",
+         lambda f: f.data.get("culprit_channel") is not None),
+        ("clean.json", None, None),
+    )
+    for fname, want, ok in checks:
+        findings = audit_fixture(os.path.join(FIXDIR, fname))
+        codes = {f.code for f in findings}
+        if want is not None:
+            hit = next((f for f in findings if f.code == want), None)
+            if hit is None or not ok(hit):
+                problems.append(f"fixture {fname}: expected {want} "
+                                f"naming its seeded subject "
+                                f"(got {sorted(codes)})")
+        elif codes & {"P001", "P002", "P003", "P004"} or "P005" not in codes:
+            problems.append(f"fixture {fname}: expected a clean P005 "
+                            f"(got {sorted(codes)})")
+
+    # 4. the disabled gate: no recorder exists off the telemetry path
+    if telemetry.flight() is not None:
+        problems.append("telemetry.flight() returned a recorder while "
+                        "disabled — the zero-overhead gate is broken")
+
+    if problems:
+        print(f"FAIL: {run_dir}")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"OK: live nan drill dumped {os.path.basename(anomaly[-1])} "
+          f"with P001 naming worker {p001.data['worker']} step "
+          f"{p001.data['step']}; operator views render; fixture gates "
+          f"hold; disabled gate returns None "
+          f"({time.monotonic() - t0:.1f}s)")
+    print(json.dumps({"bundle": anomaly[-1], "p001": p001.data,
+                      "trainer_flagged": sorted(
+                          {f.code for f in rep.findings
+                           if f.code.startswith('P00')})},
+                     indent=1, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

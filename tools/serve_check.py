@@ -1,5 +1,5 @@
 """CI gate: a live CPU-mesh continuous-batching serving run must match
-``generate()`` bit for bit and leave a schema-v4 manifest a clean Q-code
+``generate()`` bit for bit and leave a schema-v5 manifest a clean Q-code
 audit accepts (``make serve-check``, wired into ``make check``).
 
 Asserts the serving tier's acceptance contract end-to-end:
@@ -10,7 +10,7 @@ Asserts the serving tier's acceptance contract end-to-end:
    bit-match the static ``generate()`` rollout at temperature 0;
 2. a second, disaggregated run (prefill device subset) bit-matches too,
    with KV handoff bytes actually counted;
-3. the finalized manifest validates as schema v4 and its summary's
+3. the finalized manifest validates as schema v5 and its summary's
    ``serving`` block carries tokens/sec, TTFT, and slot-occupancy;
 4. the serving audit over that manifest — with the decode step's
    realized collectives extracted from the live engine's lowering — is
@@ -117,7 +117,7 @@ def main():
     if eng2.finished() and not eng2.kv_handoff_bytes:
         problems.append("disaggregated prefill counted no KV handoff bytes")
 
-    # -- 3. the manifest is schema v4 with the serving metrics -------------
+    # -- 3. the manifest is schema v5 with the serving metrics -------------
     records, errors = validate_manifest(manifest)
     for e in errors:
         problems.append(f"manifest: {e}")

@@ -14,6 +14,10 @@ absent; schema in ``autodist_tpu/telemetry/schema.py``) and reports:
 - predicted comm/compute overlap from the recorded cost estimate next
   to the measured walls (predicted-vs-measured error),
 - async-PS staleness counters and watchdog captures when present,
+- the serving block when the manifest came from the decode tier
+  (tokens/sec, TTFT/latency percentiles) including the schema-v5 TTFT
+  phase breakdown — queue -> prefill -> handoff -> first decode — so
+  the dominant phase a Q003 breach names is visible at a glance,
 - with ``--audit <report.json>`` (the ``tools/verify_strategy.py --hlo
   --json`` output, or an ``AutoStrategy.last_audit`` dump): the HLO
   communication audit's INTENDED vs REALIZED wire bytes per phase, next
@@ -180,6 +184,10 @@ def summarize_manifest(records, stats=None):
     for s in summaries:
         if s.get("health"):
             out["health"] = s["health"]
+    # the serving block (decode-tier manifests), surfaced from any summary
+    for s in summaries:
+        if s.get("serving"):
+            out["serving"] = s["serving"]
     return out
 
 
@@ -254,6 +262,23 @@ def render(summary):
         add("health: " + ", ".join(
             f"{k}={v}" for k, v in sorted(health["counts"].items()))
             + " (details with --health)")
+    serving = summary.get("serving") or {}
+    if serving:
+        add(f"serving: {serving.get('requests', 0)} request(s), "
+            f"{serving.get('tokens_per_s', 0.0):.1f} tok/s, "
+            f"TTFT p99 {_fmt_s(serving.get('ttft_p99_s'))}, "
+            f"latency p99 {_fmt_s(serving.get('latency_p99_s'))}, "
+            f"occupancy {serving.get('occupancy_mean', 0.0):.0%}")
+        phases = serving.get("ttft_phases") or {}
+        parts = []
+        for key in ("queue_s", "prefill_s", "handoff_s",
+                    "first_decode_s"):
+            p = phases.get(key)
+            if isinstance(p, dict):
+                parts.append(f"{key[:-2]} {_fmt_s(p.get('mean'))}")
+        if parts:
+            add("  TTFT phases (mean): " + " -> ".join(parts)
+                + " — the dominant phase is what a Q003 breach names")
     return "\n".join(lines)
 
 

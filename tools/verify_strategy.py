@@ -50,13 +50,24 @@ Targets:
   ``tests/data/events`` must fire E001 on the unacted log and E002 on
   the slow-MTTR log while the control stays clean.
 - ``--serving [METRICS_JSON]`` — run the SERVING tier (Q-codes) over a
-  decode service's telemetry (a finalized schema-v4 manifest whose
+  decode service's telemetry (a finalized schema-v5 manifest whose
   summary carries the ``serving`` block, or a bare serving-metrics
   JSON): exposed decode comm over the interconnect budget is Q001,
   slot-occupancy collapse Q002, TTFT p99 over budget Q003 — and every
   audited run must emit its Q004 serving table; with ``--selftest``,
   the seeded over-budget decode case must fire Q001 while the clean
   case emits Q004 only.
+- ``--postmortem [BUNDLE]`` — run the ROOT-CAUSE tier (P-codes) over a
+  flight-recorder bundle (a ``postmortem/<trigger>_<step>/`` dump dir,
+  its ``assembled.json``, or a telemetry run dir whose latest bundle
+  is taken): the first poisoned worker/step/tensor of a nonfinite
+  cascade is P001, the stall window + culprit collective of a hang
+  death P002, a torn/incomplete bundle P003, a signal the control
+  plane never acted on before death P004 — and every audited bundle
+  must emit its P005 bundle table; with ``--selftest``, the golden
+  fixtures under ``tests/data/postmortem`` must fire P001 naming the
+  injected worker/step on the NaN-cascade bundle and P002 on the
+  stall bundle while the control stays clean.
 - ``--runtime [TRACE_DIR]`` — run the RUNTIME audit tier (T-codes): a
   ``jax.profiler`` chrome-trace capture is parsed, its collective
   events matched against the strategy's intended channel table, and
@@ -187,11 +198,19 @@ def main(argv=None):
     ap.add_argument("--serving", nargs="?", const="", default=None,
                     metavar="METRICS_JSON",
                     help="also run the SERVING tier (Q-codes) over a "
-                         "decode service's telemetry (a schema-v4 "
+                         "decode service's telemetry (a schema-v5 "
                          "manifest or a serving-metrics JSON): exposed "
                          "decode comm is Q001, occupancy collapse Q002, "
                          "TTFT p99 Q003; every audited run must emit "
                          "its Q004 serving table")
+    ap.add_argument("--postmortem", nargs="?", const="", default=None,
+                    metavar="BUNDLE",
+                    help="also run the ROOT-CAUSE tier (P-codes) over a "
+                         "flight-recorder bundle (a dump dir, an "
+                         "assembled JSON, or a run dir's latest "
+                         "bundle): first poisoned worker of a NaN "
+                         "cascade is P001, a stall death P002; every "
+                         "audited bundle must emit its P005 table")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write all reports as JSON to this path")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -200,6 +219,7 @@ def main(argv=None):
 
     _force_cpu_devices()
     from autodist_tpu.analysis import (EVENT_PASSES, LOWERED_PASSES,
+                                       POSTMORTEM_PASSES,
                                        REGRESSION_PASSES, RUNTIME_PASSES,
                                        SERVING_PASSES, STATIC_PASSES,
                                        TRACE_PASSES, verify_strategy)
@@ -250,6 +270,10 @@ def main(argv=None):
         base = passes if passes is not None else \
             STATIC_PASSES + TRACE_PASSES
         passes = base + SERVING_PASSES
+    if args.postmortem is not None:
+        base = passes if passes is not None else \
+            STATIC_PASSES + TRACE_PASSES
+        passes = base + POSTMORTEM_PASSES
     trace_dir = args.runtime or None
     event_records = None
     if args.events:
@@ -271,6 +295,18 @@ def main(argv=None):
     # with the serving tier selected, every audited target must produce
     # its machine-readable Q004 serving table
     want_q004 = bool(passes) and "serving-audit" in passes
+    # with the root-cause tier selected, every audited bundle must
+    # produce its machine-readable P005 bundle table
+    want_p005 = bool(passes) and "postmortem-audit" in passes
+    postmortem_bundle = None
+    if args.postmortem:
+        from autodist_tpu.telemetry.flight_recorder import load_bundle
+
+        postmortem_bundle = load_bundle(args.postmortem)
+        if postmortem_bundle is None:
+            ap.error(f"--postmortem {args.postmortem}: no bundle found "
+                     f"(expected a postmortem dump dir, an assembled "
+                     f"JSON, or a run dir holding bundles)")
     serving_metrics = None
     if args.serving:
         from autodist_tpu.analysis.serving_audit import load_metrics
@@ -278,7 +314,7 @@ def main(argv=None):
         serving_metrics = load_metrics(args.serving)
         if serving_metrics is None:
             ap.error(f"--serving {args.serving}: no serving metrics "
-                     f"found (expected a schema-v4 manifest with a "
+                     f"found (expected a schema-v5 manifest with a "
                      f"summary 'serving' block, or a metrics JSON)")
     results = {}
     failed = False
@@ -318,6 +354,25 @@ def main(argv=None):
                   f"audit produced no Q004 table")
             failed = True
 
+    if args.postmortem:
+        # a standalone bundle target: root-cause the black box itself,
+        # with or without record targets alongside
+        from autodist_tpu.analysis.postmortem_audit import postmortem_audit
+        from autodist_tpu.analysis.report import Report
+
+        findings = postmortem_audit(
+            postmortem_bundle, intended=postmortem_bundle.get("intended"))
+        report = Report(strategy_id="postmortem")
+        report.extend(findings)
+        results[args.postmortem] = report
+        _print_report(os.path.basename(args.postmortem), report,
+                      args.verbose)
+        failed = failed or not report.ok
+        if not any(f.code == "P005" for f in findings):
+            print(f"[ERROR] {os.path.basename(args.postmortem)}: "
+                  f"postmortem audit produced no P005 table")
+            failed = True
+
     for path in args.targets:
         try:
             with open(path) as f:
@@ -345,10 +400,19 @@ def main(argv=None):
             case["current_metrics"] = {"name": stem}
         report = verify_strategy(passes=passes, trace_dir=trace_dir,
                                  event_records=event_records,
-                                 serving_metrics=serving_metrics, **case)
+                                 serving_metrics=serving_metrics,
+                                 postmortem_bundle=postmortem_bundle,
+                                 **case)
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
         failed = failed or not report.ok
+        if want_p005:
+            p5 = next((f for f in report.findings if f.code == "P005"),
+                      None)
+            if p5 is None and postmortem_bundle is not None:
+                print(f"[ERROR] {os.path.basename(path)}: postmortem "
+                      f"audit produced no P005 table")
+                failed = True
         if want_q004:
             q4 = next((f for f in report.findings if f.code == "Q004"),
                       None)
@@ -592,6 +656,54 @@ def main(argv=None):
                     else:
                         print("serving selftest passed: the control "
                               "emits Q004 only")
+        if args.postmortem is not None:
+            # the golden bundle fixtures (tests/data/postmortem): the
+            # seeded NaN-cascade bundle must fire P001 naming the
+            # injected worker (w1) and step (3), the stall bundle P002
+            # naming the hung worker, and the clean preempt bundle must
+            # stay clean with its P005 table
+            from autodist_tpu.analysis.postmortem_audit import \
+                audit_fixture as postmortem_fixture
+            from autodist_tpu.analysis.report import Report
+
+            fixdir = os.path.join(REPO, "tests", "data", "postmortem")
+            checks = (
+                ("nan-cascade", "nan_cascade.json", "P001"),
+                ("stall", "stall.json", "P002"),
+                ("control", "clean.json", None),
+            )
+            for label, fname, want in checks:
+                findings = postmortem_fixture(os.path.join(fixdir, fname))
+                report = Report()
+                report.extend(findings)
+                results[f"<postmortem-{label}-selftest>"] = report
+                _print_report(f"postmortem selftest ({label})", report,
+                              args.verbose)
+                codes = {f.code for f in findings}
+                if want is not None:
+                    bad = want not in codes
+                    if not bad and want == "P001":
+                        p1 = next(f for f in findings if f.code == "P001")
+                        bad = (p1.data.get("worker") != 1
+                               or p1.data.get("step") != 3)
+                    if bad:
+                        print(f"[ERROR] postmortem selftest ({label}): "
+                              f"expected {want} naming the injected "
+                              f"worker did not fire (got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print(f"postmortem selftest passed: the {label} "
+                              f"fixture fires {want}")
+                else:
+                    bad = codes & {"P001", "P002", "P003", "P004"}
+                    if bad or "P005" not in codes:
+                        print(f"[ERROR] postmortem selftest (control): "
+                              f"expected a clean P005 "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print("postmortem selftest passed: the control "
+                              "stays clean with its P005 table")
         if args.runtime is not None:
             # the golden trace fixtures (tests/data/trace): the
             # exposed-comm step must be caught as T001, the skewed
