@@ -53,6 +53,12 @@ aot-overlap:
 aot-gpt-levers:
 	$(PY) tools/aot_gpt_levers.py
 
+# EQuARX fused-hop lever proof: the Pallas kernel's deviceless Mosaic
+# compile for v5e + the cost model's DCN-bottleneck step-time delta vs
+# the unfused int8 pattern; writes records/v5e_aot/equarx_lever.json
+aot-equarx:
+	$(PY) tools/aot_equarx.py
+
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m compileall -q autodist_tpu tests examples
@@ -69,14 +75,18 @@ verify:
 # communication audit (X-codes: an implicit-reshard all_to_all or a
 # dropped sync collective fails the gate; the seeded reshard case must
 # be caught as X001) and the compute audit (F-codes: every target must
-# emit its F006 FLOP table with zero F001 realized-FLOP blowups; the
-# seeded remat case must be caught as F002, the seeded dropped-donation
-# case as F004)
+# emit its F006 FLOP table with zero F001 realized-FLOP blowups AND a
+# precision-aware contraction_flops_by_dtype table that reconciles
+# against realized FLOPs — bf16 contractions counted exactly once, no
+# double-count against jaxpr_flops; the seeded remat case must be
+# caught as F002, the seeded all-f32 case as F003, the seeded
+# dropped-donation case as F004, and --suggest must map each to its
+# documented strategy/engine delta)
 audit:
 	$(PY) tools/verify_strategy.py --hlo records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --hlo --selftest
 	$(PY) tools/verify_strategy.py --compute records/cpu_mesh/*.json
-	$(PY) tools/verify_strategy.py --compute --selftest
+	$(PY) tools/verify_strategy.py --compute --suggest --selftest
 
 # live telemetry gate (docs/observability.md): a 5-step CPU-mesh session
 # with telemetry on must emit a schema-valid JSONL manifest with per-step

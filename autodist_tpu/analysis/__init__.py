@@ -43,5 +43,8 @@ from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,  # noqa:
                                           REGRESSION_PASSES, RUNTIME_PASSES,
                                           SERVING_PASSES, STATIC_PASSES,
                                           TRACE_PASSES)
+from autodist_tpu.analysis.remediation import (Remediation,  # noqa: F401
+                                               format_suggestions,
+                                               suggest_remediations)
 from autodist_tpu.analysis.verify import (AnalysisContext, verify_strategy,  # noqa: F401
                                           verify_transformer)

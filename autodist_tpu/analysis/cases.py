@@ -24,6 +24,11 @@ EXPECTED_AUDIT_ERROR_CODE = "X001"
 # donation check
 EXPECTED_RECOMPUTE_CODE = "F002"
 EXPECTED_DONATION_CODE = "F004"
+# the all-f32 case (build_f32_contraction_case) is clean under every
+# other pass and caught ONLY by the compute audit's precision check as
+# this code; tools/verify_strategy.py --suggest must map it to the
+# AllReduce(precision="bf16_master") strategy delta
+EXPECTED_PRECISION_CODE = "F003"
 
 
 def build_rejected_case(num_chips=8):
@@ -158,6 +163,52 @@ def build_recompute_case(num_chips=8):
         model_item=item,
         resource_spec=spec,
         batch_shapes={"x": ((num_chips * 16, d), "float32")},
+        hbm_bytes_per_device=16 * 1024 ** 3,
+    )
+
+
+def build_f32_contraction_case(num_chips=8):
+    """The seeded F32-CONTRACTION case for the HLO compute audit's
+    precision check (``tools/verify_strategy.py --compute --selftest``
+    and the ``--suggest`` remediation loop).
+
+    A plain MLP trained entirely in f32 — no remat (each dot's
+    signature is unique, so no F002), donations all realize (no F004),
+    and the batch is large enough that contraction FLOPs dominate the
+    optimizer epilogue (no F005) and clear ``BF16_MIN_FLOPS``.  The
+    MXU would run these contractions ~2x faster under a master-weight
+    policy, which ONLY the precision check sees: ``F003``
+    (:data:`EXPECTED_PRECISION_CODE`), whose remediation is the
+    ``AllReduce(precision="bf16_master")`` strategy delta
+    (:mod:`autodist_tpu.analysis.remediation`).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    # asymmetric widths: every dot (fwd and its bwd transposes) has a
+    # unique signature, so the duplicated-signature detector stays quiet
+    d_in, d_h, d_out = 256, 320, 192
+    params = {"w1": jnp.zeros((d_in, d_h)), "w2": jnp.zeros((d_h, d_out))}
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w1"])     # all-f32 contractions:
+        y = jnp.tanh(h @ p["w2"])              # the F003 bait
+        return jnp.mean(jnp.square(y)) + 1e-6 * sum(
+            jnp.sum(jnp.square(x)) for x in jax.tree.leaves(p))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 16, d_in), "float32")},
         hbm_bytes_per_device=16 * 1024 ** 3,
     )
 

@@ -332,9 +332,19 @@ def audit_compute(ops: List[ComputeOp], *, model_flops=None,
             f"~{_fmt_bytes(hbm_saved)} of HBM residuals (remat "
             f"multiplicity, or repeated identical unrolled blocks)", sig))
 
-    # F003: f32 contractions a master-weight policy would run on bf16
+    # F003: f32 contractions a master-weight policy would run on bf16.
+    # Precision-aware counting: every contraction lands in exactly ONE
+    # dtype bucket (a bf16-master lowering's bf16 dots are counted as
+    # bf16, never double-counted back into the f32 volume), so the
+    # by-dtype totals reconcile with ``realized`` exactly — the ``make
+    # audit`` reconciliation line asserts this on every record.
+    by_dtype = {}
+    for op in contractions:
+        dt = op.dtype or "unknown"
+        by_dtype[dt] = by_dtype.get(dt, 0.0) + op.total_flops
     f32_ops = [op for op in contractions if op.dtype == "f32"]
-    f32_flops = sum(op.total_flops for op in f32_ops)
+    f32_flops = by_dtype.get("f32", 0.0)
+    f32_frac = (f32_flops / realized) if realized else 0.0
     if f32_flops >= BF16_MIN_FLOPS:
         findings.append(_f(
             Severity.WARNING, "F003",
@@ -358,6 +368,15 @@ def audit_compute(ops: List[ComputeOp], *, model_flops=None,
 
     ceiling = predicted_mfu_ceiling(model_flops or realized, realized,
                                     mxu_eff=eff)
+    # the precision-aware ceiling additionally prices the MXU's f32
+    # contraction slowdown (cost_model.F32_CONTRACTION_SLOWDOWN): an
+    # all-f32 lowering halves its ceiling, a bf16-master lowering keeps
+    # it — the ``--suggest`` F003 remediation quantifies the gap.  The
+    # plain ``predicted_mfu_ceiling`` key stays frac-free so blessed
+    # baselines and the R004 gate keep their meaning across records.
+    ceiling_prec = predicted_mfu_ceiling(model_flops or realized, realized,
+                                         mxu_eff=eff,
+                                         f32_contraction_frac=f32_frac)
     data = {
         "model_flops": round(float(model_flops), 1) if model_flops else None,
         "realized_flops": round(realized, 1),
@@ -365,10 +384,14 @@ def audit_compute(ops: List[ComputeOp], *, model_flops=None,
         "elementwise_flops": round(elementwise, 1),
         "elementwise_share": round(share, 4),
         "f32_contraction_flops": round(f32_flops, 1),
+        "f32_contraction_frac": round(f32_frac, 4),
+        "contraction_flops_by_dtype": {
+            k: round(v, 1) for k, v in sorted(by_dtype.items())},
         "per_class": {k: round(v, 1) for k, v in sorted(per_class.items())},
         "per_region": {k: round(v, 1) for k, v in sorted(per_region.items())},
         "recompute": recompute,
         "predicted_mfu_ceiling": round(ceiling, 4),
+        "predicted_mfu_ceiling_precision": round(ceiling_prec, 4),
         "mxu_eff": eff,
         "n_ops": len(ops),
         "n_contractions": len(contractions),

@@ -1,0 +1,156 @@
+"""F-code-driven remediation: verify findings -> strategy/engine deltas.
+
+The lowered-tier compute audit (:mod:`compute_audit`) names what the
+lowering wastes — f32 contractions the MXU would run 2x faster on bf16
+(F003), recompute paying FLOPs for HBM the budget may not need back
+(F002), donations that silently became full per-step copies (F004).
+This module closes the loop: :func:`suggest_remediations` consumes a
+verify :class:`~autodist_tpu.analysis.report.Report` and emits concrete,
+machine-readable deltas — the builder kwargs or ``distribute()`` knobs
+that remove each waste — so ``tools/verify_strategy.py --suggest`` (and
+an AutoSync-style outer loop) can move from *detecting* a ceiling to
+*lifting* it.
+
+Each delta quantifies its expected gain from the finding's own data
+where the audit measured one (the F006 table's precision-aware ceiling
+gap for F003, the FLOPs-paid/HBM-saved trade for F002, the copied
+buffer's traffic for F004).
+"""
+import dataclasses
+from typing import List, Optional
+
+# finding codes this module knows how to remediate, in the order the
+# suggestions are emitted (compute levers first — they move the MFU
+# ceiling — then the memory/donation repairs)
+REMEDIABLE_CODES = ("F003", "F002", "F004")
+
+
+@dataclasses.dataclass
+class Remediation:
+    """One concrete delta removing one audited waste.
+
+    ``kind`` says where the knob lives: ``"strategy"`` deltas are
+    builder kwargs (re-build the strategy with them), ``"engine"``
+    deltas are :meth:`AutoDist.distribute` kwargs, ``"model"`` deltas
+    need a source change the engine cannot apply (named in ``message``).
+    """
+
+    code: str          # the finding code that triggered this delta
+    kind: str          # "strategy" | "engine" | "model"
+    action: str        # human-oriented delta, e.g. AllReduce(precision=...)
+    knob: dict         # machine-readable kwargs delta for `kind`'s target
+    message: str       # why, with the audit's numbers
+    expected_gain: str = ""
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+
+def _f006(report):
+    return next((f.data for f in report.findings
+                 if f.code == "F006" and f.data), None)
+
+
+def _fmt_flops(f):
+    from autodist_tpu.analysis.compute_audit import _fmt_flops as fmt
+
+    return fmt(f)
+
+
+def _remediate_f003(finding, table) -> Remediation:
+    """f32 contractions -> the bf16-master precision knob.
+
+    The gain is the F006 table's precision-aware ceiling gap when the
+    table rode the same lowering: ``predicted_mfu_ceiling_precision``
+    prices the f32 contraction slowdown the plain ceiling ignores, so
+    the delta between the two IS what the knob buys back."""
+    gain = ""
+    if table:
+        plain = table.get("predicted_mfu_ceiling")
+        prec = table.get("predicted_mfu_ceiling_precision")
+        if plain is not None and prec is not None and prec < plain:
+            gain = (f"predicted MFU ceiling {prec:.3f} -> {plain:.3f} "
+                    f"once the contractions run bf16")
+        frac = table.get("f32_contraction_frac")
+        if frac and not gain:
+            gain = f"{frac:.0%} of contraction FLOPs move to the 2x path"
+    return Remediation(
+        code="F003", kind="strategy",
+        action='AllReduce(precision="bf16_master")',
+        knob={"precision": "bf16_master"},
+        message=(finding.message + " — the bf16-master strategy knob "
+                 "keeps the f32 master in the sharded-update flat shard "
+                 "and gathers bf16 compute params (half the param-gather "
+                 "wire; the upcast happens only at the update boundary)"),
+        expected_gain=gain)
+
+
+def _remediate_f002(finding, table) -> Remediation:
+    """Recompute -> relax the remat policy (when HBM headroom allows).
+
+    The FLOPs-paid/HBM-saved trade lives in the F006 table's
+    ``recompute`` groups (the F002 finding itself is prose); the gain
+    quotes the total across groups."""
+    groups = (table or {}).get("recompute") or []
+    paid = sum(g.get("flops_paid", 0.0) for g in groups)
+    saved = sum(g.get("hbm_saved_bytes", 0.0) for g in groups)
+    gain = ""
+    if paid:
+        gain = (f"stop paying {_fmt_flops(paid)}/step for "
+                f"~{saved / 1e6:.1f} MB of residuals")
+    return Remediation(
+        code="F002", kind="engine",
+        action="distribute(..., remat=False)",
+        knob={"remat": False},
+        message=(finding.message + " — if the H-code footprint shows "
+                 "headroom, drop the remat policy (or narrow jax."
+                 "checkpoint to the attention block) and keep the "
+                 "residuals resident"),
+        expected_gain=gain)
+
+
+def _remediate_f004(finding) -> Remediation:
+    """Dropped donation -> dtype-match the state update so the alias
+    can realize (donation itself stays on)."""
+    return Remediation(
+        code="F004", kind="model",
+        action="update state in its storage dtype; keep donate=True",
+        knob={"donate": True},
+        message=(finding.message + " — XLA's input_output_alias needs "
+                 "matching shape+dtype: cast the state update back to "
+                 "its storage dtype (e.g. keep f32 EMA slots updated in "
+                 "f32) so the donated buffer aliases instead of copying "
+                 "every step"),
+        expected_gain="removes one full state-buffer copy per step")
+
+
+def suggest_remediations(report) -> List["Remediation"]:
+    """Map a verify/audit :class:`Report`'s F-code findings to concrete
+    strategy/engine deltas.  Dedups by code (one delta per waste class —
+    F002 keeps the largest recompute group's numbers) and orders them
+    by :data:`REMEDIABLE_CODES`."""
+    table = _f006(report)
+    by_code = {}
+    for f in report.findings:
+        if f.code == "F003" and "F003" not in by_code:
+            by_code["F003"] = _remediate_f003(f, table)
+        elif f.code == "F002" and "F002" not in by_code:
+            by_code["F002"] = _remediate_f002(f, table)
+        elif f.code == "F004" and "F004" not in by_code:
+            by_code["F004"] = _remediate_f004(f)
+    return [by_code[c] for c in REMEDIABLE_CODES if c in by_code]
+
+
+def format_suggestions(rems: List[Remediation],
+                       prefix: str = "    ") -> Optional[str]:
+    """Render the deltas for the CLI (None when there is nothing to
+    suggest)."""
+    if not rems:
+        return None
+    lines = []
+    for r in rems:
+        line = f"{prefix}[{r.code} -> {r.kind}] {r.action}"
+        if r.expected_gain:
+            line += f"  ({r.expected_gain})"
+        lines.append(line)
+    return "\n".join(lines)

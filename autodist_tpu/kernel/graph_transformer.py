@@ -205,6 +205,24 @@ class GraphTransformer:
                         "wire codec is not elementwise; realizing the "
                         "replicated update", name)
                 plan.sharded_update = 0
+        # -- bf16-compute / f32-master mixed precision (Precision) ---------
+        # The f32 master IS the flat 1/R sharded-update shard (storage ==
+        # update space); the full-shape param exists only as a transient
+        # bf16 compute copy gathered per bucket at the top of the step.
+        # Eligibility therefore piggybacks on the sharded update: f32
+        # dtype + a realized sharded update; everything else (non-f32
+        # dtypes, block codecs, sparse, synthesized IR) keeps full F32.
+        for name in self.names:
+            plan = self.plans[name]
+            if not getattr(plan, "precision", 0):
+                continue
+            if not part.master_shard_storage(plan):
+                logging.debug(
+                    "Variable %s: precision=BF16_COMPUTE_F32_MASTER "
+                    "requested but the plan is not eligible (needs f32 "
+                    "dtype and a realized sharded update); keeping F32",
+                    name)
+                plan.precision = 0
         shapes = {v.name: v.shape for v in model_item.var_infos}
         dtypes = {v.name: v.dtype for v in model_item.var_infos}
         self.buckets = ar_sync.plan_buckets(self.plans, shapes, dtypes,
@@ -216,6 +234,13 @@ class GraphTransformer:
         self._shard_of = {
             n: (b, ss) for b in self.sharded_buckets
             for n, ss in zip(b.var_names, b.shard_sizes)}
+        # bf16-master buckets: storage is the flat f32 master shard; the
+        # compute copy gathers in bf16 at the top of the step and the
+        # grads upcast to f32 right after value_and_grad
+        self.precision_buckets = [b for b in self.sharded_buckets
+                                  if b.precision]
+        self._prec_names = frozenset(
+            n for b in self.precision_buckets for n in b.var_names)
         # collective issue schedule: "overlap" = per-bucket reverse-
         # topological collectives under XLA's latency-hiding scheduler
         # (kernel/synchronization/all_reduce.sync_overlapped); "barrier" =
@@ -307,6 +332,12 @@ class GraphTransformer:
         weight update (reduce-scatter -> shard update -> param gather)."""
         return bool(self.sharded_buckets)
 
+    @property
+    def sync_mixed_precision(self):
+        """``True`` when any AR bucket runs bf16-compute / f32-master
+        mixed precision (the F003 lever)."""
+        return bool(self.precision_buckets)
+
     def sharded_update_summary(self):
         """Static accounting of the sharded weight update — what telemetry
         records (``sync.sharded_update``) and reports render next to the
@@ -325,13 +356,19 @@ class GraphTransformer:
                "num_shards": (self.sharded_buckets[0].num_shards
                               if self.sharded_buckets else 1),
                "shard_bytes": 0.0, "padding_bytes": 0.0,
-               "param_gather_bytes": 0.0}
+               "param_gather_bytes": 0.0,
+               "bf16_master_buckets": len(self.precision_buckets),
+               "bf16_master_vars": sum(len(b.var_names)
+                                       for b in self.precision_buckets)}
         for b in self.sharded_buckets:
             item = _np.dtype(b.dtype).itemsize
             out["shard_bytes"] += b.shard_total * item
             out["padding_bytes"] += \
                 (b.padded_total - b.total) * item / b.num_shards
-            out["param_gather_bytes"] += b.padded_total * item
+            # bf16-master buckets gather the COMPUTE copy at bf16 — half
+            # the fresh-param wire of the f32 gather
+            out["param_gather_bytes"] += \
+                b.padded_total * item * (0.5 if b.precision else 1.0)
         return out
 
     def hierarchy_summary(self):
@@ -395,19 +432,24 @@ class GraphTransformer:
                         if name not in out["dcn_compressors"]:
                             out["dcn_compressors"].append(name)
                 continue
+            # the fresh-param gather leg of a sharded bucket is native
+            # dtype — except bf16-master buckets, whose compute copy
+            # gathers at bf16 (half the f32 wire)
+            pg = 0.5 if getattr(b, "precision", 0) else 1.0
             if b.hierarchy == _AR.TWO_LEVEL:
                 d = ar_sync.dcn_codec(b)
                 dcn_f = wire_byte_factor(d, b.total)
-                out["ici_hop_bytes"] += 2.0 * pbytes
+                out["ici_hop_bytes"] += \
+                    (1.0 + pg) * pbytes if sharded else 2.0 * pbytes
                 out["dcn_hop_bytes"] += \
-                    pbytes * ((dcn_f + 1.0) if sharded else dcn_f) \
+                    pbytes * ((dcn_f + pg) if sharded else dcn_f) \
                     / max(1, R_ici)
                 name = get_compressor(d).name if d else "none"
                 if name not in out["dcn_compressors"]:
                     out["dcn_compressors"].append(name)
             elif sharded:
                 wf = wire_byte_factor(ar_sync.wire_codec(b), b.total)
-                out["flat_bytes"] += pbytes * (wf + 1.0) / 2.0
+                out["flat_bytes"] += pbytes * (wf + pg) / 2.0
             else:
                 out["flat_bytes"] += \
                     nbytes * wire_byte_factor(b.compressor, b.total)
@@ -471,6 +513,10 @@ class GraphTransformer:
                 # there is no gradient all-gather at all
                 pbytes = b.padded_total * item
                 wf = wire_byte_factor(ar_sync.wire_codec(b), b.total)
+                # bf16-master buckets gather the bf16 COMPUTE copy (at the
+                # top of the step instead of post-update) — half the
+                # fresh-param wire of the f32 gather, same channel shape
+                pg = 0.5 if getattr(b, "precision", 0) else 1.0
                 if b.hierarchy == _AR.TWO_LEVEL:
                     shard_b = pbytes / max(1, R_ici)
                     add(f"{b.key}/ici-scatter", ("reduce_scatter",),
@@ -478,14 +524,14 @@ class GraphTransformer:
                     add(f"{b.key}/dcn-scatter", ("reduce_scatter",),
                         shard_b * wf * mult, "dcn_hop", (R_dcn,), in_scan)
                     add(f"{b.key}/dcn-param-gather", ("all_gather",),
-                        shard_b, "dcn_hop", (R_dcn,))
+                        shard_b * pg, "dcn_hop", (R_dcn,))
                     add(f"{b.key}/ici-param-gather", ("all_gather",),
-                        pbytes, "ici_hop", (R_ici,))
+                        pbytes * pg, "ici_hop", (R_ici,))
                 else:
                     add(f"{b.key}/shard-scatter", ("reduce_scatter",),
                         pbytes * wf * mult, "flat", (R,), in_scan)
                     add(f"{b.key}/param-gather", ("all_gather",),
-                        pbytes, "flat", (R,))
+                        pbytes * pg, "flat", (R,))
                 continue
             if b.schedule_ir:
                 # synthesized schedule: one channel per IR phase, volumes
@@ -516,7 +562,8 @@ class GraphTransformer:
                             2.0 * (g - 1) * piece * item * wf * mult,
                             phase, (), in_scan)
                     elif ph.codec in (_AR.Int8Compressor,
-                                      _AR.Int8CompressorEF):
+                                      _AR.Int8CompressorEF,
+                                      _AR.EquarxInt8Compressor):
                         add(f"{b.key}/p{i}-int8",
                             ("all_to_all", "all_gather"),
                             int8_bytes(elems, g) * mult, phase, (g,),
@@ -531,7 +578,8 @@ class GraphTransformer:
                 add(f"{b.key}/ici-scatter", ("reduce_scatter",),
                     padded * mult, "ici_hop", (R_ici,), in_scan)
                 d = ar_sync.dcn_codec(b)
-                if d in (_AR.Int8Compressor, _AR.Int8CompressorEF):
+                if d in (_AR.Int8Compressor, _AR.Int8CompressorEF,
+                         _AR.EquarxInt8Compressor):
                     add(f"{b.key}/dcn-int8", ("all_to_all", "all_gather"),
                         int8_bytes(shard, R_dcn) * mult, "dcn_hop",
                         (R_dcn,), in_scan)
@@ -541,7 +589,8 @@ class GraphTransformer:
                         "dcn_hop", (R_dcn,), in_scan)
                 add(f"{b.key}/ici-gather", ("all_gather",),
                     padded * mult, "ici_hop", (R_ici,), in_scan)
-            elif b.compressor in (_AR.Int8Compressor, _AR.Int8CompressorEF):
+            elif b.compressor in (_AR.Int8Compressor, _AR.Int8CompressorEF,
+                                  _AR.EquarxInt8Compressor):
                 add(f"{b.key}/int8", ("all_to_all", "all_gather"),
                     int8_bytes(b.total, R), "flat", (R,))
             elif b.compressor == _AR.PowerSGDCompressor:
@@ -655,6 +704,8 @@ class GraphTransformer:
                 extra += f" staleness={p.staleness}"
             if name in self._shard_of:
                 extra += f" sharded_update(ss={self._shard_of[name][1]})"
+            if name in self._prec_names:
+                extra += " precision=bf16_master"
             lines.append(f"{name}: shape={tuple(p.shape)} "
                          f"{p.placement.value}/{p.sync.value}"
                          f"{' sparse' if p.sparse else ''}{extra}")
@@ -687,7 +738,19 @@ class GraphTransformer:
 
     def _params_spec_leaves(self, space):
         if space == "storage":
-            return [part.storage_spec(self.plans[n], self.axis)
+            def s_axis_for(plan):
+                # bf16-master storage IS the flat shard — under the fused
+                # TWO_LEVEL schedule its rows are ici-major, same as the
+                # update space below
+                if (plan.name in self._shard_of
+                        and part.master_shard_storage(plan)
+                        and plan.hierarchy == ar_sync._AR.TWO_LEVEL
+                        and self.hier_spec is not None):
+                    return (self.hier_spec.ici,) + tuple(self.hier_spec.dcn)
+                return self.axis
+
+            return [part.storage_spec(self.plans[n],
+                                      s_axis_for(self.plans[n]))
                     for n in self.names]
         def axis_for(plan):
             # only the flat-shard PS update space moves to the subset axis;
@@ -747,6 +810,14 @@ class GraphTransformer:
     # -- state init --------------------------------------------------------
 
     def _to_storage(self, leaf, plan):
+        if part.master_shard_storage(plan):
+            # bf16-master: storage IS the flat padded f32 master (the
+            # update space) — the full-shape param only ever exists as a
+            # transient bf16 compute copy inside the step
+            r = self._R_for(plan)
+            n = leaf.size
+            npad = -(-n // r) * r
+            return jnp.zeros((npad,), leaf.dtype).at[:n].set(leaf.ravel())
         if plan.placement in (Placement.REPLICATED, Placement.CUSTOM):
             return leaf
         if plan.placement == Placement.SHARDED:
@@ -955,9 +1026,23 @@ class GraphTransformer:
         my = axis_index(axis)
         plans = [self.plans[n] for n in self.names]
 
-        # 1. materialize full params
+        # 1. materialize full params.  bf16-master buckets: storage is
+        # the local flat f32 master shard; the full-shape COMPUTE copy is
+        # all-gathered per bucket in bf16 — half the param-gather wire of
+        # the f32 schedule, and the only full-shape copy that ever exists
+        # (the F003 lever).  There is no post-update gather for these
+        # buckets: 6b writes the fresh f32 shard straight back.
         s_leaves = self.treedef.flatten_up_to(storage)
-        full_leaves = [self._materialize(l, p) for l, p in zip(s_leaves, plans)]
+        s_by_name = dict(zip(self.names, s_leaves))
+        bf16_full = {}
+        for b_pr in self.precision_buckets:
+            shards = {n: s_by_name[n].astype(jnp.bfloat16)
+                      for n in b_pr.var_names}
+            bf16_full.update(ar_sync.gather_bucket_params(
+                shards, b_pr, axis, self.hier_spec))
+        full_leaves = [bf16_full[n] if n in bf16_full
+                       else self._materialize(l, p)
+                       for n, l, p in zip(self.names, s_leaves, plans)]
         full = self.treedef.unflatten(full_leaves)
 
         # 2. local gradients (sparse lookups sync inside their backward)
@@ -1001,6 +1086,21 @@ class GraphTransformer:
 
         vag = jax.value_and_grad(loss_wrapper, has_aux=True)
 
+        # bf16-master vars produce bf16 grads (the compute copy is bf16);
+        # upcast to f32 immediately so accumulation, the wire reduce and
+        # the optimizer all run at master precision — the ONLY bf16
+        # stages are the forward/backward contractions and the wire legs
+        # that were already bf16
+        prec_names = self._prec_names
+
+        def upcast_grads(g):
+            if not prec_names:
+                return g
+            leaves = self.treedef.flatten_up_to(g)
+            leaves = [l.astype(jnp.float32) if n in prec_names else l
+                      for n, l in zip(self.names, leaves)]
+            return self.treedef.unflatten(leaves)
+
         def run_vag(micro_batch, micro_idx, mut):
             args = (full, mut, micro_batch)
             if item.has_rng:
@@ -1037,6 +1137,7 @@ class GraphTransformer:
         with replica_axis_context(axis), seq_axis_context(self.seq_axis):
             if A <= 1:
                 (loss, (maybe_mut, aux)), grads = run_vag(batch, 0, mutable)
+                grads = upcast_grads(grads)
                 new_mutable = maybe_mut if has_mutable else None
             else:
                 # gradient accumulation: split the local batch into A
@@ -1057,6 +1158,7 @@ class GraphTransformer:
                     mb, i = mb_i
                     acc_l, acc_g, mut_cur = carry
                     (l, (mut_next, aux_)), g = run_vag(mb, i, mut_cur)
+                    g = upcast_grads(g)
                     if not has_mutable:
                         mut_next = mut_cur
                     return ((acc_l + l / A,
@@ -1068,6 +1170,7 @@ class GraphTransformer:
                     mb, i = mb_i
                     acc_l, acc_g, mut_cur, comp_cur, acc_synced = carry
                     (l, (mut_next, aux_)), g = run_vag(mb, i, mut_cur)
+                    g = upcast_grads(g)
                     if not has_mutable:
                         mut_next = mut_cur
                     g_leaves_ = self.treedef.flatten_up_to(g)
@@ -1089,13 +1192,17 @@ class GraphTransformer:
                              mut_next, comp_next, acc_synced),
                             aux_)
 
-                zero_g = jax.tree.map(jnp.zeros_like, full)
+                # grads of bf16-master vars are upcast to f32 before
+                # accumulation, so their accumulators carry f32 too
+                zero_g = jax.tree.map(jnp.zeros_like, upcast_grads(full))
                 if overlap_in_scan:
                     # sharded-update buckets sync into per-var (ss,) flat
                     # SHARDS inside the scan; their accumulator carries the
                     # shard shape, never the full gradient
                     zero_synced = {
-                        n: (jnp.zeros((self._shard_of[n][1],), leaf.dtype)
+                        n: (jnp.zeros((self._shard_of[n][1],),
+                                      jnp.float32 if n in prec_names
+                                      else leaf.dtype)
                             if n in self._shard_of else jnp.zeros_like(leaf))
                         for n, leaf in zip(self.names,
                                            self.treedef.flatten_up_to(full))
@@ -1276,11 +1383,16 @@ class GraphTransformer:
                 # this device's (ss,) gradient shard in `synced`; pair it
                 # with the matching flat param shard
                 b_sh, ss = self._shard_of[name]
-                n = int(np.prod(plan.shape)) if plan.shape else 1
-                flatp = jnp.zeros((ss * b_sh.num_shards,),
-                                  s_leaf.dtype).at[:n].set(s_leaf.ravel())
-                u_params.append(jax.lax.dynamic_slice_in_dim(
-                    flatp, shard_rows[b_sh.key] * ss, ss))
+                if b_sh.precision:
+                    # bf16-master: s_leaf IS this device's flat f32
+                    # master shard (storage == update space)
+                    u_params.append(s_leaf)
+                else:
+                    n = int(np.prod(plan.shape)) if plan.shape else 1
+                    flatp = jnp.zeros((ss * b_sh.num_shards,),
+                                      s_leaf.dtype).at[:n].set(s_leaf.ravel())
+                    u_params.append(jax.lax.dynamic_slice_in_dim(
+                        flatp, shard_rows[b_sh.key] * ss, ss))
                 u_grads.append(synced[name])
             else:  # REPLICATED + AllReduce
                 u_params.append(s_leaf)
@@ -1356,6 +1468,11 @@ class GraphTransformer:
         # bucket i+1's still-running shard update.
         sharded_full = {}
         for b_sh in self.sharded_buckets:
+            if b_sh.precision:
+                # bf16-master: no post-update gather — the fresh f32
+                # shard IS the new storage (6b falls through to `nu`);
+                # the NEXT step's entry gather rebuilds the bf16 copy
+                continue
             sharded_full.update(ar_sync.gather_bucket_params(
                 new_by_name, b_sh, axis, self.hier_spec))
 
@@ -1511,7 +1628,10 @@ class GraphTransformer:
         plans_tree = self.treedef.unflatten([self.plans[n] for n in self.names])
 
         def fetch(leaf, plan):
-            if plan.placement == Placement.REPLICATED:
+            # bf16-master REPLICATED plans store the FLAT f32 master —
+            # canonical form still reshapes it back to the param shape
+            if (plan.placement == Placement.REPLICATED
+                    and not part.master_shard_storage(plan)):
                 return leaf
             return self._canon_leaf(leaf, plan)
 
@@ -1525,7 +1645,8 @@ class GraphTransformer:
             is_leaf=lambda x: isinstance(x, P))
 
         def to_storage(leaf, plan):
-            if plan.placement == Placement.REPLICATED:
+            if (plan.placement == Placement.REPLICATED
+                    and not part.master_shard_storage(plan)):
                 return leaf
             return self._uncanon_leaf(leaf, plan)
 

@@ -90,6 +90,14 @@ class VarPlan:
     # normalized back into `hierarchy`/`dcn_compressor` by the
     # transformer; genuinely searched programs run through run_schedule
     schedule_ir: str = ""
+    # AllReduceSynchronizer.Precision: 0 = F32 (full precision), 1 =
+    # BF16_COMPUTE_F32_MASTER — the f32 master params live as the flat
+    # padded 1/R shard (the sharded-update space doubles as storage) and
+    # the forward sees BF16 compute params all-gathered per bucket at
+    # half the param-gather wire; only meaningful where
+    # plan_sharded_update holds (the transformer normalizes it off — with
+    # a warning — elsewhere)
+    precision: int = 0
     # PS fields
     ps_sync: bool = True
     staleness: int = 0
@@ -204,6 +212,7 @@ def build_var_plans(strategy, model_item, num_replicas, param_specs=None):
             plan.dcn_compressor = ar.dcn_compressor
             plan.sharded_update = ar.sharded_update
             plan.schedule_ir = ar.schedule_ir
+            plan.precision = ar.precision
         else:
             logging.debug("Variable %s node has no synchronizer; AllReduce default", v.name)
 
@@ -290,11 +299,33 @@ def flat_shard_update(plan):
     return plan_sharded_update(plan)
 
 
+def master_shard_storage(plan):
+    """bf16-compute / f32-master mixed precision
+    (``AllReduceSynchronizer.Precision.BF16_COMPUTE_F32_MASTER``): the
+    variable's STORAGE is the flat padded f32 master 1/R shard itself —
+    the sharded-update space doubles as storage — and the full-shape
+    param the forward sees is a per-bucket all-gather of the BF16 cast of
+    the shards (half the param-gather wire, and the full-shape copy only
+    ever exists in bf16).  Eligibility mirrors ``plan_sharded_update``
+    (the master must live in the ZeRO-style shard) plus an f32 dtype —
+    casting an already-half-precision variable buys nothing."""
+    import numpy as np
+
+    if not getattr(plan, "precision", 0):
+        return False
+    if np.dtype(plan.dtype) != np.dtype("float32"):
+        return False
+    return plan_sharded_update(plan)
+
+
 def storage_spec(plan, replica_axis="replica"):
     """PartitionSpec of the variable's *storage* array on the mesh."""
     if plan.placement == Placement.CUSTOM:
         return plan.custom_spec
     if plan.placement == Placement.REPLICATED:
+        if master_shard_storage(plan):
+            # bf16-master: storage IS the flat f32 master shard
+            return P(replica_axis)
         return P()
     if plan.placement == Placement.SHARDED:
         entries = [None] * len(plan.shape)
@@ -324,6 +355,8 @@ def update_space_spec(plan, replica_axis="replica"):
 
 def storage_shape(plan, num_replicas):
     """Global shape of the storage array."""
+    if plan.placement == Placement.REPLICATED and master_shard_storage(plan):
+        return update_space_shape(plan, num_replicas)
     if plan.placement in (Placement.REPLICATED, Placement.CUSTOM):
         return tuple(plan.shape)
     if plan.placement == Placement.SHARDED:

@@ -102,7 +102,7 @@ ELEMENTWISE_CODECS = _ELEMENTWISE_CODECS
 # pass rejects it as a DCN-hop compressor (ERROR) and the engine refuses.
 DCN_SAFE_CODECS = frozenset(
     (_AR.NoneCompressor, _AR.BF16Compressor, _AR.BF16CompressorEF,
-     _AR.Int8Compressor, _AR.Int8CompressorEF))
+     _AR.Int8Compressor, _AR.Int8CompressorEF, _AR.EquarxInt8Compressor))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,6 +193,11 @@ class Bucket:
     # synthesized-schedule buckets — the executor runs the phases
     # verbatim and `hierarchy`/`dcn_compressor` are ignored
     schedule_ir: str = ""
+    # AllReduceSynchronizer.Precision: BF16_COMPUTE_F32_MASTER buckets
+    # store the f32 master as the flat shard (the update space doubles as
+    # storage) and gather BF16 compute params per bucket at the top of
+    # the step — only set on SHARDED buckets (the transformer normalizes)
+    precision: int = 0
 
     @property
     def total(self):
@@ -230,11 +235,12 @@ def plan_buckets(plans, var_shapes, var_dtypes,
             continue
         key = (plan.group, str(var_dtypes[name]), plan.compressor,
                plan.hierarchy, plan.dcn_compressor, plan.sharded_update,
-               getattr(plan, "schedule_ir", ""))
+               getattr(plan, "schedule_ir", ""),
+               getattr(plan, "precision", 0))
         groups.setdefault(key, []).append(name)
     buckets = []
     R = max(1, int(num_replicas))
-    for (group, dtype, comp, hier, dcn, shup, ir), names in sorted(
+    for (group, dtype, comp, hier, dcn, shup, ir, prec), names in sorted(
             groups.items(), key=lambda kv: kv[0]):
         # the key string keeps its pre-hierarchy format for FLAT buckets so
         # compressor-state checkpoints stay addressable
@@ -243,6 +249,10 @@ def plan_buckets(plans, var_shapes, var_dtypes,
             suffix += f"_z{shup}"
         if ir:
             suffix += f"_s{hashlib.md5(ir.encode()).hexdigest()[:8]}"
+        if prec:
+            # bf16-master buckets store flat f32 shards — they cannot
+            # share a key (or checkpoint layout) with plain f32 buckets
+            suffix += f"_p{prec}"
         sizes = tuple(int(np.prod(var_shapes[n])) if var_shapes[n] else 1
                       for n in names)
         buckets.append(Bucket(
@@ -258,6 +268,7 @@ def plan_buckets(plans, var_shapes, var_dtypes,
             num_shards=R if shup else 1,
             shard_sizes=tuple(-(-s // R) for s in sizes) if shup else (),
             schedule_ir=ir,
+            precision=prec,
         ))
     return buckets
 

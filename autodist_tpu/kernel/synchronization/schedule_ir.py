@@ -47,15 +47,19 @@ HOP_CODECS = frozenset({_AR.NoneCompressor, _AR.BF16Compressor})
 #: codecs legal on an ``all_reduce`` core (the DCN-safe family).
 CORE_CODECS = frozenset({_AR.NoneCompressor, _AR.BF16Compressor,
                          _AR.BF16CompressorEF, _AR.Int8Compressor,
-                         _AR.Int8CompressorEF})
+                         _AR.Int8CompressorEF, _AR.EquarxInt8Compressor})
 #: codecs legal on a ``ppermute_ring`` core: stateless cast only.
 RING_CODECS = frozenset({_AR.NoneCompressor, _AR.BF16Compressor})
 #: block codecs — quantize in fixed-size blocks, so the wire pays a scale
 #: sidecar per block; only worth it (and only allowed) on slow hops.
-BLOCK_CODECS = frozenset({_AR.Int8Compressor, _AR.Int8CompressorEF})
+BLOCK_CODECS = frozenset({_AR.Int8Compressor, _AR.Int8CompressorEF,
+                          _AR.EquarxInt8Compressor})
 
 _CODEC_NAMES = {v: k for k, v in _AR.Compressor.items()}
 _CODEC_VALUES = dict(_AR.Compressor.items())
+# short alias for the EQuARX fused codec (the paper's name); dumps() still
+# emits the canonical enum name
+_CODEC_VALUES["equarx_int8"] = _AR.EquarxInt8Compressor
 
 
 def _codec_table() -> str:

@@ -73,6 +73,41 @@ def dequant_sum(q, s, interpret=False):
     )(q, s)
 
 
+def _equarx_hop_kernel(n_dev, q_ref, s_ref, qo_ref, so_ref):
+    # EQuARX hop (arXiv 2506.17615): the received peer chunks never round-
+    # trip through an f32 HBM buffer — dequantize, mean over the D peers,
+    # and REquantize in one VMEM pass.  The accumulator lives only in
+    # registers/VMEM; HBM sees int8 + scales on both sides of the hop.
+    acc = jnp.sum(q_ref[:].astype(jnp.float32) * s_ref[:], axis=0) / n_dev
+    s = jnp.max(jnp.abs(acc), axis=1, keepdims=True) / 127.0
+    s = jnp.where(s == 0, 1.0, s)
+    qo_ref[:] = jnp.clip(jnp.round(acc / s), -127, 127).astype(jnp.int8)
+    so_ref[:] = s
+
+
+@functools.partial(jax.jit, static_argnames=("n_dev", "interpret"))
+def equarx_hop(q, s, n_dev, interpret=False):
+    """Fused dequantize + peer-mean + requantize for one allreduce hop:
+    ((D,N,BLOCK) int8, (D,N,1) f32) -> ((N,BLOCK) int8, (N,1) f32).
+
+    Numerically identical to ``dequant_sum(q, s) / n_dev`` followed by
+    ``quantize_int8`` (same op order per element), but as ONE kernel —
+    the full-precision accumulator never leaves VMEM."""
+    d, n, _ = q.shape
+    grid = (n // ROWS,)
+    return pl.pallas_call(
+        functools.partial(_equarx_hop_kernel, float(n_dev)),
+        grid=grid,
+        in_specs=[pl.BlockSpec((d, ROWS, BLOCK), lambda i: (0, i, 0)),
+                  pl.BlockSpec((d, ROWS, 1), lambda i: (0, i, 0))],
+        out_specs=[pl.BlockSpec((ROWS, BLOCK), lambda i: (i, 0)),
+                   pl.BlockSpec((ROWS, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, BLOCK), jnp.int8),
+                   jax.ShapeDtypeStruct((n, 1), jnp.float32)],
+        interpret=interpret,
+    )(q, s)
+
+
 def pad_to_blocks(flat, rows_multiple=ROWS, block=BLOCK):
     """Pad a flat f32 vector and reshape to (N, BLOCK) with N % rows == 0."""
     n = flat.shape[0]

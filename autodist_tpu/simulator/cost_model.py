@@ -54,6 +54,7 @@ import json
 
 from autodist_tpu.kernel.partitioner import (Placement, SyncKind,
                                              build_var_plans,
+                                             master_shard_storage,
                                              plan_sharded_update)
 
 # v5e-class defaults; override per ResourceSpec bandwidths when present.
@@ -66,6 +67,10 @@ DEFAULT_HBM_GBPS = 819.0           # v5e HBM bandwidth, GByte/s
 # moments read, param + two moments written (adam-class; sgd touches less
 # but the RANKING only needs the placement-relative factor)
 DEFAULT_OPT_BYTES_FACTOR = 7.0
+# f32 contractions run the MXU at half the bf16 issue rate on TPU —
+# the F003 lever's compute term: bf16-master strategies shed this
+# slowdown on the fraction of contraction work their vars cover
+F32_CONTRACTION_SLOWDOWN = 2.0
 
 
 @dataclasses.dataclass
@@ -186,15 +191,26 @@ def elementwise_flops(out_shape):
 
 
 def predicted_mfu_ceiling(model_flops, realized_flops,
-                          mxu_eff=DEFAULT_MXU_EFF):
+                          mxu_eff=DEFAULT_MXU_EFF,
+                          f32_contraction_frac=0.0):
     """Best MFU the lowered program can reach: the calibrated MXU
     efficiency discounted by the lowering's FLOP overhead — MFU counts
     MODEL flops, the chip executes REALIZED flops, so
     ``ceiling = mxu_eff * model / realized``.  With no contraction work
-    (or no model count) the ceiling is the raw efficiency."""
+    (or no model count) the ceiling is the raw efficiency.
+
+    ``f32_contraction_frac`` is the share of contraction FLOPs executing
+    at f32 (the F003 finding's ``f32_flops / total``): those run the MXU
+    at ``1/F32_CONTRACTION_SLOWDOWN`` of the bf16 issue rate, so the
+    ceiling (measured against bf16 peak) divides by the blended slowdown
+    — the term a bf16-master strategy sheds."""
     if not model_flops or not realized_flops or realized_flops <= 0:
-        return float(mxu_eff)
-    return float(mxu_eff) * min(1.0, float(model_flops) / float(realized_flops))
+        base = float(mxu_eff)
+    else:
+        base = float(mxu_eff) * min(
+            1.0, float(model_flops) / float(realized_flops))
+    f = min(1.0, max(0.0, float(f32_contraction_frac)))
+    return base / (1.0 + f * (F32_CONTRACTION_SLOWDOWN - 1.0))
 
 
 def jaxpr_flops(jaxpr):
@@ -392,6 +408,11 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
 
     ar_bytes = ps_bytes = gather_bytes = sparse_bytes = 0
     update_bytes = 0.0
+    # bf16-master (Precision.BF16_COMPUTE_F32_MASTER) accounting: the
+    # fraction of dense param bytes running bf16 compute scales the MXU
+    # term (f32 contractions issue at half rate), and the fresh-param
+    # gather legs of those buckets halve (bf16 wire)
+    dense_param_bytes = bf16_master_bytes = 0.0
     # overlap schedule bookkeeping: which dense-AR vars request
     # Schedule.OVERLAP, and how many buckets they split into (one per
     # (group, dtype, compressor) — mirrors all_reduce.plan_buckets)
@@ -427,6 +448,11 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
             row_bytes = nbytes / max(1, v.shape[0] if v.shape else 1)
             sparse_bytes += rows * row_bytes * R  # all-gather of touched rows
             continue
+        dense_param_bytes += nbytes
+        prec = master_shard_storage(plan)
+        if prec:
+            bf16_master_bytes += nbytes
+        pg = 0.5 if prec else 1.0  # bf16 fresh-param gather halves
         if plan.placement == Placement.SHARDED:
             ps_bytes += nbytes        # reduce-scatter grads (one phase)
             gather_bytes += nbytes    # all-gather params at use (one phase)
@@ -461,7 +487,7 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
             ar_bucket_keys.add((plan.group, str(plan.dtype),
                                 plan.compressor, plan.hierarchy,
                                 plan.dcn_compressor, plan.sharded_update,
-                                ir_text))
+                                ir_text, getattr(plan, "precision", 0)))
             # mirror the engine's IR normalization (graph_transformer):
             # canonical FLAT/TWO_LEVEL-shaped programs collapse onto the
             # legacy knobs; only genuinely synthesized programs take the
@@ -516,22 +542,35 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
             if two_level:
                 dcn_factor = wire_byte_factor(
                     dcn_enum or comp_enum, max(1, v.size))
-                hier_ici_bytes += 2.0 * nbytes    # scatter + gather phases
+                # scatter + gather phases; a bf16-master bucket's gather
+                # leg carries the bf16 COMPUTE copy (half the f32 wire)
+                hier_ici_bytes += ((1.0 + pg) * nbytes if ar_sharded
+                                   else 2.0 * nbytes)
                 if ar_sharded:
                     # ZeRO x two-level: the DCN hop pays the grad-shard
                     # scatter (codec-scaled) + the param-shard gather
-                    # (native), each one-way, instead of the shard ring
-                    oneway = nbytes * (dcn_factor + 1.0) / R_ici
+                    # (native, or bf16 under bf16-master), each one-way,
+                    # instead of the shard ring
+                    oneway = nbytes * (dcn_factor + pg) / R_ici
                     hier_dcn_bytes += oneway
                     hier_dcn_oneway_bytes += oneway
                 else:
                     hier_dcn_bytes += nbytes * dcn_factor / R_ici
             elif ar_sharded:
                 shard_scatter_bytes += nbytes * comp_factor
-                shard_gather_bytes += nbytes
+                shard_gather_bytes += nbytes * pg
             else:
                 ar_bytes += nbytes * comp_factor
 
+    # bf16-master compute term: the covered fraction's contractions run
+    # the MXU at the bf16 issue rate (F32_CONTRACTION_SLOWDOWN x the f32
+    # rate the default path is calibrated at) — contraction work
+    # approximated as proportional to dense param volume
+    bf16_frac = (bf16_master_bytes / dense_param_bytes
+                 if dense_param_bytes else 0.0)
+    if compute_s and bf16_frac:
+        compute_s *= (1.0 - bf16_frac
+                      * (1.0 - 1.0 / F32_CONTRACTION_SLOWDOWN))
     comm_s = (_ring_time(ar_bytes, R, bw)
               + _gather_time(ps_bytes, R, bw)      # reduce-scatter of grads
               + _gather_time(gather_bytes, R, bw)  # all-gather of params
@@ -592,6 +631,8 @@ def estimate(strategy, model_item, resource_spec, *, flops_per_example=0.0,
         "sharded_gather_bytes": shard_gather_bytes,
         "sharded_scatter_s": shard_scatter_s,
         "sharded_gather_s": shard_gather_s,
+        "bf16_master_bytes": bf16_master_bytes,
+        "bf16_master_frac": bf16_frac,
         "update_bytes": update_bytes, "update_s": update_s,
         "ar_buckets": len(ar_bucket_keys), "overlap_exposed_s": exposed_s,
         # the bandwidth INPUTS the estimate priced with, recorded so the
@@ -704,6 +745,17 @@ def hbm_footprint(strategy, model_item, num_replicas, *,
             param_bytes += nbytes    # gathered copy lives on every chip
             grad_bytes += nbytes
             u_frac[v.name] = 1.0 / R
+        elif master_shard_storage(plan):
+            # bf16-master: per chip, the f32 MASTER is only the 1/R flat
+            # shard (storage == update space) and the gathered compute
+            # copy — the only full-shape copy that ever exists — is bf16:
+            # 2 + 4/R bytes/param instead of the replicated 4 (and the
+            # sharded update's opt-state cut still applies below).  The
+            # transient gradient is bf16 too (upcast happens on the
+            # (ss,) shard after the scatter).
+            param_bytes += nbytes * 0.5 + nbytes / R
+            grad_bytes += nbytes * 0.5
+            u_frac[v.name] = 1.0 / R
         elif plan_sharded_update(plan):
             # ZeRO sharded weight update: the gathered param copy still
             # lives on every chip, but the optimizer's update space — and
@@ -794,6 +846,9 @@ def builder_label(b):
     shup = getattr(b, "sharded_update", "replicated")
     if shup not in ("replicated", 0, None, False):
         tags.append("sharded")
+    prec = getattr(b, "precision", "f32")
+    if prec not in ("f32", 0, None, False, ""):
+        tags.append("bf16_master")
     if getattr(b, "schedule_ir", ""):
         tags.append("searched")
     return name + (":" + ":".join(tags) if tags else "")

@@ -12,7 +12,8 @@ handled by the sparse all-gather synchronizer, matching in capability.
 from autodist_tpu.proto import synchronizers_pb2
 from autodist_tpu.strategy.base import (Strategy, StrategyBuilder,
                                         resolve_compressor, resolve_hierarchy,
-                                        resolve_schedule, resolve_schedule_ir,
+                                        resolve_precision, resolve_schedule,
+                                        resolve_schedule_ir,
                                         resolve_sharded_update)
 
 _SPECS = {
@@ -29,7 +30,8 @@ class AllReduce(StrategyBuilder):
     def __init__(self, chunk_size=128, all_reduce_spec="AUTO",
                  compressor="NoneCompressor", schedule="barrier",
                  hierarchy="auto", dcn_compressor=None,
-                 sharded_update="replicated", schedule_ir=None):
+                 sharded_update="replicated", schedule_ir=None,
+                 precision="f32"):
         """``schedule="overlap"`` emits per-bucket collectives in reverse
         layer-topological order and compiles with XLA's latency-hiding
         scheduler so each bucket's reduce hoists behind remaining backward
@@ -69,6 +71,17 @@ class AllReduce(StrategyBuilder):
         ``hierarchy``/``dcn_compressor``; canonical FLAT/TWO_LEVEL-shaped
         programs are normalized back to those knobs by the engine
         (docs/performance.md "Synthesized collective schedules").
+
+        ``precision="bf16_master"`` selects bf16-compute / f32-master
+        mixed precision (the F003 lever): the f32 master params + opt
+        state live in the sharded-update flat 1/R shard, the forward
+        sees BF16 compute params gathered per bucket at half the
+        param-gather wire volume, and the upcast happens only at the
+        update boundary.  Implies ``sharded_update="sharded"`` (the
+        master must live somewhere the compute copy is not); only
+        elementwise wire codecs qualify, like the sharded update itself
+        (docs/performance.md "Mixed precision & fused quantized
+        collectives").
         """
         if chunk_size < 1:
             raise ValueError("The chunk_size must be greater than zero")
@@ -82,6 +95,11 @@ class AllReduce(StrategyBuilder):
         if dcn_compressor is not None:
             resolve_compressor(dcn_compressor)
         self.dcn_compressor = dcn_compressor
+        if resolve_precision(precision):
+            # bf16-master keeps the f32 master in the ZeRO-style flat
+            # shard — it IS a sharded-update mode
+            sharded_update = "sharded"
+        self.precision = precision
         resolve_sharded_update(sharded_update)
         self.sharded_update = sharded_update
         self.schedule_ir = resolve_schedule_ir(schedule_ir)
@@ -101,6 +119,7 @@ class AllReduce(StrategyBuilder):
         ar.sharded_update = resolve_sharded_update(self.sharded_update)
         if self.schedule_ir:
             ar.schedule_ir = self.schedule_ir
+        ar.precision = resolve_precision(self.precision)
 
     def make_graph_config(self, strategy, resource_spec):
         """Replicas + mesh, factored into ``replica_dcn x replica_ici``

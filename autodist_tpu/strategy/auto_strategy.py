@@ -27,6 +27,12 @@ def default_candidates(resource_spec=None):
         # replicated-update AR family overflows)
         AllReduce(sharded_update="sharded"),
         AllReduce(schedule="overlap", sharded_update="sharded"),
+        # bf16-compute / f32-master mixed precision rides the sharded
+        # update: half the param-gather wire + half the live compute-param
+        # HBM, and the cost model credits the MXU's bf16 contraction rate —
+        # wins whenever the step is HBM- or compute-bound (the F003 lever)
+        AllReduce(precision="bf16_master"),
+        AllReduce(schedule="overlap", precision="bf16_master"),
         PS(),
         PSLoadBalancing(),
         PartitionedPS(),
@@ -51,6 +57,7 @@ def default_candidates(resource_spec=None):
             AllReduce(hierarchy="two_level", sharded_update="sharded"),
             AllReduce(hierarchy="two_level", schedule="overlap",
                       sharded_update="sharded"),
+            AllReduce(hierarchy="two_level", precision="bf16_master"),
             Parallax(hierarchy="two_level"),
         ]
         # searched collective schedules: the sketch-constrained synthesizer's

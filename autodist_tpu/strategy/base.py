@@ -127,6 +127,9 @@ _COMPRESSOR_ALIASES = {
     "Int8Compressor": synchronizers_pb2.AllReduceSynchronizer.Int8Compressor,
     "Int8CompressorEF": synchronizers_pb2.AllReduceSynchronizer.Int8CompressorEF,
     "PowerSGDCompressor": synchronizers_pb2.AllReduceSynchronizer.PowerSGDCompressor,
+    "EquarxInt8Compressor": synchronizers_pb2.AllReduceSynchronizer.EquarxInt8Compressor,
+    # the paper's name for the fused quantized-allreduce codec
+    "equarx_int8": synchronizers_pb2.AllReduceSynchronizer.EquarxInt8Compressor,
 }
 
 
@@ -235,6 +238,35 @@ def resolve_sharded_update(name_or_value):
             f"Unknown sharded_update {name_or_value!r}; accepted "
             f"names/values: "
             f"{_enum_choices(_SHARDED_UPDATE_ALIASES)}") from None
+
+
+_PRECISION_ALIASES = {
+    "f32": synchronizers_pb2.AllReduceSynchronizer.F32,
+    "bf16_master":
+        synchronizers_pb2.AllReduceSynchronizer.BF16_COMPUTE_F32_MASTER,
+    # long-form / spelling aliases
+    "bf16_compute_f32_master":
+        synchronizers_pb2.AllReduceSynchronizer.BF16_COMPUTE_F32_MASTER,
+    "mixed": synchronizers_pb2.AllReduceSynchronizer.BF16_COMPUTE_F32_MASTER,
+}
+
+
+def resolve_precision(name_or_value):
+    """Map a user-facing ``precision="f32"|"bf16_master"`` knob (or the
+    raw proto enum) to ``AllReduceSynchronizer.Precision``; unknown
+    inputs raise with the full accepted name/value table."""
+    if isinstance(name_or_value, int):
+        if name_or_value in set(_PRECISION_ALIASES.values()):
+            return name_or_value
+        raise ValueError(
+            f"Unknown precision enum value {name_or_value}; accepted "
+            f"names/values: {_enum_choices(_PRECISION_ALIASES)}")
+    try:
+        return _PRECISION_ALIASES[str(name_or_value).lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown precision {name_or_value!r}; accepted names/values: "
+            f"{_enum_choices(_PRECISION_ALIASES)}") from None
 
 
 def resolve_schedule_ir(value):

@@ -38,7 +38,8 @@ _C = synchronizers_pb2.AllReduceSynchronizer
 # codec placement alphabets, per hop class (schedule_ir validates the
 # same families — the search only proposes what the IR accepts)
 _HOP_CODECS = (_C.NoneCompressor, _C.BF16Compressor)
-_DCN_CORE_CODECS = (_C.NoneCompressor, _C.BF16Compressor, _C.Int8Compressor)
+_DCN_CORE_CODECS = (_C.NoneCompressor, _C.BF16Compressor, _C.Int8Compressor,
+                    _C.EquarxInt8Compressor)
 _ICI_CORE_CODECS = (_C.NoneCompressor, _C.BF16Compressor)
 _RING_CODECS = (_C.NoneCompressor, _C.BF16Compressor)
 

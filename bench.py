@@ -246,12 +246,16 @@ def _bench_searched_ir(spec):
 def _bench_sync(n_chips):
     """Resolve the gradient-sync levers into ``(spec, builder_kwargs,
     extras)``: the barrier/overlap schedule, the flat/two_level hierarchy
-    spec, and the searched schedule-IR program (which needs the factored
-    mesh, so ``BENCH_SCHEDULE=searched`` implies the two_level spec)."""
+    spec, the searched schedule-IR program (which needs the factored
+    mesh, so ``BENCH_SCHEDULE=searched`` implies the two_level spec),
+    the EQuARX fused quantized DCN codec (``BENCH_SCHEDULE=equarx`` —
+    also needs the factored mesh), and the bf16-master mixed-precision
+    knob (``BENCH_PRECISION=bf16_master``)."""
     schedule = _bench_schedule()
     searched = os.environ.get("BENCH_SCHEDULE", "") == "searched"
+    equarx = os.environ.get("BENCH_SCHEDULE", "") == "equarx"
     spec, hierarchy = _bench_hierarchy_spec(
-        n_chips, force_two_level=searched)
+        n_chips, force_two_level=searched or equarx)
     kwargs = {"schedule": schedule}
     ir = _bench_searched_ir(spec)
     extras = {"sync_schedule": schedule, "sync_hierarchy": hierarchy}
@@ -262,6 +266,21 @@ def _bench_sync(n_chips):
     elif searched:
         extras["sync_hierarchy"] = \
             f"{hierarchy} (searched requested; mesh did not factor)"
+    elif equarx:
+        if hierarchy == "two_level":
+            # the fused block-quantized ring hop on the slow DCN wire
+            # (ops/pallas/quantize.equarx_hop via the equarx_int8 codec)
+            kwargs.update(hierarchy="two_level",
+                          dcn_compressor="equarx_int8")
+            extras["sync_hierarchy"] = "two_level+equarx"
+        else:
+            extras["sync_hierarchy"] = \
+                f"{hierarchy} (equarx requested; mesh did not factor)"
+    if os.environ.get("BENCH_PRECISION", "f32") == "bf16_master":
+        # bf16-compute/f32-master: half the param-gather wire + the MXU's
+        # bf16 contraction rate; implies the ZeRO-style sharded update
+        kwargs["precision"] = "bf16_master"
+        extras["sync_precision"] = "bf16_master"
     return spec, kwargs, extras
 
 
@@ -534,10 +553,15 @@ def _cpu_proxy(steps=8):
 
     opt = optax.adam(1e-3)
 
-    def engine_ms(spec=None, **kw):
+    def engine_ms(spec=None, out=None, **kw):
         ad = AutoDist(resource_spec=spec or ResourceSpec.from_num_chips(n),
                       strategy_builder=AllReduce(**kw))
         sess = ad.distribute(loss, params, opt)
+        if out is not None:   # sharded-update wire accounting for extras
+            try:
+                out.update(sess._t.sharded_update_summary())
+            except Exception:
+                pass
         g = sess._shard_batch(batch)
         fetch_scalar(sess.run(g)["loss"])  # compile + warm
 
@@ -571,12 +595,17 @@ def _cpu_proxy(steps=8):
     raw_dt, _ = measure_per_step(run_raw, k=steps, repeats=1)
     raw_ms = raw_dt * 1e3
     eng_ms = engine_ms()
-    shard_ms = engine_ms(sharded_update="sharded")
+    shard_info, prec_info = {}, {}
+    shard_ms = engine_ms(sharded_update="sharded", out=shard_info)
+    # the bf16-master mixed-precision variant: same flat-shard update,
+    # bf16 compute-param gather at half the wire — the param_gather_bytes
+    # delta vs the f32 sharded update is the lever's wire evidence
+    bf16_ms = engine_ms(precision="bf16_master", out=prec_info)
     # the searched collective-schedule variant (strategy/schedule_search):
     # synthesize the top program for a 2 x n/2 factored virtual mesh and
     # time the session executing the schedule IR — the new sync path's
     # engine overhead rides in the same trajectory record
-    searched_ms = searched_ir = None
+    searched_ms = searched_ir = equarx_ms = None
     if n >= 4 and n % 2 == 0:
         from autodist_tpu.strategy.schedule_search import search
 
@@ -590,6 +619,11 @@ def _cpu_proxy(steps=8):
             searched_ms = engine_ms(spec=searched_spec,
                                     schedule_ir=searched_ir,
                                     hierarchy="two_level")
+        # the EQuARX fused quantized codec on the synthetic DCN hop —
+        # the same factored mesh, int8+scales wire with the fused
+        # dequant/accumulate/requant hop kernel
+        equarx_ms = engine_ms(spec=searched_spec, hierarchy="two_level",
+                              dcn_compressor="equarx_int8")
     out = {
         "metric": CPU_PROXY_METRIC,
         "value": round(eng_ms / max(raw_ms, 1e-9), 3),
@@ -600,6 +634,14 @@ def _cpu_proxy(steps=8):
         "engine_step_ms": round(eng_ms, 3),
         "engine_sharded_update_step_ms": round(shard_ms, 3),
         "sharded_update_ratio": round(shard_ms / max(raw_ms, 1e-9), 3),
+        "engine_bf16_step_ms": round(bf16_ms, 3),
+        "bf16_master_ratio": round(bf16_ms / max(raw_ms, 1e-9), 3),
+        # the wire evidence: bf16 compute-param gather is half the f32
+        # sharded update's fresh-param gather volume
+        "param_gather_bytes": {
+            "sharded_f32": shard_info.get("param_gather_bytes"),
+            "bf16_master": prec_info.get("param_gather_bytes"),
+        },
         "note": ("CPU-mesh pipeline proxy — engine dispatch/transform "
                  "overhead only, never a hardware throughput claim"),
     }
@@ -607,6 +649,9 @@ def _cpu_proxy(steps=8):
         out["engine_searched_step_ms"] = round(searched_ms, 3)
         out["searched_ratio"] = round(searched_ms / max(raw_ms, 1e-9), 3)
         out["searched_schedule_ir"] = searched_ir
+    if equarx_ms is not None:
+        out["engine_equarx_step_ms"] = round(equarx_ms, 3)
+        out["equarx_ratio"] = round(equarx_ms / max(raw_ms, 1e-9), 3)
     # the HLO compute audit of the same step (F006: model vs realized
     # FLOPs + predicted MFU ceiling) — priced from the lowering alone, so
     # the record keeps a hardware-independent compute story between

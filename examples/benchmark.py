@@ -231,10 +231,14 @@ def _make_builder(args, strategy_name, resource_spec=None):
     """``Name`` or ``Name:variant[:variant]`` — AllReduce-family variants:
     ``overlap``/``barrier`` (sync schedule), ``two_level``/``flat``
     (sync hierarchy), ``sharded_update`` (ZeRO-style sharded weight
-    update) and ``searched_schedule`` (the schedule synthesizer's top
-    program for the spec — requires a ``replica_dcn x replica_ici``
-    factorization, e.g. ``--mesh "replica_dcn=2,replica_ici=4"``), e.g.
-    ``AllReduce:two_level`` or ``AllReduce:overlap:sharded_update``;
+    update), ``bf16_master`` (bf16-compute/f32-master mixed precision —
+    implies the sharded update), ``equarx`` (the fused block-quantized
+    EQuARX codec on the DCN hop — requires the factored mesh, like
+    ``searched_schedule``) and ``searched_schedule`` (the schedule
+    synthesizer's top program for the spec — requires a ``replica_dcn x
+    replica_ici`` factorization, e.g. ``--mesh
+    "replica_dcn=2,replica_ici=4"``), e.g. ``AllReduce:two_level``,
+    ``AllReduce:bf16_master`` or ``AllReduce:overlap:sharded_update``;
     ``--ar_chunk_size`` sets the family's bucket-group granularity so
     the overlap term has buckets to pipeline."""
     from autodist_tpu import strategy as S
@@ -249,6 +253,17 @@ def _make_builder(args, strategy_name, resource_spec=None):
             kwargs["hierarchy"] = variant
         elif variant in ("sharded_update", "sharded"):
             kwargs["sharded_update"] = "sharded"
+        elif variant in ("bf16_master", "mixed"):
+            kwargs["precision"] = "bf16_master"
+        elif variant in ("equarx", "equarx_int8"):
+            if resource_spec is None or not getattr(
+                    resource_spec, "mesh_request", None):
+                raise SystemExit(
+                    "equarx: the fused quantized codec rides the DCN hop "
+                    "of the two-level schedule — factor the mesh with "
+                    "--mesh \"replica_dcn=N,replica_ici=M\"")
+            kwargs["dcn_compressor"] = "equarx_int8"
+            kwargs.setdefault("hierarchy", "two_level")
         elif variant in ("searched_schedule", "searched"):
             from autodist_tpu.strategy.schedule_search import search
 
@@ -266,7 +281,7 @@ def _make_builder(args, strategy_name, resource_spec=None):
             raise SystemExit(f"unknown strategy variant {variant!r} in "
                              f"{strategy_name!r} (overlap | barrier | "
                              f"two_level | flat | sharded_update | "
-                             f"searched_schedule)")
+                             f"bf16_master | equarx | searched_schedule)")
     if args.ar_chunk_size and issubclass(builder_cls, S.AllReduce):
         kwargs["chunk_size"] = args.ar_chunk_size
     return builder_cls(**kwargs)

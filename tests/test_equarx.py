@@ -1,0 +1,258 @@
+"""EQuARX fused quantized allreduce (``equarx_int8`` codec + Pallas hop).
+
+The fused block-quantized ring hop (arXiv 2506.17615) pinned end to end:
+
+- codec registry/aliases, DCN-safety, the schedule-IR codec token, and
+  the schedule search's DCN codec alphabet,
+- wire pricing: equarx shares the int8 family's scale-bytes factor,
+- kernel equivalence: the fused ``equarx_hop`` (interpret mode on CPU)
+  computes exactly the unfused dequant -> mean -> requant expression,
+- codec equivalence: the jnp fallback matches ``Int8Compressor`` hop
+  math, and ``AUTODIST_EQUARX_INTERPRET=1`` drives the real Pallas
+  kernel through the pmap'd collective with identical results,
+- engine: a two-level DCN-hop equarx run matches the Int8 DCN run
+  exactly and the uncompressed flat baseline within the int8 family's
+  5e-2 tolerance,
+- the AD10 lint rule confines ``pallas_call`` to ops/pallas/ (fires on
+  a synthetic violation, exempts the kernel dir, repo stays clean),
+- the live ``records/cpu_mesh/gpt_tiny_AllReduce_equarx.json`` record
+  audits clean.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from autodist_tpu.kernel.synchronization import all_reduce as ar_sync
+from autodist_tpu.kernel.synchronization import schedule_ir as sir
+from autodist_tpu.kernel.synchronization.compressor import (
+    EquarxInt8Compressor, Int8Compressor, get_compressor, wire_byte_factor)
+from autodist_tpu.ops.pallas.quantize import BLOCK, ROWS, equarx_hop
+from autodist_tpu.proto import synchronizers_pb2
+from autodist_tpu.strategy import AllReduce
+
+from tests.test_sharded_update import SPEC_2x2, SPEC_FLAT4
+
+_C = synchronizers_pb2.AllReduceSynchronizer
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- registry / pricing ------------------------------------------------------
+
+def test_codec_registry_and_dcn_safety():
+    comp = get_compressor(_C.EquarxInt8Compressor)
+    assert isinstance(comp, EquarxInt8Compressor)
+    assert isinstance(comp, Int8Compressor)  # same wire pattern + math
+    assert comp.name == "equarx_int8" and not comp.stateful
+    # a shard-decomposable elementwise-block codec: legal on the DCN hop
+    assert _C.EquarxInt8Compressor in ar_sync.DCN_SAFE_CODECS
+    # schedule-IR codec token + the search's DCN alphabet
+    assert sir._CODEC_VALUES["equarx_int8"] == _C.EquarxInt8Compressor
+    from autodist_tpu.strategy.schedule_search import _DCN_CORE_CODECS
+    assert _C.EquarxInt8Compressor in _DCN_CORE_CODECS
+
+
+def test_schedule_ir_accepts_equarx_on_dcn_hop():
+    from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+    from autodist_tpu.strategy.base import resolve_schedule_ir
+
+    text = (f"reduce_scatter@{AXIS_REPLICA_ICI};"
+            f"all_reduce@{AXIS_REPLICA_DCN}:equarx_int8;"
+            f"all_gather@{AXIS_REPLICA_ICI}")
+    ir = sir.loads(text)
+    assert ir.phases[1].codec == _C.EquarxInt8Compressor
+    # the alias canonicalizes to the enum name in the serialized form
+    canon = resolve_schedule_ir(text)
+    assert "EquarxInt8Compressor" in canon
+    assert resolve_schedule_ir(canon) == canon
+
+
+def test_wire_byte_factor_equarx_is_int8_family():
+    int8_factor = 0.25 * (1.0 + 4.0 / Int8Compressor.BLOCK)
+    assert wire_byte_factor(_C.EquarxInt8Compressor) == \
+        pytest.approx(int8_factor)
+    assert wire_byte_factor(_C.EquarxInt8Compressor) == \
+        pytest.approx(wire_byte_factor(_C.Int8Compressor))
+
+
+# -- kernel equivalence (interpret mode on CPU) ------------------------------
+
+def _unfused_hop(q, s, n_dev):
+    """The reference expression the fused kernel replaces: dequantize the
+    peer chunks, mean, block-requantize."""
+    acc = jnp.sum(q.astype(jnp.float32) * s, axis=0) / n_dev
+    s2 = jnp.max(jnp.abs(acc), axis=1, keepdims=True) / 127.0
+    s2 = jnp.where(s2 == 0, 1.0, s2)
+    q2 = jnp.clip(jnp.round(acc / s2), -127, 127).astype(jnp.int8)
+    return q2, s2
+
+
+def test_fused_hop_matches_unfused_reference():
+    r = np.random.RandomState(0)
+    d, n = 4, 2 * ROWS
+    q = jnp.asarray(r.randint(-127, 128, size=(d, n, BLOCK)), jnp.int8)
+    s = jnp.asarray(np.abs(r.randn(d, n, 1)).astype(np.float32))
+    q2, s2 = equarx_hop(q, s, d, interpret=True)
+    rq, rs = _unfused_hop(q, s, d)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(rs), rtol=1e-6)
+    # identical round/clip semantics: the int8 codes agree exactly
+    np.testing.assert_array_equal(np.asarray(q2), np.asarray(rq))
+
+
+def test_fused_hop_zero_chunk_safe():
+    d, n = 2, ROWS
+    q = jnp.zeros((d, n, BLOCK), jnp.int8)
+    s = jnp.zeros((d, n, 1), jnp.float32)
+    q2, s2 = equarx_hop(q, s, d, interpret=True)
+    assert not np.any(np.asarray(q2))
+    assert np.all(np.asarray(s2) == 1.0)  # the zero-block guard
+
+
+# -- codec equivalence through the pmap'd collective -------------------------
+
+def _pmap_reduce(comp, n_dev, n):
+    r = np.random.RandomState(0)
+    x = r.randn(n_dev, n).astype(np.float32)
+    fn = jax.pmap(lambda b: comp.all_reduce(b, (), "i")[0], axis_name="i",
+                  devices=jax.devices()[:n_dev])
+    return x, np.asarray(fn(jnp.asarray(x)))
+
+
+def test_codec_jnp_fallback_matches_int8_hop_math():
+    """Small buffers take the jnp fallback; the fused expression is the
+    same dequant -> mean -> requant recipe Int8Compressor runs, so the
+    two codecs agree to float rounding."""
+    x, got = _pmap_reduce(EquarxInt8Compressor(), 4, 1000)
+    _, want = _pmap_reduce(Int8Compressor(), 4, 1000)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # and both approximate the true mean at int8 block-quant accuracy
+    np.testing.assert_allclose(got[0], x.mean(axis=0), atol=5e-2)
+
+
+def test_codec_interpret_mode_drives_the_pallas_kernel(monkeypatch):
+    """AUTODIST_EQUARX_INTERPRET=1 + a tile-sized chunk routes the hop
+    through the REAL Pallas kernel in interpret mode — results match the
+    jnp fallback path exactly."""
+    # chunk = n / n_dev must span a full (ROWS x BLOCK) tile grid
+    n_dev, n = 2, 2 * ROWS * BLOCK
+    comp = EquarxInt8Compressor()
+    _, want = _pmap_reduce(comp, n_dev, n)
+    monkeypatch.setenv("AUTODIST_EQUARX_INTERPRET", "1")
+    _, got = _pmap_reduce(comp, n_dev, n)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# -- engine (two-level DCN hop) ----------------------------------------------
+
+def _train(spec, compressor="NoneCompressor", dcn_compressor=None,
+           hierarchy="auto", steps=2):
+    from autodist_tpu.autodist import AutoDist
+
+    r = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(r.randn(32, 16), jnp.float32),
+              "b1": jnp.zeros((16,), jnp.float32),
+              "w2": jnp.asarray(r.randn(16, 4), jnp.float32)}
+
+    def loss(p, b):
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - b["y"]) ** 2)
+
+    batch = {"x": r.randn(32, 32).astype(np.float32),
+             "y": r.randn(32, 4).astype(np.float32)}
+    ad = AutoDist(resource_spec=spec, strategy_builder=AllReduce(
+        compressor=compressor, dcn_compressor=dcn_compressor,
+        hierarchy=hierarchy))
+    sess = ad.distribute(loss, params, optax.sgd(0.1))
+    for _ in range(steps):
+        sess.run(batch)
+    return sess
+
+
+def test_engine_two_level_equarx_matches_int8_and_flat():
+    s0 = _train(SPEC_FLAT4)
+    s1 = _train(SPEC_2x2, hierarchy="two_level",
+                dcn_compressor="equarx_int8")
+    s2 = _train(SPEC_2x2, hierarchy="two_level",
+                dcn_compressor="Int8Compressor")
+    assert s1._t.sync_hierarchy == "two_level"
+    # same hop math as Int8Compressor: agree to float rounding
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 s1.params(), s2.params())
+    # int8 family tolerance vs the uncompressed flat baseline
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=5e-2),
+                 s0.params(), s1.params())
+
+
+# -- AD10 lint ---------------------------------------------------------------
+
+def _lint_snippet(tmp_path, relpath, source):
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [code for _p, _ln, code, _m in lint.lint_file(p)]
+
+
+_AD10 = ("from jax.experimental import pallas as pl\n"
+         "def fused(x):\n"
+         "    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)\n")
+
+
+def test_ad10_flags_pallas_call_outside_kernel_dir(tmp_path):
+    assert "AD10" in _lint_snippet(
+        tmp_path, "autodist_tpu/kernel/foo.py", _AD10)
+    assert "AD10" in _lint_snippet(tmp_path, "tools/foo.py", _AD10)
+
+
+def test_ad10_exempts_kernel_dir_and_tests(tmp_path):
+    assert "AD10" not in _lint_snippet(
+        tmp_path, "autodist_tpu/ops/pallas/foo.py", _AD10)
+    assert "AD10" not in _lint_snippet(tmp_path, "tests/t.py", _AD10)
+
+
+def test_repo_is_ad10_clean():
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    findings = []
+    for root in ("autodist_tpu", "tools", "examples"):
+        for dirpath, _dirs, files in os.walk(os.path.join(REPO, root)):
+            for f in files:
+                if f.endswith(".py") and not f.endswith("_pb2.py"):
+                    findings.extend(
+                        lint.lint_file(
+                            type(lint.Path(""))(os.path.join(dirpath, f))))
+    assert not [f for f in findings if f[2] == "AD10"]
+
+
+# -- the live record ---------------------------------------------------------
+
+def test_live_equarx_record_audits_clean():
+    from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
+                                       TRACE_PASSES, verify_strategy)
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.simulator.cost_model import (RuntimeRecord,
+                                                   rebuild_record_case)
+
+    path = os.path.join(REPO, "records", "cpu_mesh",
+                        "gpt_tiny_AllReduce_equarx.json")
+    assert os.path.exists(path), "live equarx record missing"
+    rec = RuntimeRecord.load(path)
+    strategy, item, R = rebuild_record_case(rec)
+    assert any(
+        n.AllReduceSynchronizer.dcn_compressor == _C.EquarxInt8Compressor
+        for n in strategy.node_config)
+    spec = ResourceSpec.from_num_chips(R)
+    report = verify_strategy(
+        strategy, item, spec, batch_shapes={"x": ((2 * R, 4), "float32")},
+        hbm_bytes_per_device=16 << 30,
+        passes=STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES)
+    assert report.ok, [str(f) for f in report.errors]

@@ -95,13 +95,16 @@ def test_hierarchy_threads_builder_to_plans_and_transformer():
     assert all(b.hierarchy == _C.TWO_LEVEL for b in t.buckets)
     assert "sync_hierarchy: two_level" in t.plan_summary()
     # the summary's per-hop accounting: DCN rides 1/R_ici of the volume,
-    # further int8-compressed (0.25x of the f32 bytes)
+    # further int8-compressed — wire_byte_factor's honest int8 pricing,
+    # 0.25x payload plus the per-256-block f32 scale rows
+    from autodist_tpu.kernel.synchronization.compressor import \
+        wire_byte_factor
     hs = t.hierarchy_summary()
     assert hs["mode"] == "two_level"
     assert hs["replica_dcn"] == 2 and hs["replica_ici"] == 2
     assert hs["dcn_compressors"] == ["int8"]
     assert hs["dcn_hop_bytes"] == pytest.approx(
-        hs["ici_hop_bytes"] / 2 * 0.25 / 2)
+        hs["ici_hop_bytes"] / 2 * wire_byte_factor(_C.Int8Compressor) / 2)
 
 
 def test_two_level_without_factored_mesh_raises():

@@ -1,0 +1,487 @@
+"""bf16-compute / f32-master mixed precision (AllReduce ``precision``).
+
+The F003 lever, pinned end to end, mirroring tests/test_sharded_update.py:
+
+- ``resolve_precision`` follows the name/value-table error convention,
+- the builder forces the ZeRO-style sharded update (the f32 master IS
+  the flat 1/R shard), proto/plan/bucket threading, ineligibility
+  fallbacks (block codecs, non-f32 dtypes),
+- engine parity: bf16-compute training matches the f32 baseline within
+  the bf16 codec family's 2e-2 tolerance across optimizers,
+  barrier+overlap, FLAT+TWO_LEVEL, and under grad-accum scan,
+- cost model: the param gather carries the bf16 compute copy (half the
+  f32 wire), the covered fraction's contractions earn the MXU-rate
+  discount, the f32 master keeps the 0.5 + 1/R HBM branch, and
+  AutoStrategy ranks a bf16-master candidate first on an HBM-bound spec,
+- compute audit: the NEW precision-aware F006 keys
+  (``f32_contraction_frac``, ``contraction_flops_by_dtype``,
+  ``predicted_mfu_ceiling_precision``) — the plain
+  ``predicted_mfu_ceiling`` stays frac-free so R004 baselines hold,
+- remediation: the seeded F002/F003/F004 cases map to the documented
+  strategy/engine deltas (``tools/verify_strategy.py --suggest``),
+- checkpoint round-trip of the f32 master (canonical single-device
+  form; same-mode resume and cross-strategy restore into plain f32).
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
+                                   TRACE_PASSES, format_suggestions,
+                                   suggest_remediations, verify_strategy)
+from autodist_tpu.analysis.cases import (EXPECTED_DONATION_CODE,
+                                         EXPECTED_PRECISION_CODE,
+                                         EXPECTED_RECOMPUTE_CODE,
+                                         build_dropped_donation_case,
+                                         build_f32_contraction_case,
+                                         build_recompute_case)
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.proto import synchronizers_pb2
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.simulator.cost_model import (DEFAULT_MXU_EFF,
+                                               F32_CONTRACTION_SLOWDOWN,
+                                               estimate, hbm_footprint,
+                                               predicted_mfu_ceiling)
+from autodist_tpu.strategy import AllReduce
+from autodist_tpu.strategy.base import resolve_precision
+
+from tests.test_sharded_update import SPEC_2NODE, SPEC_2x2, SPEC_FLAT4
+
+_C = synchronizers_pb2.AllReduceSynchronizer
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the documented engine-parity tolerance: bf16 compute params round the
+# forward exactly like the BF16Compressor wire rounds the gradients
+BF16_MASTER_TOL = 2e-2
+
+
+# -- knob resolution + proto threading --------------------------------------
+
+def test_resolve_precision_names_and_ints():
+    assert resolve_precision("f32") == _C.F32
+    assert resolve_precision("bf16_master") == _C.BF16_COMPUTE_F32_MASTER
+    assert resolve_precision("BF16_MASTER") == _C.BF16_COMPUTE_F32_MASTER
+    assert resolve_precision("mixed") == _C.BF16_COMPUTE_F32_MASTER
+    assert resolve_precision(
+        "bf16_compute_f32_master") == _C.BF16_COMPUTE_F32_MASTER
+    assert resolve_precision(_C.BF16_COMPUTE_F32_MASTER) == \
+        _C.BF16_COMPUTE_F32_MASTER
+    with pytest.raises(ValueError) as e:
+        resolve_precision("fp16")
+    assert "'bf16_master'" in str(e.value) and "'f32'" in str(e.value)
+    with pytest.raises(ValueError) as e:
+        resolve_precision(99)
+    assert "accepted names/values" in str(e.value)
+    with pytest.raises(ValueError):
+        AllReduce(precision="bogus")
+
+
+def _item():
+    params = {"w1": jnp.zeros((32, 16)), "b1": jnp.zeros((16,)),
+              "w2": jnp.zeros((16, 4))}
+    return ModelItem(lambda p, b: 0.0, params)
+
+
+def test_precision_threads_builder_to_buckets():
+    from autodist_tpu.kernel import partitioner as part
+    from autodist_tpu.kernel.graph_transformer import GraphTransformer
+    from jax.sharding import Mesh
+
+    item = _item()
+    s = AllReduce(precision="bf16_master").build(item, SPEC_FLAT4)
+    for n in s.node_config:
+        ar = n.AllReduceSynchronizer
+        assert ar.precision == _C.BF16_COMPUTE_F32_MASTER
+        # the builder forces the sharded update: the f32 master IS the
+        # flat 1/R shard
+        assert ar.sharded_update == _C.SHARDED
+    plans = part.build_var_plans(s, item, 4)
+    assert all(p.precision == _C.BF16_COMPUTE_F32_MASTER
+               for p in plans.values())
+    mesh = Mesh(np.array(jax.devices()[:4]), ("replica",))
+    t = GraphTransformer(s, item, mesh)
+    assert t.sync_mixed_precision and t.sync_sharded_update
+    assert t.precision_buckets == t.sharded_buckets
+    assert "precision=bf16_master" in t.plan_summary()
+    summary = t.sharded_update_summary()
+    assert summary["bf16_master_buckets"] == len(t.precision_buckets) > 0
+
+    # the fresh-param all-gather carries the bf16 compute copy: half the
+    # wire of the same plan at full f32
+    s_f32 = AllReduce(sharded_update="sharded").build(item, SPEC_FLAT4)
+    t_f32 = GraphTransformer(s_f32, item, mesh)
+    assert summary["param_gather_bytes"] == pytest.approx(
+        0.5 * t_f32.sharded_update_summary()["param_gather_bytes"])
+
+
+def test_precision_block_codec_falls_back_to_f32():
+    """A block codec defeats the sharded update, and the master shard
+    rides the sharded update — so the whole precision request degrades
+    to plain f32 (logged, never an error)."""
+    from autodist_tpu.kernel.graph_transformer import GraphTransformer
+    from jax.sharding import Mesh
+
+    item = _item()
+    s = AllReduce(precision="bf16_master",
+                  compressor="Int8Compressor").build(item, SPEC_FLAT4)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("replica",))
+    t = GraphTransformer(s, item, mesh)
+    assert not t.sync_sharded_update and not t.sync_mixed_precision
+    assert t.precision_buckets == []
+
+
+def test_precision_non_f32_vars_keep_their_dtype():
+    from autodist_tpu.kernel import partitioner as part
+
+    item = ModelItem(lambda p, b: 0.0,
+                     {"w": jnp.zeros((32, 8)),
+                      "emb": jnp.zeros((16, 8), jnp.bfloat16)})
+    s = AllReduce(precision="bf16_master").build(item, SPEC_FLAT4)
+    plans = part.build_var_plans(s, item, 4)
+    assert part.master_shard_storage(plans["w"])
+    # already-bf16 storage: casting buys nothing, the plan keeps F32 mode
+    assert not part.master_shard_storage(plans["emb"])
+
+
+# -- engine parity (the acceptance matrix) -----------------------------------
+
+_OPTS = {"sgd": lambda: optax.sgd(0.1),
+         "momentum": lambda: optax.sgd(0.1, momentum=0.9),
+         "adam": lambda: optax.adam(0.05)}
+
+
+def _train(spec, opt="sgd", schedule="barrier", hierarchy="auto",
+           precision="f32", accum=1, steps=2):
+    from autodist_tpu.autodist import AutoDist
+
+    r = np.random.RandomState(0)
+    params = {"w1": jnp.asarray(r.randn(32, 16), jnp.float32),
+              "b1": jnp.zeros((16,), jnp.float32),
+              "w2": jnp.asarray(r.randn(16, 4), jnp.float32)}
+
+    def loss(p, b):
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - b["y"]) ** 2)
+
+    batch = {"x": r.randn(32, 32).astype(np.float32),
+             "y": r.randn(32, 4).astype(np.float32)}
+    ad = AutoDist(resource_spec=spec, strategy_builder=AllReduce(
+        schedule=schedule, hierarchy=hierarchy, precision=precision))
+    sess = ad.distribute(loss, params, _OPTS[opt](), accum_steps=accum)
+    for _ in range(steps):
+        m = sess.run(batch)
+    return sess, float(m["loss"])
+
+
+# adam's per-element normalization turns a bf16-rounded gradient sign
+# wobble into a full lr-sized step difference, so its parity bound is
+# steps * lr rather than the rounding-scale family tolerance
+_PARITY_ATOL = {"sgd": BF16_MASTER_TOL, "momentum": BF16_MASTER_TOL,
+                "adam": 2 * 0.05 * 2}
+
+
+@pytest.mark.parametrize("opt", sorted(_OPTS))
+def test_engine_bf16_master_matches_f32_per_optimizer(opt):
+    """Acceptance: sgd / momentum / adam — bf16-compute training stays
+    within the documented parity bound of the f32 baseline, and the
+    MASTER params remain exact f32 (the update runs at full precision)."""
+    s0, l0 = _train(SPEC_FLAT4, opt=opt)
+    s1, l1 = _train(SPEC_FLAT4, opt=opt, precision="bf16_master")
+    assert s1._t.sync_mixed_precision and not s0._t.sync_mixed_precision
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b,
+                                                atol=_PARITY_ATOL[opt]),
+        s0.params(), s1.params())
+    assert abs(l0 - l1) < BF16_MASTER_TOL
+    # the master is genuinely f32 storage, not a cast-back bf16 copy
+    assert all(np.asarray(v).dtype == np.float32
+               for v in jax.tree.leaves(s1.params()))
+
+
+@pytest.mark.parametrize("schedule", ["barrier", "overlap"])
+def test_engine_bf16_master_under_schedule_and_accum(schedule):
+    """Both issue schedules x grad accumulation: the bf16 gather runs
+    once at the top of the step, the scan carry stays f32."""
+    s0, _ = _train(SPEC_FLAT4, opt="adam", schedule=schedule, accum=4)
+    s1, _ = _train(SPEC_FLAT4, opt="adam", schedule=schedule, accum=4,
+                   precision="bf16_master")
+    assert s1._t.sync_mixed_precision
+    assert s1._t.sync_schedule == schedule
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b,
+                                                atol=_PARITY_ATOL["adam"]),
+        s0.params(), s1.params())
+
+
+def test_engine_two_level_bf16_master_matches_flat():
+    """TWO_LEVEL x bf16-master: the param gather retraces the ici/dcn
+    hops with the bf16 compute copy and stays within family tolerance
+    of the flat f32 baseline."""
+    s0, _ = _train(SPEC_FLAT4, opt="adam")
+    s1, _ = _train(SPEC_2x2, opt="adam", hierarchy="two_level",
+                   precision="bf16_master")
+    t = s1._t
+    assert t.sync_hierarchy == "two_level" and t.sync_mixed_precision
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b,
+                                                atol=_PARITY_ATOL["adam"]),
+        s0.params(), s1.params())
+
+
+# -- cost model (acceptance) -------------------------------------------------
+
+def _big_item():
+    return ModelItem(lambda p, b: 0.0, {"w": jnp.zeros((512, 512))},
+                     optax.adam(1e-3))
+
+
+def test_cost_model_prices_bf16_master():
+    item = _big_item()
+    nbytes = 512 * 512 * 4
+    f32 = estimate(
+        AllReduce(sharded_update="sharded").build(item, SPEC_FLAT4),
+        item, SPEC_FLAT4, flops_per_example=1e9)
+    prec = estimate(
+        AllReduce(precision="bf16_master").build(item, SPEC_FLAT4),
+        item, SPEC_FLAT4, flops_per_example=1e9)
+    bd = prec.breakdown
+    assert bd["bf16_master_frac"] == pytest.approx(1.0)
+    assert bd["bf16_master_bytes"] == pytest.approx(nbytes)
+    # grad scatter unchanged; param gather halved (bf16 compute copy)
+    assert bd["sharded_scatter_bytes"] == pytest.approx(
+        f32.breakdown["sharded_scatter_bytes"])
+    assert bd["sharded_gather_bytes"] == pytest.approx(
+        0.5 * f32.breakdown["sharded_gather_bytes"])
+    # the covered contractions run at the bf16 MXU issue rate (a small
+    # additive non-contraction term rides along untouched)
+    assert prec.compute_s == pytest.approx(
+        f32.compute_s / F32_CONTRACTION_SLOWDOWN, rel=1e-2)
+    assert prec.total_s < f32.total_s
+
+
+def test_cost_model_two_level_bf16_master_dcn_gather_is_bf16():
+    item = _big_item()
+    f32 = estimate(
+        AllReduce(hierarchy="two_level",
+                  sharded_update="sharded").build(item, SPEC_2NODE),
+        item, SPEC_2NODE, flops_per_example=1e9)
+    prec = estimate(
+        AllReduce(hierarchy="two_level",
+                  precision="bf16_master").build(item, SPEC_2NODE),
+        item, SPEC_2NODE, flops_per_example=1e9)
+    # dcn one-way = shard * (grad factor 1 + param gather pg): pg drops
+    # from 1 -> 0.5, so the hop carries 3/4 of the f32 bytes
+    assert prec.breakdown["hier_dcn_bytes"] == pytest.approx(
+        0.75 * f32.breakdown["hier_dcn_bytes"])
+    assert prec.total_s < f32.total_s
+
+
+def test_predicted_mfu_ceiling_precision_term():
+    """Pin: the frac-free default is UNCHANGED (R004 baselines depend on
+    it); the f32 share discounts the ceiling by the MXU slowdown."""
+    assert predicted_mfu_ceiling(1e6, 1e6) == pytest.approx(DEFAULT_MXU_EFF)
+    assert predicted_mfu_ceiling(
+        1e6, 1e6, f32_contraction_frac=0.0) == pytest.approx(
+            DEFAULT_MXU_EFF)
+    assert predicted_mfu_ceiling(
+        1e6, 1e6, f32_contraction_frac=1.0) == pytest.approx(
+            DEFAULT_MXU_EFF / F32_CONTRACTION_SLOWDOWN)
+    # out-of-range fracs clamp rather than corrupt the gauge
+    assert predicted_mfu_ceiling(
+        1e6, 1e6, f32_contraction_frac=7.0) == pytest.approx(
+            DEFAULT_MXU_EFF / F32_CONTRACTION_SLOWDOWN)
+
+
+def test_hbm_footprint_bf16_master_master_shard_branch():
+    item = _big_item()
+    pb = 512 * 512 * 4
+    repl = hbm_footprint(AllReduce().build(item, SPEC_FLAT4), item, 8)
+    prec = hbm_footprint(
+        AllReduce(precision="bf16_master").build(item, SPEC_FLAT4),
+        item, 8)
+    # per chip: bf16 compute copy (pb/2) + the f32 master's 1/R shard
+    assert prec["param_bytes"] == pytest.approx(pb * 0.5 + pb / 8,
+                                                rel=0.05)
+    # opt state rides the sharded update: 1/R of Adam's 2pb
+    assert prec["opt_bytes"] == pytest.approx(2 * pb / 8, rel=0.05)
+    assert repl["param_bytes"] == pytest.approx(pb, rel=0.05)
+
+
+def test_auto_strategy_ranks_bf16_master_on_hbm_bound_spec():
+    """Acceptance: the candidate set carries bf16-master entries and on
+    an HBM-bound spec (fits the bf16-master footprint, not the plain
+    sharded one) the BUILT winner carries the precision proto knob."""
+    from autodist_tpu.strategy.auto_strategy import (AutoStrategy,
+                                                     default_candidates)
+
+    assert any(getattr(b, "precision", "f32") == "bf16_master"
+               for b in default_candidates(SPEC_FLAT4))
+    assert any(getattr(b, "precision", "f32") == "bf16_master"
+               and getattr(b, "hierarchy", None) == "two_level"
+               for b in default_candidates(SPEC_2NODE))
+
+    item = _big_item()
+    sh = hbm_footprint(
+        AllReduce(sharded_update="sharded").build(item, SPEC_2NODE),
+        item, 8)
+    pr = hbm_footprint(
+        AllReduce(precision="bf16_master").build(item, SPEC_2NODE),
+        item, 8)
+    total = lambda fp: (fp["param_bytes"] + fp["grad_bytes"]  # noqa: E731
+                        + fp["opt_bytes"])
+    assert total(pr) < total(sh)
+    budget = int((total(pr) + total(sh)) / 2)
+    auto = AutoStrategy(flops_per_example=1e9,
+                        hbm_bytes_per_device=budget)
+    s = auto.build(item, SPEC_2NODE)
+    winner = auto.last_ranking[0][0]
+    assert "bf16_master" in winner, auto.last_ranking
+    assert any(
+        n.AllReduceSynchronizer.precision == _C.BF16_COMPUTE_F32_MASTER
+        for n in s.node_config
+        if n.WhichOneof("synchronizer") == "AllReduceSynchronizer")
+
+
+# -- compute audit: precision-aware F006 keys --------------------------------
+
+_ALL = STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+
+
+def test_f006_precision_keys_on_all_f32_lowering():
+    report = verify_strategy(passes=_ALL, **build_f32_contraction_case())
+    assert report.ok, [str(f) for f in report.errors]
+    codes = [f.code for f in report.findings]
+    assert EXPECTED_PRECISION_CODE in codes  # F003: the bait is seen
+    f6 = next(f for f in report.findings if f.code == "F006")
+    d = f6.data
+    assert d["f32_contraction_frac"] > 0.95
+    # the plain key stays frac-free; the precision key pays the slowdown
+    assert d["predicted_mfu_ceiling_precision"] == pytest.approx(
+        d["predicted_mfu_ceiling"] / F32_CONTRACTION_SLOWDOWN, rel=0.02)
+    # every contraction lands in exactly ONE dtype bucket: the by-dtype
+    # table reconciles against realized FLOPs (the `make audit` check)
+    by_dtype = d["contraction_flops_by_dtype"]
+    assert set(by_dtype) == {"f32"}
+    assert sum(by_dtype.values()) == pytest.approx(d["realized_flops"],
+                                                   rel=1e-4)
+
+
+def test_f006_precision_keys_on_bf16_lowering():
+    """The recompute case contracts in bf16 under a master-weight policy:
+    no F003, frac ~ 0, and the precision ceiling matches the plain one —
+    'the ceiling improves under bf16-master' in gauge form."""
+    report = verify_strategy(passes=_ALL, **build_recompute_case())
+    d = next(f for f in report.findings if f.code == "F006").data
+    assert d["f32_contraction_frac"] < 0.05
+    assert d["predicted_mfu_ceiling_precision"] == pytest.approx(
+        d["predicted_mfu_ceiling"], rel=0.05)
+    assert "bf16" in d["contraction_flops_by_dtype"]
+    assert sum(d["contraction_flops_by_dtype"].values()) == pytest.approx(
+        d["realized_flops"], rel=1e-4)
+
+
+# -- remediation (the --suggest loop) ----------------------------------------
+
+def test_remediation_maps_seeded_cases_to_documented_deltas():
+    expected = {
+        EXPECTED_PRECISION_CODE: ("strategy", {"precision": "bf16_master"},
+                                  build_f32_contraction_case),
+        EXPECTED_RECOMPUTE_CODE: ("engine", {"remat": False},
+                                  build_recompute_case),
+        EXPECTED_DONATION_CODE: ("model", {"donate": True},
+                                 build_dropped_donation_case),
+    }
+    for code, (kind, knob, build) in expected.items():
+        report = verify_strategy(passes=_ALL, **build())
+        rems = {r.code: r for r in suggest_remediations(report)}
+        assert code in rems, (code, [f.code for f in report.findings])
+        assert rems[code].kind == kind
+        assert rems[code].knob == knob
+        assert rems[code].expected_gain  # quantified, never bare advice
+
+
+def test_remediation_format_and_clean_report_is_silent():
+    report = verify_strategy(passes=_ALL, **build_f32_contraction_case())
+    rems = suggest_remediations(report)
+    text = format_suggestions(rems)
+    assert 'precision="bf16_master"' in text
+    # a clean strategy yields no deltas and no rendering
+    clean = _train(SPEC_FLAT4)[0]
+    del clean
+    item = _big_item()
+    s = AllReduce(precision="bf16_master").build(item, SPEC_FLAT4)
+    rep = verify_strategy(
+        s, item, SPEC_FLAT4,
+        batch_shapes={"x": ((16, 4), "float32")},
+        hbm_bytes_per_device=16 << 30, passes=_ALL)
+    assert suggest_remediations(rep) == []
+    assert format_suggestions([]) is None
+
+
+# -- checkpoint round-trip ---------------------------------------------------
+
+def test_checkpoint_roundtrip_f32_master(tmp_path):
+    """The f32 master canonicalizes to single-device f32 on save and
+    restores both into a bf16-master session (resume == uninterrupted)
+    AND across strategies into a plain f32 replicated one."""
+    from autodist_tpu.checkpoint.saver import Saver
+
+    sess, _ = _train(SPEC_FLAT4, opt="adam", precision="bf16_master",
+                     steps=2)
+    path = str(tmp_path / "ckpt")
+    Saver(sess).save(path)
+
+    restored = Saver.restore_single_device(path)
+    for name, leaf in restored["params"].items():
+        assert leaf.dtype == np.float32  # the master, not the compute copy
+        assert leaf.shape == np.asarray(sess.params()[name]).shape
+
+    # same-mode resume: continue training == uninterrupted training
+    sess_resume, _ = _train(SPEC_FLAT4, opt="adam",
+                            precision="bf16_master", steps=2)
+    Saver(sess_resume).restore(path)
+    ref, _ = _train(SPEC_FLAT4, opt="adam", precision="bf16_master",
+                    steps=3)
+    r = np.random.RandomState(0)
+    r.randn(32, 16)
+    r.randn(16, 4)
+    batch = {"x": r.randn(32, 32).astype(np.float32),
+             "y": r.randn(32, 4).astype(np.float32)}
+    sess_resume.run(batch)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                 ref.params(), sess_resume.params())
+
+    # cross-strategy restore (bf16-master -> plain f32): the master lands
+    # as the full-precision params and training continues in f32
+    sess_repl, _ = _train(SPEC_FLAT4, opt="adam", steps=2)
+    Saver(sess_repl).restore(path)
+    sess_repl.run(batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b,
+                                                atol=BF16_MASTER_TOL),
+        ref.params(), sess_repl.params())
+
+
+# -- the live record ---------------------------------------------------------
+
+def test_live_bf16_master_record_audits_clean():
+    from autodist_tpu.simulator.cost_model import (RuntimeRecord,
+                                                   rebuild_record_case)
+
+    path = os.path.join(REPO, "records", "cpu_mesh",
+                        "gpt_tiny_AllReduce_bf16_master.json")
+    assert os.path.exists(path), "live bf16-master record missing"
+    rec = RuntimeRecord.load(path)
+    strategy, item, R = rebuild_record_case(rec)
+    assert any(
+        n.AllReduceSynchronizer.precision == _C.BF16_COMPUTE_F32_MASTER
+        for n in strategy.node_config)
+    spec = ResourceSpec.from_num_chips(R)
+    report = verify_strategy(
+        strategy, item, spec, batch_shapes={"x": ((2 * R, 4), "float32")},
+        hbm_bytes_per_device=16 << 30, passes=_ALL)
+    assert report.ok, [str(f) for f in report.errors]

@@ -503,9 +503,23 @@ def test_auto_strategy_ranks_searched_first():
 
     item = _gpt_class_item()
     auto = AutoStrategy(flops_per_example=1e9)
-    s = auto.build(item, SPEC_2NODE)
-    winner = auto.last_ranking[0][0]
-    assert "searched" in winner, auto.last_ranking[:3]
+    auto.build(item, SPEC_2NODE)
+    ranking = [name for name, _ in auto.last_ranking]
+    # the bf16_master candidate (half the param-gather wire + 2x MXU
+    # contractions) now legitimately wins this spec outright — pinned in
+    # tests/test_mixed_precision.py; the searched program must still beat
+    # every legacy TWO_LEVEL program it generalizes
+    searched = next(i for i, n in enumerate(ranking) if "searched" in n)
+    legacy = [i for i, n in enumerate(ranking)
+              if "two_level" in n and "searched" not in n
+              and "bf16_master" not in n]
+    assert legacy and searched < min(legacy), ranking[:6]
+    # and when the precision dimension is excluded, searched wins outright
+    cands = [b for b in default_candidates(SPEC_2NODE)
+             if getattr(b, "precision", "f32") == "f32"]
+    auto2 = AutoStrategy(candidates=cands, flops_per_example=1e9)
+    s = auto2.build(item, SPEC_2NODE)
+    assert "searched" in auto2.last_ranking[0][0], auto2.last_ranking[:3]
     assert any(n.AllReduceSynchronizer.schedule_ir
                for n in s.node_config
                if n.WhichOneof("synchronizer") == "AllReduceSynchronizer")

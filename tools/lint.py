@@ -107,6 +107,17 @@ codebase:
         Scoped to ``autodist_tpu/``; tools and tests name the
         directory legitimately.
 
+  AD10  a ``pallas_call`` invocation outside ``autodist_tpu/ops/pallas/``:
+        Mosaic kernel bodies live in the blessed kernel directory so the
+        deviceless AOT prover (``tools/mosaic_aot_check.py`` and the
+        ``make aot-*`` records) and the interpret-mode CPU tests cover
+        every kernel.  A kernel defined at a call site ships unlowered —
+        no TPU-lowerability proof, no interpret-mode equivalence pin —
+        and its tuning constants (block shapes, VMEM budgets) drift
+        outside the one directory the accelerator guides review.
+        Scoped to ``autodist_tpu/`` and ``tools/``; consumers import the
+        wrapped op (``autodist_tpu.ops.pallas.*``) instead.
+
 Exit code 1 when any finding is reported.
 """
 import ast
@@ -224,6 +235,17 @@ _AD09_EXEMPT = ("flight_recorder.py", "lint.py")
 def _ad09_applies(path):
     p = Path(path)
     return "autodist_tpu" in p.parts and p.name not in _AD09_EXEMPT
+
+
+# AD10 shares AD01's engine+tool scope; autodist_tpu/ops/pallas/ IS the
+# blessed Mosaic kernel directory (AOT-proved, interpret-mode-tested)
+_AD10_EXEMPT_DIR = "pallas"
+
+
+def _ad10_applies(path):
+    p = Path(path)
+    return any(part in _AD01_PARTS for part in p.parts) \
+        and _AD10_EXEMPT_DIR not in p.parts
 
 
 class Checker(ast.NodeVisitor):
@@ -476,6 +498,18 @@ class Checker(ast.NodeVisitor):
                          f"(serving/slots.py) so byte/block accounting, "
                          f"shard layout and occupancy telemetry stay "
                          f"authoritative")
+        # AD10: a pallas_call outside ops/pallas/ — Mosaic kernel bodies
+        # belong to the blessed (AOT-proved, interpret-tested) directory
+        if _ad10_applies(self.path):
+            is_pallas = (isinstance(f, ast.Name) and f.id == "pallas_call") \
+                or (isinstance(f, ast.Attribute) and f.attr == "pallas_call")
+            if is_pallas:
+                self.add(node.lineno, "AD10",
+                         "pallas_call outside autodist_tpu/ops/pallas/: "
+                         "Mosaic kernel bodies live in the blessed kernel "
+                         "directory (AOT-proved by tools/mosaic_aot_check"
+                         ".py, interpret-mode-tested on CPU); import the "
+                         "wrapped op from autodist_tpu.ops.pallas instead")
         # AD03: a shape-product inside flops-named code re-derives FLOP
         # accounting that must come from simulator/cost_model.py
         if (self._flop_ctx and self._is_prod_call(node)

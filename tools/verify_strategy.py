@@ -174,6 +174,14 @@ def main(argv=None):
                          "(F-codes): realized-vs-model FLOPs, recompute, "
                          "dtype and donation checks, predicted MFU "
                          "ceiling; every target must emit its F006 table")
+    ap.add_argument("--suggest", action="store_true",
+                    help="map each report's F-code findings to concrete "
+                         "strategy/engine deltas (analysis.remediation): "
+                         "F003 -> the bf16-master precision knob, F002 "
+                         "-> the remat policy, F004 -> the donation "
+                         "repair; implies the compute audit.  With "
+                         "--selftest, the seeded F002/F003/F004 cases "
+                         "must map to their expected deltas")
     ap.add_argument("--runtime", nargs="?", const="", default=None,
                     metavar="TRACE_DIR",
                     help="also run the RUNTIME audit tier (T-codes) "
@@ -226,11 +234,17 @@ def main(argv=None):
     from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
                                              EXPECTED_DONATION_CODE,
                                              EXPECTED_ERROR_CODES,
+                                             EXPECTED_PRECISION_CODE,
                                              EXPECTED_RECOMPUTE_CODE,
                                              build_dropped_donation_case,
+                                             build_f32_contraction_case,
                                              build_recompute_case,
                                              build_rejected_case,
                                              build_reshard_case)
+
+    if args.suggest:
+        # remediation consumes the compute audit's F-codes
+        args.compute = args.compute or not args.hlo
 
     if (args.hlo or args.compute or args.runtime is not None) \
             and args.static_only:
@@ -406,6 +420,13 @@ def main(argv=None):
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
         failed = failed or not report.ok
+        if args.suggest:
+            from autodist_tpu.analysis import (format_suggestions,
+                                               suggest_remediations)
+
+            txt = format_suggestions(suggest_remediations(report))
+            if txt:
+                print(f"  suggested deltas:\n{txt}")
         if want_p005:
             p5 = next((f for f in report.findings if f.code == "P005"),
                       None)
@@ -462,6 +483,22 @@ def main(argv=None):
                           f"from jaxpr model FLOPs {model} beyond "
                           f"tolerance")
                     failed = True
+                # precision-aware reconciliation: every contraction is
+                # attributed to exactly one dtype bucket (a bf16-master
+                # lowering's bf16 dots must not double-count back into
+                # the f32 volume), so the by-dtype totals must sum to
+                # the realized contraction FLOPs exactly
+                by_dtype = f6.data.get("contraction_flops_by_dtype", {})
+                dtype_sum = sum(by_dtype.values())
+                realized = f6.data["realized_flops"]
+                if abs(dtype_sum - realized) > \
+                        max(1.0, abs(realized)) * 1e-6 + 1.0:
+                    print(f"[ERROR] {os.path.basename(path)}: F006 "
+                          f"by-dtype contraction FLOPs {dtype_sum} do "
+                          f"not reconcile with realized {realized} "
+                          f"(precision-aware counting must attribute "
+                          f"each contraction exactly once)")
+                    failed = True
 
     for path in args.case:
         case = _load_case_file(path)
@@ -502,13 +539,23 @@ def main(argv=None):
                       f"{EXPECTED_AUDIT_ERROR_CODE}")
         if args.compute or args.hlo:
             # the seeded remat-everything case: clean under every other
-            # pass, caught ONLY by the compute audit as F002 — and the
-            # seeded bf16-stats case, whose dropped donation is F004
+            # pass, caught ONLY by the compute audit as F002 — the
+            # seeded bf16-stats case, whose dropped donation is F004 —
+            # and the seeded all-f32 MLP, whose bf16-eligible
+            # contractions are F003.  With --suggest, each case must
+            # additionally map to its expected remediation delta.
+            expected_knob = {
+                "F002": {"remat": False},
+                "F003": {"precision": "bf16_master"},
+                "F004": {"donate": True},
+            }
             for label, build, want in (
                     ("recompute", build_recompute_case,
                      EXPECTED_RECOMPUTE_CODE),
                     ("donation", build_dropped_donation_case,
-                     EXPECTED_DONATION_CODE)):
+                     EXPECTED_DONATION_CODE),
+                    ("precision", build_f32_contraction_case,
+                     EXPECTED_PRECISION_CODE)):
                 report = verify_strategy(passes=passes, **build())
                 results[f"<{label}-selftest>"] = report
                 _print_report(f"compute selftest (expected {want})",
@@ -524,6 +571,20 @@ def main(argv=None):
                 else:
                     print(f"compute selftest passed: the {label} case "
                           f"is {want}")
+                if args.suggest:
+                    from autodist_tpu.analysis import suggest_remediations
+
+                    rems = {r.code: r for r in suggest_remediations(report)}
+                    r = rems.get(want)
+                    if r is None or r.knob != expected_knob[want]:
+                        print(f"[ERROR] suggest selftest ({label}): "
+                              f"expected the {want} delta "
+                              f"{expected_knob[want]} "
+                              f"(got {r.knob if r else None})")
+                        failed = True
+                    else:
+                        print(f"suggest selftest passed: {want} -> "
+                              f"{r.action}")
         if args.regression:
             # the golden regression fixtures (tests/data/regression):
             # the seeded slow manifest must fire R001, the NaN manifest
