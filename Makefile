@@ -81,12 +81,18 @@ verify:
 # double-count against jaxpr_flops; the seeded remat case must be
 # caught as F002, the seeded all-f32 case as F003, the seeded
 # dropped-donation case as F004, and --suggest must map each to its
-# documented strategy/engine delta)
+# documented strategy/engine delta) plus the cross-rank LOCKSTEP
+# verifier (L-codes: every strategy's step expanded into per-rank
+# rendezvous traces and proven deadlock-free with its L006 trace table;
+# the seeded broken-ring case must fire exactly L003 and the seeded
+# divergent-cond case exactly L001)
 audit:
 	$(PY) tools/verify_strategy.py --hlo records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --hlo --selftest
 	$(PY) tools/verify_strategy.py --compute records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --compute --suggest --selftest
+	$(PY) tools/verify_strategy.py --lockstep records/cpu_mesh/*.json
+	$(PY) tools/verify_strategy.py --lockstep --selftest
 
 # live telemetry gate (docs/observability.md): a 5-step CPU-mesh session
 # with telemetry on must emit a schema-valid JSONL manifest with per-step

@@ -30,6 +30,11 @@ pluggable passes producing a severity-ranked :class:`Report`:
 - ``postmortem-audit`` — POSTMORTEM tier: the assembled black-box
   bundle a failure trigger dumped (nonfinite cascade origin, stall
   culprit channel, bundle completeness, unanswered signals) — P-codes
+- ``lockstep-audit`` — LOCKSTEP tier: per-rank rendezvous-trace
+  expansion of the traced jaxpr + lowered module + schedule-IR bucket
+  programs, proving the emitted schedule deadlock-free (mismatched
+  rendezvous, ordering cycles, broken ppermute rings, deadlocking
+  searched programs) — L-codes
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on
@@ -38,8 +43,9 @@ See ``docs/analysis.md``.
 """
 from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
                                           StrategyVerificationError)
-from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,  # noqa: F401
-                                          PASS_REGISTRY, POSTMORTEM_PASSES,
+from autodist_tpu.analysis.passes import (EVENT_PASSES, LOCKSTEP_PASSES,  # noqa: F401
+                                          LOWERED_PASSES, PASS_REGISTRY,
+                                          POSTMORTEM_PASSES,
                                           REGRESSION_PASSES, RUNTIME_PASSES,
                                           SERVING_PASSES, STATIC_PASSES,
                                           TRACE_PASSES)

@@ -29,6 +29,15 @@ EXPECTED_DONATION_CODE = "F004"
 # this code; tools/verify_strategy.py --suggest must map it to the
 # AllReduce(precision="bf16_master") strategy delta
 EXPECTED_PRECISION_CODE = "F003"
+# the two seeded deadlock cases for the lockstep tier
+# (``tools/verify_strategy.py --lockstep --selftest``): a ppermute whose
+# permutation mixes a forward stage-chain with a wrap edge
+# (build_ppermute_ring_case) and a rank-divergent conditional collective
+# whose branches agree on (prim, axes) but not on bytes
+# (build_divergent_cond_collective_case).  Both are clean under every
+# other pass's ERROR set and caught ONLY by the lockstep tier.
+EXPECTED_LOCKSTEP_RING_CODE = "L003"
+EXPECTED_LOCKSTEP_DIVERGENT_CODE = "L001"
 
 
 def build_rejected_case(num_chips=8):
@@ -250,6 +259,112 @@ def build_dropped_donation_case(num_chips=8):
 
     item = ModelItem(loss_fn, params, optax.adam(1e-3),
                      mutable_state=mutable)
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 16, d), "float32")},
+        hbm_bytes_per_device=16 * 1024 ** 3,
+    )
+
+
+def build_ppermute_ring_case(num_chips=8):
+    """The seeded BROKEN-RING case for the lockstep tier
+    (``tools/verify_strategy.py --lockstep --selftest``).
+
+    A hand-rolled "stage handoff" whose permutation is a forward chain
+    ``1->2->...->7`` PLUS the wrap edge ``7->0`` — but no ``0->1`` edge,
+    so it is neither a closed rotation (rank 0 sends to nobody, so the
+    cycle never closes) nor a monotone chain (the wrap edge points
+    backward).  On a real pod rank 0 posts its recv and waits forever on
+    a send from the epoch that never happens.  Every src and every dst
+    is distinct and in-range, so the C-tier bijectivity check (C010)
+    stays quiet — only the permutation-shape classifier sees it:
+    ``L003`` (:data:`EXPECTED_LOCKSTEP_RING_CODE`).  The payload is tiny
+    (256 B) so the lowered audit treats it as control-plane traffic.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    d = 64
+    params = {"w": jnp.zeros((d, d))}
+    # the bug: a "ring" that skips rank 0's send — chain + wrap edge
+    broken_perm = [(i, i + 1) for i in range(1, num_chips - 1)]
+    broken_perm.append((num_chips - 1, 0))
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w"])              # (B_local, d)
+        boundary = jnp.mean(h, axis=0, keepdims=True)  # (1, d) = 256 B
+        # deliberately raw lax.ppermute: the blessed wrapper
+        # (kernel/collectives.py validate_perm) would refuse this perm
+        nxt = jax.lax.ppermute(boundary, "replica", broken_perm)  # noqa: AD11 seeded-broken ring
+        return (jnp.mean(jnp.square(h)) + 1e-6 * jnp.mean(nxt)
+                + 1e-6 * sum(jnp.sum(jnp.square(x))
+                             for x in jax.tree.leaves(p)))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 16, d), "float32")},
+        hbm_bytes_per_device=16 * 1024 ** 3,
+    )
+
+
+def build_divergent_cond_collective_case(num_chips=8):
+    """The seeded DIVERGENT-RENDEZVOUS case for the lockstep tier
+    (``tools/verify_strategy.py --lockstep --selftest``).
+
+    A ``lax.cond`` on a device-local predicate where BOTH branches issue
+    a collective over the same axis — so the C-tier's branch-signature
+    comparison (``collective_signature`` records only (prim, axes)) sees
+    two identical signatures and C001/C002 stay silent.  But the
+    branches reduce different operand shapes: ranks taking the true
+    branch arrive at a 256 B psum rendezvous while ranks taking the
+    false branch arrive at a 128 B one — on a real pod the fused
+    all-reduce's participants disagree on the buffer and the step hangs.
+    Only the lockstep tier's per-rank event expansion sees the byte
+    divergence: ``L001`` (:data:`EXPECTED_LOCKSTEP_DIVERGENT_CODE`).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    d = 64
+    params = {"w": jnp.zeros((d, d))}
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w"])   # (B_local, d)
+        local = jnp.mean(h * h)
+        v = jnp.mean(h, axis=0)             # (d,)
+        # the bug: "sync the cheap half when my local loss is small" —
+        # both branches DO reach a pmean over "replica" (same signature,
+        # so the C-tier whitelists the fork), but over different bytes
+        pred = local > 0.5                  # varies per device
+        out = jax.lax.cond(
+            pred,
+            lambda u: jnp.sum(jax.lax.pmean(u, "replica")),
+            lambda u: jnp.sum(jax.lax.pmean(u[:d // 2], "replica")) * 2.0,
+            v)
+        return (local + 1e-6 * out
+                + 1e-6 * sum(jnp.sum(jnp.square(x))
+                             for x in jax.tree.leaves(p)))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
     spec = ResourceSpec.from_num_chips(num_chips)
     strategy = AllReduce().build(item, spec)
     return dict(

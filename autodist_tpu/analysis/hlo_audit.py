@@ -361,6 +361,7 @@ class Channel:
     group_sizes: tuple = ()     # () = any group layout acceptable
     in_scan: bool = False       # the plan ISSUES this inside the scan
     required: bool = True
+    index: int = 0              # plan position: the deterministic tie-break
     realized: float = 0.0
     matched_ops: int = 0
     group_mismatch: Optional[CollectiveOp] = None
@@ -390,12 +391,13 @@ def channels_from_plan(plan_entries) -> List[Channel]:
     demanding a match would misfire X002 (2x margin covers channels whose
     volume splits across a couple of sub-threshold collectives)."""
     chans = []
-    for e in plan_entries:
+    for i, e in enumerate(plan_entries):
         c = Channel(label=e["label"], kinds=tuple(e["kinds"]),
                     bytes=float(e["bytes"]), phase=e.get("phase", "flat"),
                     group_sizes=tuple(e.get("group_sizes", ())),
                     in_scan=bool(e.get("in_scan", False)),
-                    required=bool(e.get("required", True)))
+                    required=bool(e.get("required", True)),
+                    index=i)
         if c.bytes <= 2 * SMALL_BYTES:
             c.required = False
         chans.append(c)
@@ -448,6 +450,11 @@ def audit_collectives(ops: List[CollectiveOp], channels: List[Channel], *,
                 return (grp_ok, need > 0, fits,
                         -abs(need - op.total_bytes))
 
+            # equal-score candidates must resolve deterministically
+            # (channel name, then plan position), not by the channel
+            # list's construction order: max() keeps the FIRST maximal
+            # element, so pre-sorting pins the tie-break
+            cands.sort(key=lambda c: (c.label, c.index))
             best = max(cands, key=score)
             best.take(op)
             if op.in_loop and not best.in_scan:

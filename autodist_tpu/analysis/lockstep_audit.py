@@ -1,0 +1,680 @@
+"""Cross-rank collective lockstep verifier: the L-code tier.
+
+Every tier before this one judges the schedule from ONE rank's point of
+view — the C-tier walks a per-body jaxpr with dataflow heuristics, the
+X-tier diffs aggregate bytes, and searched schedule-IR programs are only
+grammar-validated.  None of them *proves* the property SPMD actually
+requires: that **all ranks issue a matching, consistently ordered
+rendezvous sequence**.  A bad sketch, a divergent predicate, or a broken
+``ppermute`` ring surfaces as a silent TPU hang — the worst failure mode
+the graph-transform approach is supposed to rule out by construction.
+
+This module is that prover.  It expands three independent views of the
+emitted schedule into rank-level rendezvous traces and checks them
+against each other:
+
+1. the **traced jaxpr** (per ``shard_map`` body): every collective
+   becomes an ordered ``(op, group, bytes, dtype)`` event; ``scan``
+   bodies are unrolled symbolically (trip multiplicities), ``cond``
+   branches are forked where the predicate may vary across mesh axes
+   (the same varying-axes fixpoint the C-tier runs) and the fork's
+   per-branch traces must agree event for event — the C-tier's
+   signature check only compares ``(op, axes)``, so two branches
+   issuing the *same* collective over *different* byte volumes slip
+   past it and deadlock anyway;
+2. the **lowered StableHLO** (reusing the communication audit's walker:
+   outlined call graph, loop-trip multiplicities): ``replica_groups``
+   and ``source_target_pairs`` payloads are expanded to explicit rank
+   membership and checked for rank-level consistency;
+3. the **schedule-IR phase programs** (one per bucket): each program is
+   expanded phase by phase on the concrete ``dcn x ici`` factorization —
+   the gate ``schedule_search`` runs on every candidate before pricing.
+
+  L000 INFO    audit skipped (nothing attached to expand)
+  L001 ERROR   mismatched rendezvous: ranks in one group disagree on
+               op/bytes/dtype (SPMD deadlock, culprit named)
+  L002 ERROR   ordering cycle: two rendezvous groups sharing ranks are
+               visited in opposite orders (happens-before cycle between
+               overlapped buckets)
+  L003 ERROR   invalid ppermute permutation: non-bijective (repeated
+               source/dest, out of range) or a cross-epoch ring (a
+               partial chain that wraps the axis without closing the
+               cycle — the pipeline-axis precondition)
+  L004 ERROR   schedule-IR program whose phase expansion deadlocks on
+               the concrete factorization (unknown axis, repeated axis
+               inflating the rendezvous group past the ranks that exist)
+  L005 WARNING rank-asymmetric trip counts reachable only via varying
+               predicates (a while loop with no collective inside, so
+               the C-tier's C003 stays quiet)
+  L006 INFO    machine-readable per-rank trace table
+               (``Finding.data``; lands on ``ctx.lockstep_summary``)
+
+Two seeded fixtures pin the tier's unique coverage
+(:mod:`autodist_tpu.analysis.cases`): a broken stage-boundary ring that
+evades C010/C011-as-error and is caught ONLY as L003, and a
+rank-divergent conditional collective with signature-equal branches that
+the C-tier's whitelist misses, caught ONLY as L001
+(``tools/verify_strategy.py --lockstep --selftest``).
+"""
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from autodist_tpu.analysis.jaxpr_utils import (
+    COLLECTIVE_PRIMS, aval_bytes, collective_axes, find_shard_map_bodies,
+    subjaxprs, varying_out, _as_jaxpr, _read,
+)
+from autodist_tpu.analysis.report import Finding, Severity
+
+# ranks beyond which the per-rank trace table stays symbolic (the checks
+# above it are closed-form and run regardless)
+RANK_CAP = 128
+# events kept verbatim in the L006 table
+TRACE_ROWS = 64
+
+_PAIRS_PAYLOAD_RE = re.compile(
+    r"source_target_pairs\s*=\s*dense<(.*?)>\s*:\s*tensor<(\d+)x2xi64>",
+    re.DOTALL)
+
+
+def _f(sev, code, msg, subject="", data=None):
+    return Finding(Severity(sev), code, "lockstep-audit", msg, subject,
+                   data=data)
+
+
+@dataclasses.dataclass
+class Rendezvous:
+    """One rank-level rendezvous event in a lockstep trace."""
+
+    op: str
+    axes: tuple           # participating mesh axes (jaxpr/IR view)
+    group_size: int
+    bytes: float
+    dtype: str
+    count: float = 1.0    # static multiplicity (scan trips)
+    where: str = ""
+
+    def key(self):
+        return (self.op, self.axes, round(self.bytes, 1), self.dtype)
+
+    def describe(self):
+        return (f"{self.op} over {self.axes} "
+                f"({self.bytes:.0f} B {self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# L003: permutation validity
+# ---------------------------------------------------------------------------
+
+
+def check_permutation(perm, size, where, origin="jaxpr") -> List[Finding]:
+    """Prove one ppermute permutation safe for a lockstep schedule.
+
+    Legal shapes: a union of closed cycles (ring / reverse ring /
+    rotation — sources and destinations coincide as sets), or a
+    one-directional epoch-local chain (the pipeline stage handoff
+    ``[(i, i+1) for i in range(S-1)]`` — strictly monotone, never
+    wrapping the axis).  Everything else deadlocks a multi-step ring
+    protocol or mixes epoch N+1 into epoch N across a stage boundary.
+    """
+    findings = []
+    perm = [tuple(int(x) for x in p) for p in perm]
+    if not perm:
+        return findings
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+        findings.append(_f(
+            Severity.ERROR, "L003",
+            f"non-bijective permutation in the {origin}: {tuple(perm)} "
+            f"repeats a source or destination — two peers rendezvous on "
+            f"the same device and the ring protocol deadlocks", where))
+        return findings
+    if size:
+        bad = sorted({i for i in srcs + dsts if not 0 <= i < int(size)})
+        if bad:
+            findings.append(_f(
+                Severity.ERROR, "L003",
+                f"permutation index(es) {bad} out of range for the "
+                f"{int(size)}-rank group in the {origin}: the rendezvous "
+                f"waits on ranks that do not exist", where))
+            return findings
+    if set(srcs) == set(dsts):
+        return findings     # union of closed cycles: a well-formed ring
+    directions = {d > s for s, d in perm if d != s}
+    if len(directions) > 1 or any(d == s for s, d in perm):
+        findings.append(_f(
+            Severity.ERROR, "L003",
+            f"cross-epoch ring in the {origin}: permutation "
+            f"{tuple(perm)} wraps the axis without closing the cycle — "
+            f"a stage-boundary handoff that feeds epoch N+1 data into "
+            f"epoch N; make it a closed ring (sources == destinations) "
+            f"or a one-directional stage chain", where))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# jaxpr side: symbolic per-rank trace expansion (L001, L003, L005)
+# ---------------------------------------------------------------------------
+
+
+def _group_size(axes, axis_sizes):
+    g = 1
+    for a in axes:
+        g *= int(axis_sizes.get(a, 1))
+    return g
+
+
+def _event_from_eqn(eqn, axis_sizes, where):
+    axes = tuple(collective_axes(eqn))
+    nbytes = sum(aval_bytes(v.aval) for v in eqn.invars
+                 if hasattr(v, "aval"))
+    dtype = ""
+    for v in eqn.invars:
+        dt = getattr(getattr(v, "aval", None), "dtype", None)
+        if dt is not None:
+            dtype = str(dt)
+            break
+    return Rendezvous(op=eqn.primitive.name, axes=axes,
+                      group_size=_group_size(axes, axis_sizes),
+                      bytes=float(nbytes), dtype=dtype, where=where)
+
+
+def trace_events(jaxpr, in_varying, axis_sizes, findings, stats,
+                 where="step", depth=0) -> List[Rendezvous]:
+    """Symbolically interpret one body into its ordered rendezvous trace.
+
+    Mirrors the C-tier walker's varying-axes environment so forks and
+    trip-count asymmetry are judged with the same dataflow facts, but
+    *collects* events instead of pattern-matching them."""
+    jaxpr = _as_jaxpr(jaxpr)
+    env, _ = varying_out(jaxpr, in_varying)
+    events: List[Rendezvous] = []
+    for eqn in jaxpr.eqns:
+        ins = [_read(env, a) for a in eqn.invars]
+        union = frozenset().union(*ins) if ins else frozenset()
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            ev = _event_from_eqn(eqn, axis_sizes, where)
+            if name == "ppermute":
+                findings.extend(check_permutation(
+                    eqn.params.get("perm") or (), ev.group_size,
+                    f"ppermute over {ev.axes} in {where}"))
+            events.append(ev)
+        elif name == "cond":
+            pred_varying = ins[0] if ins else frozenset()
+            branch_events = [
+                trace_events(b, ins[1:], axis_sizes, findings, stats,
+                             where=f"{where}/cond", depth=depth + 1)
+                for b in eqn.params["branches"]]
+            keys = [tuple(e.key() for e in be) for be in branch_events]
+            if len(set(keys)) > 1:
+                stats["forks"] += 1
+                if pred_varying:
+                    culprit = _first_divergence(branch_events)
+                    findings.append(_f(
+                        Severity.ERROR, "L001",
+                        f"mismatched rendezvous in {where}: cond "
+                        f"branches fork the lockstep trace and the "
+                        f"predicate may vary across mesh axes "
+                        f"{sorted(pred_varying)} — ranks taking "
+                        f"different branches meet on {culprit}; every "
+                        f"rank must issue the identical (op, group, "
+                        f"bytes, dtype) sequence", "cond"))
+            events.extend(branch_events[0])
+        elif name == "while":
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            cconsts, bconsts = ins[:cn], ins[cn:cn + bn]
+            carry = list(ins[cn + bn:])
+            for _ in range(16):
+                _, new = varying_out(eqn.params["body_jaxpr"],
+                                     list(bconsts) + carry)
+                merged = [c | n for c, n in zip(carry, new)]
+                if merged == carry:
+                    break
+                carry = merged
+            _, pred_out = varying_out(eqn.params["cond_jaxpr"],
+                                      list(cconsts) + carry)
+            pred_varying = pred_out[0] if pred_out else frozenset()
+            body_events = trace_events(
+                eqn.params["body_jaxpr"], list(bconsts) + carry,
+                axis_sizes, findings, stats, where=f"{where}/while",
+                depth=depth + 1)
+            if pred_varying and not body_events:
+                stats["varying_trip_loops"] += 1
+                findings.append(_f(
+                    Severity.WARNING, "L005",
+                    f"rank-asymmetric trip count in {where}: the while "
+                    f"predicate may vary across mesh axes "
+                    f"{sorted(pred_varying)}, so ranks run different "
+                    f"iteration counts — safe only while the body stays "
+                    f"collective-free (any collective added inside "
+                    f"becomes a deadlock the C-tier's C003 would flag)",
+                    "while"))
+            # varying predicate WITH collectives inside is C003's ERROR;
+            # the events still join the trace (counted once, trips
+            # unknown) so downstream ordering checks see them
+            events.extend(body_events)
+        elif name == "scan":
+            nc, ncar = eqn.params["num_consts"], eqn.params["num_carry"]
+            consts = ins[:nc]
+            carry = list(ins[nc:nc + ncar])
+            xs = ins[nc + ncar:]
+            body = eqn.params["jaxpr"]
+            for _ in range(16):
+                _, new = varying_out(body, list(consts) + carry + list(xs))
+                merged = [c | n for c, n in zip(carry, new[:ncar])]
+                if merged == carry:
+                    break
+                carry = merged
+            body_events = trace_events(
+                body, list(consts) + carry + list(xs), axis_sizes,
+                findings, stats, where=f"{where}/scan", depth=depth + 1)
+            trips = max(1, int(eqn.params.get("length", 1) or 1))
+            for e in body_events:
+                e.count *= trips
+            events.extend(body_events)
+        else:
+            for sub in subjaxprs(eqn):
+                sub_j = _as_jaxpr(sub)
+                if len(sub_j.invars) == len(ins):
+                    sub_in = ins
+                else:
+                    sub_in = [union] * len(sub_j.invars)
+                events.extend(trace_events(
+                    sub_j, sub_in, axis_sizes, findings, stats,
+                    where=where, depth=depth + 1))
+    return events
+
+
+def _first_divergence(branch_events):
+    """Human-readable culprit for an L001 fork: the first position where
+    the branch traces disagree."""
+    longest = max(len(be) for be in branch_events)
+    for i in range(longest):
+        evs = [be[i] if i < len(be) else None for be in branch_events]
+        keys = {e.key() if e is not None else None for e in evs}
+        if len(keys) > 1:
+            descs = [e.describe() if e is not None else "no collective"
+                     for e in evs]
+            return f"event {i}: " + " vs ".join(descs)
+    return "traces of different lengths"
+
+
+# ---------------------------------------------------------------------------
+# rank expansion + ordering (L002, L006)
+# ---------------------------------------------------------------------------
+
+
+def expand_rank_traces(events, axis_sizes,
+                       rank_cap=RANK_CAP) -> Optional[Dict[int, list]]:
+    """Expand an event sequence to per-rank traces: rank ids are
+    row-major over the mesh axes, and each event's replica groups
+    partition the ranks by their coordinates on the non-participating
+    axes.  Returns ``None`` when the mesh exceeds ``rank_cap`` (the
+    closed-form checks still ran) or has nothing to rendezvous."""
+    names = [a for a in axis_sizes]
+    sizes = [int(axis_sizes[a]) for a in names]
+    R = 1
+    for s in sizes:
+        R *= s
+    if R <= 1 or R > rank_cap:
+        return None
+    coords = []
+    for r in range(R):
+        c, rem = [], r
+        for s in reversed(sizes):
+            c.append(rem % s)
+            rem //= s
+        coords.append(tuple(reversed(c)))
+    traces: Dict[int, list] = {r: [] for r in range(R)}
+    for ei, e in enumerate(events):
+        part = [i for i, a in enumerate(names)
+                if a in e.axes and sizes[i] > 1]
+        if not part:
+            continue        # no cross-rank rendezvous (size-1 axes)
+        groups: Dict[tuple, list] = {}
+        for r in range(R):
+            key = tuple(coords[r][i] for i in range(len(names))
+                        if i not in part)
+            groups.setdefault(key, []).append(r)
+        for members in groups.values():
+            gm = tuple(members)
+            for r in gm:
+                traces[r].append((e.op, gm, e.bytes, e.dtype, ei))
+    return traces
+
+
+def check_ordering(rank_traces) -> List[Finding]:
+    """L002: a happens-before cycle — two rendezvous groups sharing at
+    least two ranks, visited in opposite orders by different ranks.
+    Each side of the cycle blocks inside its first group waiting for a
+    rank still parked in the other."""
+    findings = []
+    first: Dict[tuple, Dict[int, int]] = {}
+    for r, tr in rank_traces.items():
+        for i, ev in enumerate(tr):
+            gkey = (ev[0], ev[1])       # (op, member ranks)
+            first.setdefault(gkey, {})
+            if r not in first[gkey]:
+                first[gkey][r] = i
+    keys = sorted(first, key=str)
+    reported = set()
+    for i, ga in enumerate(keys):
+        for gb in keys[i + 1:]:
+            shared = set(first[ga]) & set(first[gb])
+            if len(shared) < 2:
+                continue
+            orders = {first[ga][r] < first[gb][r] for r in shared
+                      if first[ga][r] != first[gb][r]}
+            if len(orders) > 1 and (ga, gb) not in reported:
+                reported.add((ga, gb))
+                findings.append(_f(
+                    Severity.ERROR, "L002",
+                    f"ordering cycle between rendezvous groups "
+                    f"{ga[0]}{list(ga[1])} and {gb[0]}{list(gb[1])}: "
+                    f"ranks sharing both groups visit them in opposite "
+                    f"orders — each side blocks in its first collective "
+                    f"waiting for a rank parked in the other "
+                    f"(happens-before cycle across overlapped buckets)",
+                    f"{ga[0]}/{gb[0]}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# schedule-IR side: concrete-factorization expansion (L004)
+# ---------------------------------------------------------------------------
+
+
+def schedule_program_findings(prog, axis_sizes, where="schedule-ir",
+                              ) -> List[Finding]:
+    """Prove one schedule-IR phase program deadlock-free on a concrete
+    mesh factorization.  Grammar validity is NOT assumed — this is the
+    gate a *searched* candidate passes before pricing, so a malformed
+    program is a finding, not an exception."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    findings = []
+    try:
+        sir.validate_structure(prog)
+    except ValueError as e:
+        findings.append(_f(
+            Severity.ERROR, "L004",
+            f"malformed schedule-IR program reached the lockstep gate "
+            f"({where}): {e}", where))
+        return findings
+    for i, ph in enumerate(prog.phases):
+        missing = [a for a in ph.axes if a not in axis_sizes]
+        if missing:
+            findings.append(_f(
+                Severity.ERROR, "L004",
+                f"{where}: phase p{i} ({ph.op}) names mesh axes "
+                f"{missing} absent from the concrete factorization "
+                f"{dict(axis_sizes)} — the rendezvous addresses ranks "
+                f"that do not exist", f"p{i}"))
+            continue
+        if len(set(ph.axes)) != len(ph.axes):
+            g = sir.phase_group_size(ph, axis_sizes)
+            have = _group_size(set(ph.axes), axis_sizes)
+            findings.append(_f(
+                Severity.ERROR, "L004",
+                f"{where}: phase p{i} ({ph.op}) repeats a mesh axis in "
+                f"{ph.axes} — the phase expands to {g}-rank rendezvous "
+                f"groups but only {have} ranks exist along "
+                f"{sorted(set(ph.axes))}; every group waits on ranks "
+                f"that never arrive", f"p{i}"))
+            continue
+        if ph.op == "ppermute_ring":
+            g = int(axis_sizes[ph.axes[0]])
+            if g > 1:
+                ring = [(j, (j + 1) % g) for j in range(g)]
+                findings.extend(check_permutation(
+                    ring, g, f"{where}: phase p{i} ppermute_ring",
+                    origin="schedule-ir expansion"))
+    return findings
+
+
+def deadlock_free(prog, axis_sizes) -> bool:
+    """``schedule_search``'s gate: True iff the program's phase expansion
+    on the concrete factorization carries no L-code ERROR."""
+    return not any(f.severity is Severity.ERROR
+                   for f in schedule_program_findings(prog, axis_sizes))
+
+
+def _bucket_programs(transformer):
+    """``(bucket key, resolved phase program)`` per sync bucket — the
+    same resolution the executor applies (explicit IR > hierarchy knob),
+    skipping buckets the hierarchy pass already rejects."""
+    from autodist_tpu.kernel.synchronization.all_reduce import (
+        bucket_program)
+
+    out = []
+    for b in getattr(transformer, "buckets", ()) or ():
+        try:
+            prog = bucket_program(b, transformer.data_axes,
+                                  transformer.hier_spec)
+        except ValueError:
+            continue        # Y010 owns malformed bucket programs
+        out.append((b.key, prog))
+    return out
+
+
+def _overlap_order_findings(bucket_progs) -> List[Finding]:
+    """L002 across *overlapped* buckets: concurrent programs must visit
+    their hop classes (axis groups) in one consistent order, or the
+    interleaved collectives form a happens-before cycle."""
+    findings = []
+    orders = []
+    for key, prog in bucket_progs:
+        seq = []
+        for ph in prog.phases:
+            g = frozenset(ph.axes)
+            if g not in seq:
+                seq.append(g)
+        orders.append((key, seq))
+    for i, (ka, sa) in enumerate(orders):
+        for kb, sb in orders[i + 1:]:
+            shared = [g for g in sa if g in sb]
+            for x in range(len(shared)):
+                for y in range(x + 1, len(shared)):
+                    ga, gb = shared[x], shared[y]
+                    if sb.index(ga) > sb.index(gb):
+                        findings.append(_f(
+                            Severity.ERROR, "L002",
+                            f"overlapped buckets '{ka}' and '{kb}' "
+                            f"visit hop groups {sorted(ga)} and "
+                            f"{sorted(gb)} in opposite orders — their "
+                            f"in-flight collectives interleave into a "
+                            f"happens-before cycle; align the phase "
+                            f"programs or schedule the buckets with a "
+                            f"barrier", f"{ka}/{kb}"))
+                        break
+                else:
+                    continue
+                break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lowered-HLO side: replica_groups / source_target_pairs rank expansion
+# ---------------------------------------------------------------------------
+
+
+def _parse_int_matrix(payload, rows, cols):
+    nums = [int(x) for x in re.findall(r"-?\d+", payload)]
+    if len(nums) == 1 and rows * cols > 1:
+        nums = nums * (rows * cols)     # dense splat form
+    if len(nums) != rows * cols:
+        return None
+    return [nums[i * cols:(i + 1) * cols] for i in range(rows)]
+
+
+def lowered_rendezvous(text) -> Tuple[list, List[Finding]]:
+    """Walk a lowered module (the communication audit's call-graph and
+    loop-trip walker) and expand every collective's ``replica_groups`` /
+    ``source_target_pairs`` payload to explicit rank membership."""
+    from autodist_tpu.analysis.hlo_audit import (_GROUPS_RE, _OP_RE,
+                                                 _parse_op,
+                                                 walk_module_ops)
+
+    findings, events = [], []
+    for raw in walk_module_ops(text, _OP_RE):
+        op = _parse_op(raw.kind, raw.text, raw.trailer)
+        if op is None:
+            continue
+        groups = None
+        m = _GROUPS_RE.search(raw.text)
+        if m:
+            groups = _parse_int_matrix(m.group(1), int(m.group(2)),
+                                       int(m.group(3)))
+        if raw.kind == "collective_permute":
+            pm = _PAIRS_PAYLOAD_RE.search(raw.text)
+            if pm:
+                pairs = _parse_int_matrix(pm.group(1), int(pm.group(2)), 2)
+                if pairs:
+                    findings.extend(check_permutation(
+                        [tuple(p) for p in pairs], None,
+                        f"collective_permute in @{raw.function}",
+                        origin="lowered module"))
+        if groups:
+            seen: Dict[int, int] = {}
+            for gi, g in enumerate(groups):
+                if len(set(g)) != len(g):
+                    findings.append(_f(
+                        Severity.ERROR, "L001",
+                        f"mismatched rendezvous in the lowered module: "
+                        f"{raw.kind} in @{raw.function} repeats rank(s) "
+                        f"within replica group {g} — the rank meets "
+                        f"itself and the group never completes",
+                        raw.kind))
+                for r in g:
+                    if r in seen and seen[r] != gi:
+                        findings.append(_f(
+                            Severity.ERROR, "L001",
+                            f"mismatched rendezvous in the lowered "
+                            f"module: {raw.kind} in @{raw.function} "
+                            f"places rank {r} in two replica groups — "
+                            f"the rank cannot satisfy both rendezvous",
+                            raw.kind))
+                    seen[r] = gi
+        events.append({"kind": raw.kind, "groups": groups,
+                       "bytes": op.wire_bytes, "dtype": op.dtype,
+                       "count": raw.count, "in_loop": raw.in_loop,
+                       "function": raw.function})
+    return events, findings
+
+
+def _hlo_rank_traces(hlo_events) -> Dict[int, list]:
+    traces: Dict[int, list] = {}
+    for e in hlo_events:
+        for g in e["groups"] or []:
+            gm = tuple(g)
+            if len(gm) <= 1:
+                continue
+            for r in gm:
+                traces.setdefault(r, []).append(
+                    (e["kind"], gm, e["bytes"], e["dtype"]))
+    return traces
+
+
+# ---------------------------------------------------------------------------
+# the registered pass
+# ---------------------------------------------------------------------------
+
+
+def lockstep_audit_pass(ctx) -> List[Finding]:
+    """PASS_REGISTRY entry (``LOCKSTEP_PASSES``): expand the traced
+    jaxpr, the lowered module, and the schedule-IR bucket programs into
+    rank-level rendezvous traces and prove them deadlock-free."""
+    from autodist_tpu.analysis.hlo_audit import lowered_text_for
+
+    transformer = getattr(ctx, "transformer", None)
+    jaxpr = getattr(ctx, "jaxpr", None)
+    if transformer is None and jaxpr is None:
+        return [_f(Severity.INFO, "L000",
+                   "lockstep audit skipped: no traced step or "
+                   "GraphTransformer attached — no schedule to expand")]
+
+    findings: List[Finding] = []
+    stats = {"forks": 0, "varying_trip_loops": 0}
+    events: List[Rendezvous] = []
+    rank_counts: Dict[int, int] = {}
+    n_bodies = 0
+    if jaxpr is not None:
+        bodies = find_shard_map_bodies(jaxpr)
+        n_bodies = len(bodies)
+        for body, mesh, in_varying in bodies:
+            sizes = dict(getattr(mesh, "shape", {}) or ctx.axis_sizes)
+            body_events = trace_events(body, in_varying, sizes, findings,
+                                       stats)
+            events.extend(body_events)
+            traces = expand_rank_traces(body_events, sizes)
+            if traces is not None:
+                findings.extend(check_ordering(traces))
+                for r, tr in traces.items():
+                    rank_counts[r] = rank_counts.get(r, 0) + len(tr)
+        if not bodies:
+            sizes = dict(getattr(ctx, "axis_sizes", {}) or {})
+            top = _as_jaxpr(jaxpr)
+            events = trace_events(
+                top, [frozenset()] * len(top.invars), sizes, findings,
+                stats)
+
+    bucket_rows = []
+    if transformer is not None:
+        mesh_sizes = dict(transformer.mesh.shape)
+        progs = _bucket_programs(transformer)
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+        for key, prog in progs:
+            findings.extend(schedule_program_findings(
+                prog, mesh_sizes, where=f"bucket '{key}'"))
+            bucket_rows.append({
+                "bucket": key, "ir": sir.dumps(prog),
+                "phases": [{"op": ph.op, "axes": list(ph.axes),
+                            "group": sir.phase_group_size(ph, mesh_sizes)}
+                           for ph in prog.phases]})
+        if getattr(transformer, "sync_schedule", "") == "overlap" and \
+                len(progs) > 1:
+            findings.extend(_overlap_order_findings(progs))
+
+    hlo_events = []
+    hlo_rank_counts: Dict[int, int] = {}
+    text, source = lowered_text_for(ctx)
+    if text is not None:
+        hlo_events, hf = lowered_rendezvous(text)
+        findings.extend(hf)
+        htr = _hlo_rank_traces(hlo_events)
+        findings.extend(check_ordering(htr))
+        hlo_rank_counts = {r: len(tr) for r, tr in htr.items()}
+
+    table = {
+        "source": source or "traced jaxpr",
+        "n_bodies": n_bodies,
+        "n_events": len(events),
+        "forks": stats["forks"],
+        "varying_trip_loops": stats["varying_trip_loops"],
+        "rank_events": {str(r): n for r, n in sorted(rank_counts.items())},
+        "trace": [{"op": e.op, "axes": list(e.axes),
+                   "group": e.group_size, "bytes": round(e.bytes, 1),
+                   "dtype": e.dtype, "count": e.count}
+                  for e in events[:TRACE_ROWS]],
+        "buckets": bucket_rows,
+        "sync_schedule": getattr(transformer, "sync_schedule", "")
+        if transformer is not None else "",
+        "hlo_collectives": len(hlo_events),
+        "hlo_rank_events": {str(r): n
+                            for r, n in sorted(hlo_rank_counts.items())},
+    }
+    ctx.lockstep_summary = table
+    n_ranks = len(rank_counts) or len(hlo_rank_counts)
+    findings.append(_f(
+        Severity.INFO, "L006",
+        f"lockstep trace: {len(events)} jaxpr rendezvous event(s) over "
+        f"{n_bodies} shard_map body(ies), {len(hlo_events)} lowered "
+        f"collective(s), {len(bucket_rows)} schedule-IR bucket "
+        f"program(s), {n_ranks} rank(s) expanded; {stats['forks']} "
+        f"fork(s), {stats['varying_trip_loops']} varying-trip loop(s)",
+        "summary", data=table))
+    return findings

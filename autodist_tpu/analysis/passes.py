@@ -129,6 +129,19 @@ Finding codes (stable; tests and tools match on them):
   P004 WARNING reaction mismatch: the black box shows a signal the
                control plane never acted on before death
   P005 INFO    machine-readable bundle table (carried in Finding.data)
+  L000 INFO    lockstep audit skipped (nothing attached to expand)
+  L001 ERROR   mismatched rendezvous: ranks in one group disagree on
+               op/bytes/dtype (SPMD deadlock, culprit named)
+  L002 ERROR   ordering cycle between rendezvous groups sharing ranks
+               (happens-before cycle across overlapped buckets)
+  L003 ERROR   invalid ppermute permutation: non-bijective or a
+               cross-epoch ring (the pipeline-axis precondition)
+  L004 ERROR   schedule-IR program whose phase expansion deadlocks on
+               the concrete dcn x ici factorization
+  L005 WARNING rank-asymmetric trip counts reachable only via varying
+               predicates (collective-free loop body)
+  L006 INFO    machine-readable per-rank trace table (carried in
+               Finding.data; lands on ctx.lockstep_summary)
   TR001 ERROR  tracing the strategy's train step failed
   TR002 INFO   trace skipped (trace passes did not run)
 
@@ -160,6 +173,12 @@ POSTMORTEM tier (:mod:`autodist_tpu.analysis.postmortem_audit`): they
 judge the assembled black-box bundle a failure trigger dumped
 (:mod:`autodist_tpu.telemetry.flight_recorder`) — the root-cause pass
 for runs that did not survive to be judged by any other tier.
+The L-codes form the LOCKSTEP tier
+(:mod:`autodist_tpu.analysis.lockstep_audit`): a per-rank symbolic
+interpreter that expands the traced jaxpr, the lowered module's
+replica_groups, and the schedule-IR bucket programs into each rank's
+ordered rendezvous trace and proves the emitted schedule deadlock-free
+— the gate ``schedule_search`` runs on every candidate before pricing.
 """
 import numpy as np
 
@@ -863,6 +882,17 @@ def compute_audit_pass(ctx):
     return _run(ctx)
 
 
+def lockstep_audit_pass(ctx):
+    """Lockstep-tier pass: expand the traced jaxpr, the lowered module,
+    and the schedule-IR bucket programs into per-rank rendezvous traces
+    and prove the schedule deadlock-free
+    (:mod:`autodist_tpu.analysis.lockstep_audit`)."""
+    from autodist_tpu.analysis.lockstep_audit import \
+        lockstep_audit_pass as _run
+
+    return _run(ctx)
+
+
 def runtime_audit_pass(ctx):
     """Runtime-tier pass: the measured timeline of a ``jax.profiler``
     capture vs the intended channels and the cost estimate, plus
@@ -924,6 +954,7 @@ PASS_REGISTRY = {
     "hbm-traced": hbm_traced_pass,
     "hlo-audit": hlo_audit_pass,
     "compute-audit": compute_audit_pass,
+    "lockstep-audit": lockstep_audit_pass,
     "runtime-audit": runtime_audit_pass,
     "regression-audit": regression_audit_pass,
     "reaction-audit": reaction_audit_pass,
@@ -938,6 +969,12 @@ TRACE_PASSES = ("collectives", "donation", "hbm-traced")
 # verify_strategy(passes=...), the CLI's --hlo/--compute, the AOT verify
 # gate, and AutoStrategy's top-candidate audit
 LOWERED_PASSES = ("hlo-audit", "compute-audit")
+# the LOCKSTEP tier: per-rank rendezvous-trace expansion of the traced
+# jaxpr + lowered module + schedule-IR bucket programs, proving the
+# emitted schedule deadlock-free; opt-in via verify_strategy(passes=...),
+# the CLI's --lockstep, the runner/AOT verify gates, and the
+# schedule_search / AutoStrategy candidate gate
+LOCKSTEP_PASSES = ("lockstep-audit",)
 # passes over a MEASURED jax.profiler capture + aggregated manifests;
 # opt-in via verify_strategy(passes=..., trace_dir=...), the CLI's
 # --runtime, and the watchdog's post-capture auto-analysis

@@ -19,8 +19,9 @@ does not mask every other finding behind a trace error.
 import dataclasses
 from typing import Any, Dict, Optional
 
-from autodist_tpu.analysis.passes import (EVENT_PASSES, LOWERED_PASSES,
-                                          PASS_REGISTRY, POSTMORTEM_PASSES,
+from autodist_tpu.analysis.passes import (EVENT_PASSES, LOCKSTEP_PASSES,
+                                          LOWERED_PASSES, PASS_REGISTRY,
+                                          POSTMORTEM_PASSES,
                                           REGRESSION_PASSES, RUNTIME_PASSES,
                                           SERVING_PASSES, STATIC_PASSES,
                                           TRACE_PASSES)
@@ -60,6 +61,8 @@ class AnalysisContext:
     lowered_source: str = ""
     predicted_comm_bytes: Optional[dict] = None
     audit_summary: Optional[dict] = None
+    # the lockstep verifier's machine-readable L006 per-rank trace table
+    lockstep_summary: Optional[dict] = None
     # the compute audit's machine-readable table (the F006 payload:
     # model/realized FLOPs, per-region attribution, predicted MFU ceiling)
     compute_summary: Optional[dict] = None
@@ -230,12 +233,17 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
             report.extend(PASS_REGISTRY[name](ctx))
     trace_selected = [p for p in selected if p in TRACE_PASSES]
     lowered_selected = [p for p in selected if p in LOWERED_PASSES]
+    lockstep_selected = [p for p in selected if p in LOCKSTEP_PASSES]
     runtime_selected = [p for p in selected if p in RUNTIME_PASSES]
-    if trace_selected or lowered_selected:
+    if trace_selected or lowered_selected or lockstep_selected:
         _run_trace(ctx, report, transformer, rng)
         for name in trace_selected:
             report.extend(PASS_REGISTRY[name](ctx))
         for name in lowered_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+        # lockstep tier after the lowered tier: it expands the same
+        # trace/lowering into per-rank rendezvous traces
+        for name in lockstep_selected:
             report.extend(PASS_REGISTRY[name](ctx))
     for name in runtime_selected:
         report.extend(PASS_REGISTRY[name](ctx))
@@ -357,7 +365,8 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
 
     trace_selected = [p for p in selected if p in TRACE_PASSES]
     lowered_selected = [p for p in selected if p in LOWERED_PASSES]
-    if trace_selected or lowered_selected:
+    lockstep_selected = [p for p in selected if p in LOCKSTEP_PASSES]
+    if trace_selected or lowered_selected or lockstep_selected:
         if batch_shapes is None or model_item is None:
             report.add(Severity.INFO, "TR002", "trace",
                        "trace skipped: no batch_shapes/model given — trace "
@@ -372,6 +381,11 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         # namespaced program-evolution dump) and diffs the realized
         # collective schedule against the transformer's intended plan
         for name in lowered_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+        # lockstep tier after it: expands the traced jaxpr, the lowered
+        # module, and the schedule-IR bucket programs into per-rank
+        # rendezvous traces and proves them deadlock-free
+        for name in lockstep_selected:
             report.extend(PASS_REGISTRY[name](ctx))
 
     # runtime (measured) tier: needs no trace of its own — it consumes

@@ -270,9 +270,12 @@ def aot_compile_step(
         # static verification of the traced program against the TARGET
         # generation's HBM budget, PLUS the HLO communication audit over
         # the real TPU lowering (the realized collective schedule vs the
-        # strategy's plan — an implicit reshard is an X001 ERROR); an
-        # infeasible strategy raises here, before the minutes-long compile
-        from autodist_tpu.analysis.passes import (LOWERED_PASSES,
+        # strategy's plan — an implicit reshard is an X001 ERROR), PLUS
+        # the lockstep tier proving the real lowering's rendezvous
+        # schedule deadlock-free rank by rank; an infeasible strategy
+        # raises here, before the minutes-long compile
+        from autodist_tpu.analysis.passes import (LOCKSTEP_PASSES,
+                                                  LOWERED_PASSES,
                                                   PASS_REGISTRY,
                                                   STATIC_PASSES,
                                                   TRACE_PASSES)
@@ -291,7 +294,8 @@ def aot_compile_step(
         ctx.lowered_text = lowered.as_text()
         ctx.lowered_source = f"TPU lowering for {topology}"
         report = Report(strategy_id=strategy.id)
-        for pass_name in STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES:
+        for pass_name in (STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+                          + LOCKSTEP_PASSES):
             report.extend(PASS_REGISTRY[pass_name](ctx))
         logging.info("AOT strategy verification:\n%s", report)
         report.raise_for_errors()

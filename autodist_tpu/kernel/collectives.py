@@ -1,0 +1,78 @@
+"""Blessed construction site for collective permutations (lint AD11).
+
+A hand-built ``lax.ppermute`` permutation list is the easiest way to
+deadlock a pod: a repeated source, an off-by-one that wraps the axis
+without closing the cycle, or an index past the axis size all lower to a
+``collective_permute`` whose rendezvous some rank never joins — a silent
+hang, not an error.  So permutation construction is confined here
+(enforced by ``tools/lint.py`` rule AD11, alongside the schedule-IR ring
+executor in :mod:`autodist_tpu.kernel.synchronization.all_reduce`):
+callers take one of the validated builders below, or route an explicit
+permutation through :func:`ppermute`, which proves it against the same
+checker the lockstep tier's L003 enforces
+(:func:`autodist_tpu.analysis.lockstep_audit.check_permutation`) before
+emitting the collective.
+"""
+import jax
+
+
+def ring_perm(size, step=1):
+    """The closed rotation ring: rank ``i`` sends to ``(i + step) % size``
+    (every rank sends and receives exactly once — the shape ring
+    attention and the reduce-scatter ring executor move blocks with)."""
+    size = int(size)
+    if size < 1:
+        raise ValueError(f"ring_perm needs a positive size, got {size}")
+    step = int(step) % size
+    return [(i, (i + step) % size) for i in range(size)]
+
+
+def reverse_ring_perm(size):
+    """The closed ring rotating the other way (cotangents travel against
+    the activation ring in interleaved pipeline schedules)."""
+    return ring_perm(size, step=-1)
+
+
+def stage_chain_perm(size, reverse=False):
+    """The epoch-local stage handoff: a strictly one-directional chain
+    ``i -> i+1`` (or ``i+1 -> i``) that deliberately does NOT wrap — the
+    first/last stage has no predecessor/successor inside one epoch.
+    Wrapping a chain without closing it is exactly the cross-epoch ring
+    the lockstep tier rejects as L003."""
+    size = int(size)
+    if size < 1:
+        raise ValueError(f"stage_chain_perm needs a positive size, "
+                         f"got {size}")
+    if reverse:
+        return [(i + 1, i) for i in range(size - 1)]
+    return [(i, i + 1) for i in range(size - 1)]
+
+
+def validate_perm(perm, size=None, where="ppermute"):
+    """Raise ``ValueError`` unless ``perm`` is lockstep-safe: a union of
+    closed cycles or a one-directional stage chain, with every index in
+    range (the L003 predicate, applied at construction time)."""
+    from autodist_tpu.analysis.lockstep_audit import check_permutation
+
+    findings = check_permutation(perm, size, where)
+    if findings:
+        raise ValueError("; ".join(f.message for f in findings))
+    return [tuple(int(x) for x in p) for p in perm]
+
+
+def ppermute(x, axis_name, perm, *, size=None):
+    """``lax.ppermute`` behind the L003 validity proof.
+
+    ``size`` defaults to the bound axis size (available statically inside
+    ``shard_map``); pass it explicitly when building the call outside a
+    bound axis context."""
+    if size is None:
+        try:
+            # psum of the literal 1 folds to the bound axis size without
+            # emitting a collective (the documented static-size idiom)
+            size = int(jax.lax.psum(1, axis_name))
+        except Exception:
+            size = None     # unbound axis: bijectivity/shape checks only
+    perm = validate_perm(perm, size,
+                         where=f"ppermute over {axis_name!r}")
+    return jax.lax.ppermute(x, axis_name, perm)

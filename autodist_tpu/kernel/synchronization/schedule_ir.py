@@ -264,6 +264,16 @@ def validate(prog: ScheduleIR, data_axes: Optional[Sequence[str]] = None,
             f"schedule_ir: block codec {_CODEC_NAMES[ph.codec]} on fast hop "
             f"{ph.op}@{'+'.join(ph.axes)} — block codecs are confined to "
             f"phases whose axis group includes a DCN-class axis")
+    for ph in prog.phases:
+        if len(set(ph.axes)) != len(ph.axes):
+            # the grammar's disjointness check dedups axes WITHIN a
+            # phase, but a repeated axis inflates the phase's rendezvous
+            # group size past the ranks that exist — the L004 deadlock
+            raise ValueError(
+                f"schedule_ir: phase {ph.op}@{'+'.join(ph.axes)} repeats "
+                f"a mesh axis — each axis may appear once per phase (a "
+                f"duplicate inflates the rendezvous group past the "
+                f"existing ranks and the collective deadlocks)")
     if axis_sizes is not None:
         for ph in prog.phases:
             for a in ph.axes:

@@ -63,7 +63,12 @@ def all_to_all(x, axis_name, split_axis, concat_axis):
 
 
 def ppermute(x, axis_name, perm):
-    return jax.lax.ppermute(x, axis_name, perm)
+    """Validated collective permute: the permutation is proven
+    lockstep-safe (closed cycles or a one-directional stage chain — the
+    L003 predicate) before the collective is emitted."""
+    from autodist_tpu.kernel.collectives import ppermute as _blessed
+
+    return _blessed(x, axis_name, perm)
 
 
 def axis_index(axis_name):

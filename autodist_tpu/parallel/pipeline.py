@@ -28,6 +28,8 @@ divisible by ``num_microbatches``.
 import jax
 import jax.numpy as jnp
 
+from autodist_tpu.kernel.collectives import (ppermute, reverse_ring_perm,
+                                             ring_perm, stage_chain_perm)
 from autodist_tpu.parallel.collectives import axis_index, axis_size
 
 
@@ -120,8 +122,7 @@ def pipeline_apply(body_fn, stacked_local, x, axis_name, num_microbatches,
             micro, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
         cur = jnp.where(jnp.equal(idx, 0), feed, act)
         y = superstage(stage_params, cur)
-        nxt = jax.lax.ppermute(y, axis_name,
-                               [(i, i + 1) for i in range(S - 1)])
+        nxt = ppermute(y, axis_name, stage_chain_perm(S))
         return nxt, y
 
     act0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
@@ -296,10 +297,8 @@ def pipeline_train_loss(body_fn, loss_fn, stacked_local, x, y, axis_name,
                 carry["grads"], carry["loss"])
 
             # 4) unconditional ring hops: activations +1, cotangents -1
-            ring_a = jax.lax.ppermute(
-                a_out, axis_name, [(i, (i + 1) % S) for i in range(S)])
-            ring_c = jax.lax.ppermute(
-                c_out, axis_name, [(i, (i - 1) % S) for i in range(S)])
+            ring_a = ppermute(a_out, axis_name, ring_perm(S))
+            ring_c = ppermute(c_out, axis_name, reverse_ring_perm(S))
             return dict(stash=stash, recv_a=recv_a, recv_c=recv_c,
                         ring_a=ring_a, ring_c=ring_c, grads=grads,
                         loss=loss), None

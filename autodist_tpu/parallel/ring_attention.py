@@ -21,6 +21,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from autodist_tpu.kernel.collectives import ppermute, ring_perm
+
 
 def _online_block(q, k_blk, v_blk, bias_blk, m, l, o, scale):
     """One flash-style block update.  q:(B,Sq,H,D) k/v:(B,Sk,H,D),
@@ -63,7 +65,7 @@ def _make_ring_flash(axis_name, causal, b, h, sq, d, bq, bk, scale,
         r = jax.lax.axis_size(axis_name)
         idx = jax.lax.axis_index(axis_name)
         q_off = idx * sq
-        perm = [(i, (i + 1) % r) for i in range(r)]
+        perm = ring_perm(r)
         m0 = jnp.full((bh, sq), F._M_FLOOR, jnp.float32)
         l0 = jnp.zeros((bh, sq), jnp.float32)
         o0 = jnp.zeros((bh, sq, d), jnp.float32)
@@ -75,8 +77,8 @@ def _make_ring_flash(axis_name, causal, b, h, sq, d, bq, bk, scale,
             m, l, o = F.flash_block_update(
                 qf, k_blk, v_blk, m, l, o, q_off, blk * sq, causal=causal,
                 sm_scale=scale, block_q=bq, block_k=bk, interpret=interpret)
-            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            k_blk = ppermute(k_blk, axis_name, perm)
+            v_blk = ppermute(v_blk, axis_name, perm)
             return (k_blk, v_blk, m, l, o), None
 
         (kf, vf, m, l, o), _ = _ring(body, (kf, vf, m0, l0, o0), r)
@@ -94,7 +96,7 @@ def _make_ring_flash(axis_name, causal, b, h, sq, d, bq, bk, scale,
         r = jax.lax.axis_size(axis_name)
         idx = jax.lax.axis_index(axis_name)
         q_off = idx * sq
-        perm = [(i, (i + 1) % r) for i in range(r)]
+        perm = ring_perm(r)
         delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1)
         bias = jnp.zeros((b, sq), jnp.float32)
@@ -114,7 +116,7 @@ def _make_ring_flash(axis_name, causal, b, h, sq, d, bq, bk, scale,
             dk = dk + dk_p.astype(jnp.float32)
             dv = dv + dv_p.astype(jnp.float32)
             # gradients travel the ring WITH their K/V block
-            k_blk, v_blk, dk, dv = (jax.lax.ppermute(t, axis_name, perm)
+            k_blk, v_blk, dk, dv = (ppermute(t, axis_name, perm)
                                     for t in (k_blk, v_blk, dk, dv))
             return (k_blk, v_blk, dk, dv, dq), None
 
@@ -198,7 +200,7 @@ def ring_attention(q, k, v, axis_name, causal=False, impl="auto"):
     # the carry types must agree, so mark them varying up front (engine
     # paths run check_vma=False and never see this, bare shard_map users do)
     m0, l0, o0 = _pcast_varying((m0, l0, o0), axis_name)
-    perm = [(i, (i + 1) % R) for i in range(R)]
+    perm = ring_perm(R)
 
     def body(carry, step):
         k_blk, v_blk, m, l, o = carry
@@ -211,8 +213,8 @@ def ring_attention(q, k, v, axis_name, causal=False, impl="auto"):
             bias = jnp.where(mask, 0.0, -jnp.inf)[None, None]
         m, l, o = _online_block(q.astype(jnp.float32), k_blk.astype(jnp.float32),
                                 v_blk.astype(jnp.float32), bias, m, l, o, scale)
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        k_blk = ppermute(k_blk, axis_name, perm)
+        v_blk = ppermute(v_blk, axis_name, perm)
         return (k_blk, v_blk, m, l, o), None
 
     (k, v, m, l, o), _ = jax.lax.scan(body, (k, v, m0, l0, o0),

@@ -182,15 +182,20 @@ class AutoStrategy(StrategyBuilder):
         candidate's step and diff its realized collective schedule against
         the plan (:mod:`autodist_tpu.analysis.hlo_audit`).  A candidate
         realizing unplanned communication (X001) or dropping planned sync
-        (X002) is demoted and the next one audited.  Returns the ranking
-        with demoted candidates removed (raises when none survive).
+        (X002) is demoted and the next one audited; the lockstep tier
+        rides the same lowering, so a candidate whose rendezvous schedule
+        can deadlock — mismatched rendezvous (L001) or a schedule-IR
+        program that deadlocks on the concrete factorization (L004) — is
+        demoted the same way.  Returns the ranking with demoted
+        candidates removed (raises when none survive).
 
         The compute audit rides along on the same lowering: the winner's
         F006 table lands in ``last_compute_audit`` and its predicted MFU
         ceiling in the ``auto_strategy.predicted_mfu_ceiling`` gauge, so
         the screening pipeline prices realized-FLOP waste (recompute,
         lowering-added work) before a single step runs."""
-        from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
+        from autodist_tpu.analysis import (LOCKSTEP_PASSES, LOWERED_PASSES,
+                                           STATIC_PASSES,
                                            StrategyVerificationError,
                                            verify_strategy)
 
@@ -202,8 +207,9 @@ class AutoStrategy(StrategyBuilder):
                 strategy, model_item, resource_spec,
                 batch_shapes=self._audit_shapes,
                 hbm_bytes_per_device=self._hbm_budget,
-                passes=STATIC_PASSES + LOWERED_PASSES)
-            bad = {"X001", "X002"} & set(report.error_codes())
+                passes=STATIC_PASSES + LOWERED_PASSES + LOCKSTEP_PASSES)
+            bad = {"X001", "X002", "L001", "L004"} & \
+                set(report.error_codes())
             audit = next((f.data for f in report.findings
                           if f.code == "X006"), None)
             compute = next((f.data for f in report.findings
@@ -237,7 +243,8 @@ class AutoStrategy(StrategyBuilder):
                 return survivors
             logging.warning(
                 "AutoStrategy: demoting %s — realized collective schedule "
-                "diverges from the plan (%s): %s", name, sorted(bad),
+                "diverges from the plan or can deadlock (%s): %s",
+                name, sorted(bad),
                 "; ".join(f.message for f in report.errors))
             self.last_rejected.append((name, report))
             survivors = survivors[1:]

@@ -153,13 +153,25 @@ def search(resource_spec, *, top_k=3, measured_bandwidths=None,
     Returns the ``top_k`` scored entries (cheapest first), each a dict
     ``{ir, predicted_s, ici_bytes, dcn_bytes}``.  ``lossless_only``
     restricts the codec alphabet to codec-free programs (exact numerics).
+
+    Every candidate is proven deadlock-free on the concrete
+    ``R_dcn x R_ici`` factorization by the lockstep tier
+    (:func:`autodist_tpu.analysis.lockstep_audit.deadlock_free`) BEFORE
+    it is priced: a grammar-valid but deadlocking program (e.g. a phase
+    whose repeated axis inflates the rendezvous group past the ranks
+    that exist) never reaches the ranking.
     """
+    from autodist_tpu.analysis.lockstep_audit import deadlock_free
+
     R_dcn, R_ici = mesh_factorization(resource_spec)
     ici, dcn = resolve_bandwidths(resource_spec, measured_bandwidths,
                                   ici_gbps, dcn_gbps)
+    sizes = {AXIS_REPLICA_DCN: R_dcn, AXIS_REPLICA_ICI: R_ici}
     scored = []
     for prog in enumerate_programs(R_dcn, R_ici):
         if lossless_only and any(ph.codec for ph in prog.phases):
+            continue
+        if not deadlock_free(prog, sizes):
             continue
         scored.append(score_program(prog, R_dcn, R_ici, ici, dcn,
                                     nbytes=nbytes))

@@ -438,3 +438,82 @@ def test_ad01_exempts_xla_options_tests_and_traced_lowerings(tmp_path):
         tmp_path, "autodist_tpu/kernel/xla_options.py", bad)
     assert "AD01" not in _lint_snippet(tmp_path, "tests/test_z.py", bad)
     assert "AD01" not in _lint_snippet(tmp_path, "autodist_tpu/ok.py", ok)
+
+
+# -- golden ppermute-ring fixture (lockstep tier's lowered view) -------------
+
+
+def test_extract_ppermute_ring_golden_pin():
+    """Golden pin: a 7-step scan passing a block around the closed 8-rank
+    ring — the collective_permute comes back in_loop with the trip count,
+    and the lockstep tier proves its source_target_pairs a closed cycle."""
+    from autodist_tpu.analysis.lockstep_audit import lowered_rendezvous
+
+    txt = _fixture("ppermute_ring.stablehlo.txt")
+    (op,) = [o for o in extract_collectives(txt)
+             if o.kind == "collective_permute"]
+    assert op.in_loop and op.count == 7.0
+    assert op.pairs == 8
+    assert op.operand_bytes == 16 * 4          # the (1, 16) f32 block
+    events, findings = lowered_rendezvous(txt)
+    assert findings == []
+    (ev,) = events
+    assert (ev["kind"], ev["count"], ev["in_loop"]) == \
+        ("collective_permute", 7.0, True)
+
+
+def test_ppermute_ring_live_lowering_matches_golden():
+    """Drift check: a fresh lowering of the same ring program must parse
+    to the schedule the golden file pins (a jax upgrade changing the
+    textual format breaks HERE, not in the fixture-driven pins)."""
+    from autodist_tpu.kernel.collectives import ppermute, ring_perm
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("r",))
+
+    def body(x):
+        def step(c, _):
+            blk, acc = c
+            blk = ppermute(blk, "r", ring_perm(8))
+            return (blk, acc + blk), None
+        (blk, acc), _ = jax.lax.scan(step, (x, x), None, length=7)
+        return acc
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=P("r"), out_specs=P("r"),
+                      check_vma=False)
+    txt = jax.jit(f).trace(
+        jax.ShapeDtypeStruct((8, 16), "float32")).lower().as_text()
+    live = [(o.kind, o.in_loop, o.count, o.pairs)
+            for o in extract_collectives(txt)]
+    golden = [(o.kind, o.in_loop, o.count, o.pairs)
+              for o in extract_collectives(
+                  _fixture("ppermute_ring.stablehlo.txt"))]
+    assert live == golden
+
+
+# -- deterministic best-fit tie-break ----------------------------------------
+
+
+def test_matcher_tie_break_ignores_channel_list_order():
+    """Equal-score candidates resolve by (label, plan index), not by the
+    channel list's construction order: the op lands on 'a' either way,
+    so the X002 always names 'b'."""
+    for order in (("a", "b"), ("b", "a")):
+        chans = [_chan(label=lab) for lab in order]
+        findings = audit_collectives([_op()], chans)
+        assert [f.subject for f in findings if f.code == "X002"] == ["b"]
+
+
+def test_matcher_tie_break_falls_back_to_plan_index():
+    """Same label, same score: the earlier plan entry wins, regardless of
+    list order."""
+    c0 = _chan(label="a", index=0)
+    c1 = _chan(label="a", index=1)
+    audit_collectives([_op()], [c1, c0])
+    assert (c0.matched_ops, c1.matched_ops) == (1, 0)
+
+
+def test_channels_from_plan_records_plan_positions():
+    chans = channels_from_plan([
+        {"label": "b0", "kinds": ("all_reduce",), "bytes": 1e6},
+        {"label": "b1", "kinds": ("all_reduce",), "bytes": 1e6}])
+    assert [c.index for c in chans] == [0, 1]

@@ -1,0 +1,445 @@
+"""Cross-rank lockstep verifier (autodist_tpu/analysis/lockstep_audit.py).
+
+Covers the L003 permutation classifier and the blessed construction site
+(kernel/collectives.py), symbolic trace expansion (rank traces, ordering
+cycles, varying-trip loops), the schedule-IR deadlock gate (L004 +
+schedule_search pruning + the AutoStrategy demotion path), the two
+seeded fixtures' exact code sets, the L006 trace table, and the AD11
+lint rule.
+"""
+import importlib.util
+import os
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.analysis import (LOCKSTEP_PASSES, LOWERED_PASSES,
+                                   STATIC_PASSES, TRACE_PASSES, Severity,
+                                   StrategyVerificationError,
+                                   verify_strategy)
+from autodist_tpu.analysis.cases import (
+    EXPECTED_LOCKSTEP_DIVERGENT_CODE, EXPECTED_LOCKSTEP_RING_CODE,
+    build_divergent_cond_collective_case, build_ppermute_ring_case)
+from autodist_tpu.analysis.lockstep_audit import (
+    Rendezvous, check_ordering, check_permutation, deadlock_free,
+    expand_rank_traces, lowered_rendezvous, schedule_program_findings,
+    trace_events)
+from autodist_tpu.const import AXIS_REPLICA_DCN, AXIS_REPLICA_ICI
+from autodist_tpu.kernel.collectives import (ppermute, reverse_ring_perm,
+                                             ring_perm, stage_chain_perm,
+                                             validate_perm)
+from autodist_tpu.kernel.synchronization import schedule_ir as sir
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.proto import synchronizers_pb2
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce
+
+_C = synchronizers_pb2.AllReduceSynchronizer
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LOCKSTEP_CHAIN = STATIC_PASSES + TRACE_PASSES + LOCKSTEP_PASSES
+SPEC_2NODE = ResourceSpec(resource_info={"nodes": [
+    {"address": "10.0.0.1", "chips": [0, 1, 2, 3], "chief": True,
+     "network_bandwidth": 100},
+    {"address": "10.0.0.2", "chips": [0, 1, 2, 3],
+     "network_bandwidth": 100}]})
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# -- L003: the permutation classifier ---------------------------------------
+
+
+def test_check_permutation_accepts_lockstep_safe_shapes():
+    for perm in (ring_perm(8), reverse_ring_perm(8), ring_perm(8, step=3),
+                 stage_chain_perm(8), stage_chain_perm(8, reverse=True),
+                 [(0, 1), (1, 0)],          # closed 2-cycle on a sub-axis
+                 [(2, 5), (5, 2), (3, 4), (4, 3)],   # cycle union
+                 []):
+        assert check_permutation(perm, 8, "t") == [], perm
+
+
+def test_check_permutation_rejects_non_bijective_and_out_of_range():
+    assert _codes(check_permutation([(0, 1), (0, 2)], 8, "t")) == ["L003"]
+    assert _codes(check_permutation([(0, 2), (1, 2)], 8, "t")) == ["L003"]
+    assert _codes(check_permutation([(0, 1), (1, 9)], 8, "t")) == ["L003"]
+    # without a known size, range cannot be judged — but shape still is
+    assert check_permutation([(0, 1), (1, 9), (9, 0)], None, "t") == []
+
+
+def test_check_permutation_rejects_cross_epoch_ring():
+    # the seeded shape: a forward chain plus the wrap edge, no 0->1
+    broken = [(i, i + 1) for i in range(1, 7)] + [(7, 0)]
+    (f,) = check_permutation(broken, 8, "t")
+    assert f.code == "L003" and "cross-epoch" in f.message
+    # a self-edge inside a partial perm is equally direction-broken
+    assert _codes(check_permutation([(0, 1), (2, 2)], 8, "t")) == ["L003"]
+
+
+# -- the blessed construction site (kernel/collectives.py) -------------------
+
+
+def test_perm_builders_and_validate_perm():
+    assert ring_perm(4) == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    assert reverse_ring_perm(4) == [(0, 3), (1, 0), (2, 1), (3, 2)]
+    assert stage_chain_perm(4) == [(0, 1), (1, 2), (2, 3)]
+    assert stage_chain_perm(4, reverse=True) == [(1, 0), (2, 1), (3, 2)]
+    with pytest.raises(ValueError):
+        ring_perm(0)
+    assert validate_perm(((0.0, 1.0), (1.0, 0.0)), 2) == [(0, 1), (1, 0)]
+    with pytest.raises(ValueError, match="cross-epoch"):
+        validate_perm([(i, i + 1) for i in range(1, 7)] + [(7, 0)], 8)
+    with pytest.raises(ValueError, match="out of range"):
+        validate_perm([(0, 9)], 8)
+
+
+def test_blessed_ppermute_validates_then_rotates():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("r",))
+    P = jax.sharding.PartitionSpec
+
+    def roll(x):
+        return ppermute(x, "r", ring_perm(8))
+
+    f = jax.shard_map(roll, mesh=mesh, in_specs=P("r"), out_specs=P("r"),
+                      check_vma=False)
+    out = jax.jit(f)(jnp.arange(8, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+    def broken(x):
+        return ppermute(x, "r",
+                        [(i, i + 1) for i in range(1, 7)] + [(7, 0)])
+
+    g = jax.shard_map(broken, mesh=mesh, in_specs=P("r"),
+                      out_specs=P("r"), check_vma=False)
+    with pytest.raises(ValueError, match="cross-epoch"):
+        jax.jit(g)(jnp.arange(8, dtype=jnp.float32))
+
+
+# -- trace expansion: rank traces, ordering, varying trips -------------------
+
+
+def _ev(op="psum", axes=("i",), nbytes=1024.0, dtype="float32"):
+    return Rendezvous(op=op, axes=tuple(axes), group_size=0, bytes=nbytes,
+                      dtype=dtype)
+
+
+def test_expand_rank_traces_partitions_by_nonparticipating_axes():
+    sizes = {"d": 2, "i": 4}
+    traces = expand_rank_traces([_ev(axes=("i",)), _ev(axes=("d", "i"))],
+                                sizes)
+    assert set(traces) == set(range(8))
+    # event 0 over "i" only: two groups split by the d coordinate
+    assert traces[0][0][1] == (0, 1, 2, 3)
+    assert traces[5][0][1] == (4, 5, 6, 7)
+    # event 1 over both axes: one global group
+    assert traces[3][1][1] == tuple(range(8))
+    # a size-1 mesh has nothing to rendezvous; a huge one stays symbolic
+    assert expand_rank_traces([_ev()], {"i": 1}) is None
+    assert expand_rank_traces([_ev(axes=("r",))], {"r": 4096}) is None
+
+
+def test_check_ordering_flags_happens_before_cycle():
+    ga, gb = (0, 1), (0, 1, 2, 3)
+    consistent = {
+        0: [("ar", ga, 1.0, "f32", 0), ("ar", gb, 1.0, "f32", 1)],
+        1: [("ar", ga, 1.0, "f32", 0), ("ar", gb, 1.0, "f32", 1)],
+    }
+    assert check_ordering(consistent) == []
+    cyclic = {
+        0: [("ar", ga, 1.0, "f32", 0), ("ar", gb, 1.0, "f32", 1)],
+        1: [("ar", gb, 1.0, "f32", 1), ("ar", ga, 1.0, "f32", 0)],
+    }
+    assert _codes(check_ordering(cyclic)) == ["L002"]
+
+
+def test_trace_events_l005_varying_trip_collective_free_loop():
+    def f(x):
+        return jax.lax.while_loop(lambda c: c < jnp.sum(x),
+                                  lambda c: c + 1.0, 0.0)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,)))
+    findings, stats = [], {"forks": 0, "varying_trip_loops": 0}
+    events = trace_events(jaxpr, [frozenset({"r"})], {"r": 8}, findings,
+                          stats)
+    assert events == []
+    assert _codes(findings) == ["L005"]
+    assert stats["varying_trip_loops"] == 1
+    # a replicated predicate is rank-symmetric: no finding
+    findings2, stats2 = [], {"forks": 0, "varying_trip_loops": 0}
+    trace_events(jaxpr, [frozenset()], {"r": 8}, findings2, stats2)
+    assert findings2 == []
+
+
+def test_trace_events_scan_multiplies_counts():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("r",))
+    P = jax.sharding.PartitionSpec
+
+    def body(x):
+        def step(c, _):
+            return c + jax.lax.pmean(c, "r"), None
+        c, _ = jax.lax.scan(step, x, None, length=5)
+        return c
+
+    f = jax.shard_map(body, mesh=mesh, in_specs=P("r"), out_specs=P("r"),
+                      check_vma=False)
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((8, 4)))
+    from autodist_tpu.analysis.jaxpr_utils import find_shard_map_bodies
+
+    ((bjaxpr, bmesh, in_varying),) = find_shard_map_bodies(jaxpr)
+    findings, stats = [], {"forks": 0, "varying_trip_loops": 0}
+    events = trace_events(bjaxpr, in_varying, dict(bmesh.shape), findings,
+                          stats)
+    assert [f.code for f in findings if int(f.severity) > 0] == []
+    (ev,) = events
+    assert (ev.op, ev.count, ev.group_size) == ("psum", 5.0, 8)
+
+
+# -- schedule-IR gate (L004) -------------------------------------------------
+
+
+def _dup_axis_program():
+    """Grammar-valid (validate_structure passes) but deadlocking: the
+    repeated axis inflates the rendezvous group past the existing ranks."""
+    return sir.ScheduleIR((sir.Phase(
+        "all_reduce", (AXIS_REPLICA_ICI, AXIS_REPLICA_ICI),
+        _C.NoneCompressor),))
+
+
+def test_schedule_program_findings_l004_paths():
+    sizes = {AXIS_REPLICA_DCN: 2, AXIS_REPLICA_ICI: 4}
+    good = sir.loads(f"reduce_scatter@{AXIS_REPLICA_ICI};"
+                     f"all_reduce@{AXIS_REPLICA_DCN};"
+                     f"all_gather@{AXIS_REPLICA_ICI}")
+    assert schedule_program_findings(good, sizes) == []
+    assert deadlock_free(good, sizes)
+    ring = sir.loads(f"reduce_scatter@{AXIS_REPLICA_ICI};"
+                     f"ppermute_ring@{AXIS_REPLICA_DCN};"
+                     f"all_gather@{AXIS_REPLICA_ICI}")
+    assert deadlock_free(ring, sizes)
+
+    dup = _dup_axis_program()
+    sir.validate_structure(dup)     # the grammar alone cannot reject it
+    (f,) = schedule_program_findings(dup, sizes)
+    assert f.code == "L004" and "repeats a mesh axis" in f.message
+    assert not deadlock_free(dup, sizes)
+
+    missing = sir.loads("all_reduce@replica_xyz")
+    assert _codes(schedule_program_findings(missing, sizes)) == ["L004"]
+    malformed = sir.ScheduleIR((
+        sir.Phase("all_gather", (AXIS_REPLICA_ICI,), _C.NoneCompressor),
+        sir.Phase("reduce_scatter", (AXIS_REPLICA_ICI,),
+                  _C.NoneCompressor)))
+    (f,) = schedule_program_findings(malformed, sizes)
+    assert f.code == "L004" and "malformed" in f.message
+
+
+def test_search_gates_deadlocking_program_before_pricing(monkeypatch):
+    from autodist_tpu.strategy import schedule_search as ss
+
+    good = sir.loads(f"reduce_scatter@{AXIS_REPLICA_ICI};"
+                     f"all_reduce@{AXIS_REPLICA_DCN};"
+                     f"all_gather@{AXIS_REPLICA_ICI}")
+    bad = _dup_axis_program()
+    monkeypatch.setattr(ss, "enumerate_programs",
+                        lambda R_dcn, R_ici: [good, bad])
+    out = ss.search(SPEC_2NODE, top_k=5)
+    irs = [e["ir"] for e in out]
+    assert sir.dumps(good) in irs
+    assert sir.dumps(bad) not in irs
+
+
+def test_all_enumerated_candidates_deadlock_free():
+    from autodist_tpu.strategy.schedule_search import (enumerate_programs,
+                                                       mesh_factorization)
+
+    R_dcn, R_ici = mesh_factorization(SPEC_2NODE)
+    sizes = {AXIS_REPLICA_DCN: R_dcn, AXIS_REPLICA_ICI: R_ici}
+    progs = enumerate_programs(R_dcn, R_ici)
+    assert progs
+    for p in progs:
+        assert deadlock_free(p, sizes), sir.dumps(p)
+
+
+# -- the seeded fixtures -----------------------------------------------------
+
+
+@pytest.mark.parametrize("build,want", [
+    (build_ppermute_ring_case, EXPECTED_LOCKSTEP_RING_CODE),
+    (build_divergent_cond_collective_case,
+     EXPECTED_LOCKSTEP_DIVERGENT_CODE),
+])
+def test_seeded_fixture_fires_exactly_its_code(build, want):
+    kw = build()
+    report = verify_strategy(passes=LOCKSTEP_CHAIN, **kw)
+    assert set(report.error_codes()) == {want}
+    # and stays clean under every pre-existing tier
+    clean = verify_strategy(
+        passes=STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES, **kw)
+    assert clean.ok, clean.error_codes()
+
+
+def test_l006_table_on_a_clean_strategy():
+    params = {"w": jnp.zeros((64, 64))}
+
+    def loss_fn(p, batch):
+        h = batch["x"] @ p["w"]
+        return jnp.mean(h * h) + 1e-6 * jnp.sum(jnp.square(p["w"]))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(8)
+    report = verify_strategy(AllReduce().build(item, spec), item, spec,
+                             passes=LOCKSTEP_CHAIN,
+                             batch_shapes={"x": ((128, 64), "float32")})
+    assert report.ok
+    (l6,) = [f for f in report.findings if f.code == "L006"]
+    t = l6.data
+    assert t["n_events"] >= 1 and t["n_bodies"] >= 1
+    assert t["buckets"] and t["buckets"][0]["ir"]
+    # lockstep means every rank sees the same event count
+    assert len(set(t["rank_events"].values())) == 1
+
+
+def test_lowered_rendezvous_flags_duplicate_rank_in_group():
+    text = """\
+module @jit_f {
+  func.func public @main(%arg0: tensor<8xf32>) -> tensor<8xf32> {
+    %0 = "stablehlo.all_reduce"(%arg0) ({
+    ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+      %s = stablehlo.add %a, %b : tensor<f32>
+      stablehlo.return %s : tensor<f32>
+    }) {replica_groups = dense<[[0, 1, 1, 2]]> : tensor<1x4xi64>} \
+: (tensor<8xf32>) -> tensor<8xf32>
+    return %0 : tensor<8xf32>
+  }
+}
+"""
+    events, findings = lowered_rendezvous(text)
+    assert len(events) == 1
+    assert "L001" in _codes(findings)
+
+
+# -- AutoStrategy demotion ---------------------------------------------------
+
+
+def test_auto_strategy_demotes_lockstep_divergence():
+    """Every candidate realizes the divergent-cond rendezvous mismatch,
+    so the lockstep tier demotes the whole ranking — each rejection
+    recorded with its L001."""
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    case = build_divergent_cond_collective_case()
+    auto = AutoStrategy(
+        candidates=[AllReduce(),
+                    AllReduce(compressor="BF16Compressor")],
+        audit_batch_shapes=case["batch_shapes"])
+    with pytest.raises(StrategyVerificationError):
+        auto.build(case["model_item"], case["resource_spec"])
+    assert len(auto.last_rejected) == 2
+    for _name, rep in auto.last_rejected:
+        assert "L001" in rep.error_codes()
+
+
+def test_auto_strategy_demotes_l004_deadlocking_program(monkeypatch):
+    """A candidate whose audit reports a deadlocking schedule-IR program
+    (L004) is demoted exactly like an X001 plan divergence."""
+    import autodist_tpu.analysis as analysis
+    from autodist_tpu.analysis.report import Finding, Report
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    def fake_verify(*args, **kwargs):
+        rep = Report(strategy_id="fake")
+        rep.extend([Finding(Severity.ERROR, "L004", "lockstep-audit",
+                            "phase p0 repeats a mesh axis")])
+        return rep
+
+    monkeypatch.setattr(analysis, "verify_strategy", fake_verify)
+    params = {"w": jnp.zeros((16, 16))}
+    item = ModelItem(lambda p, b: jnp.sum(jnp.square(p["w"])), params,
+                     optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(8)
+    auto = AutoStrategy(candidates=[AllReduce()],
+                        audit_batch_shapes={"x": ((16, 16), "float32")})
+    with pytest.raises(StrategyVerificationError):
+        auto.build(item, spec)
+    ((_name, rep),) = auto.last_rejected
+    assert rep.error_codes() == ["L004"]
+
+
+# -- AD11 lint rule ----------------------------------------------------------
+
+
+def _lint_snippet(tmp_path, relpath, source):
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [code for _p, _ln, code, _m in lint.lint_file(p)]
+
+
+_AD11_RAW = ("import jax\n"
+             "y = jax.lax.ppermute(0, 'r', [(0, 1), (1, 0)])\n")
+_AD11_FROM = ("from jax.lax import ppermute\n"
+              "y = ppermute(0, 'r', [(0, 1), (1, 0)])\n")
+_AD11_LITERAL = "perm = [(0, 1), (1, 2)]\n"
+_AD11_BLESSED = ("from autodist_tpu.kernel.collectives import ppermute, "
+                 "ring_perm\n"
+                 "y = ppermute(0, 'r', ring_perm(2))\n")
+
+
+def test_ad11_flags_raw_ppermute_and_perm_literals(tmp_path):
+    assert "AD11" in _lint_snippet(
+        tmp_path, "autodist_tpu/parallel/foo.py", _AD11_RAW)
+    assert "AD11" in _lint_snippet(
+        tmp_path, "autodist_tpu/parallel/foo.py", _AD11_FROM)
+    assert "AD11" in _lint_snippet(
+        tmp_path, "tools/foo.py", _AD11_LITERAL)
+    assert "AD11" in _lint_snippet(
+        tmp_path, "autodist_tpu/parallel/collectives.py", _AD11_RAW)
+    # '# noqa' suppresses a justified raw use (the seeded fixtures)
+    assert "AD11" not in _lint_snippet(
+        tmp_path, "autodist_tpu/parallel/foo.py",
+        _AD11_RAW.replace("])\n", "])  # noqa: seeded\n"))
+
+
+def test_ad11_exempts_blessed_sites_and_wrapped_calls(tmp_path):
+    assert "AD11" not in _lint_snippet(
+        tmp_path, "autodist_tpu/kernel/collectives.py", _AD11_RAW)
+    assert "AD11" not in _lint_snippet(
+        tmp_path, "autodist_tpu/kernel/synchronization/all_reduce.py",
+        _AD11_RAW)
+    assert "AD11" not in _lint_snippet(
+        tmp_path, "autodist_tpu/analysis/lockstep_audit.py",
+        _AD11_LITERAL)
+    assert "AD11" not in _lint_snippet(tmp_path, "tests/t.py", _AD11_RAW)
+    # the blessed wrapper is a plain Name call: never flagged
+    assert "AD11" not in _lint_snippet(
+        tmp_path, "autodist_tpu/parallel/foo.py", _AD11_BLESSED)
+    # a perm built by a validated builder (Call value) is fine
+    assert "AD11" not in _lint_snippet(
+        tmp_path, "autodist_tpu/parallel/foo.py",
+        "from autodist_tpu.kernel.collectives import ring_perm\n"
+        "perm = ring_perm(8)\n")
+
+
+def test_repo_is_ad11_clean():
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    findings = []
+    for root in ("autodist_tpu", "tools"):
+        for dirpath, _dirs, files in os.walk(os.path.join(REPO, root)):
+            for f in files:
+                if f.endswith(".py"):
+                    findings += [x for x in lint.lint_file(
+                        pathlib.Path(dirpath) / f) if x[2] == "AD11"]
+    assert findings == []

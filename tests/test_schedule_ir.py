@@ -212,6 +212,47 @@ def test_resolve_schedule_ir_convention():
         AllReduce(schedule_ir="bogus@x")
 
 
+def test_resolve_schedule_ir_error_paths():
+    """Construction-time rejection of the programs the lockstep tier
+    would otherwise have to kill at the gate (L004)."""
+    # unknown phase op: the full accepted-ops table in the message
+    with pytest.raises(ValueError) as e:
+        resolve_schedule_ir("all_sum@replica")
+    assert "'reduce_scatter'" in str(e.value)
+    # a repeated axis within one phase inflates the rendezvous group
+    # past the ranks that exist — rejected by validate(), so the text
+    # form can never reach the executor (only a directly-built
+    # ScheduleIR slips past grammar into the L004 gate)
+    with pytest.raises(ValueError, match="repeats a mesh axis"):
+        resolve_schedule_ir(
+            f"all_reduce@{AXIS_REPLICA_DCN}+{AXIS_REPLICA_DCN}")
+    with pytest.raises(ValueError, match="repeats a mesh axis"):
+        resolve_schedule_ir(
+            f"reduce_scatter@{AXIS_REPLICA_ICI}+{AXIS_REPLICA_ICI};"
+            f"all_gather@{AXIS_REPLICA_ICI}+{AXIS_REPLICA_ICI}")
+    # block codec on a non-DCN hop class (the Y011 placement rule)
+    with pytest.raises(ValueError, match="fast hop|DCN-class"):
+        resolve_schedule_ir(
+            f"reduce_scatter@{AXIS_REPLICA_DCN};"
+            f"all_reduce@{AXIS_REPLICA_ICI}:EquarxInt8Compressor;"
+            f"all_gather@{AXIS_REPLICA_DCN}")
+    # raw-int codec edges: a valid enum int canonicalizes to its name,
+    # anything outside the Compressor value set enumerates the table
+    assert resolve_schedule_ir(
+        f"all_reduce@replica:{int(_C.BF16Compressor)}") == \
+        "all_reduce@replica:BF16Compressor"
+    assert resolve_schedule_ir(
+        f"all_reduce@{AXIS_REPLICA_DCN}:{int(_C.Int8Compressor)}") == \
+        f"all_reduce@{AXIS_REPLICA_DCN}:Int8Compressor"
+    assert resolve_schedule_ir(
+        f"all_reduce@replica:{int(_C.NoneCompressor)}") == \
+        "all_reduce@replica"
+    with pytest.raises(ValueError, match="accepted names/values"):
+        resolve_schedule_ir("all_reduce@replica:-1")
+    with pytest.raises(ValueError, match="accepted names/values"):
+        resolve_schedule_ir("all_reduce@replica:999")
+
+
 def test_schedule_ir_threads_proto_plans_and_round_trips():
     from autodist_tpu.kernel import partitioner as part
     from autodist_tpu.proto import strategy_pb2

@@ -118,6 +118,19 @@ codebase:
         Scoped to ``autodist_tpu/`` and ``tools/``; consumers import the
         wrapped op (``autodist_tpu.ops.pallas.*``) instead.
 
+  AD11  a raw ``lax.ppermute`` call or a hand-built permutation literal
+        (``perm = [...]``) outside the blessed permutation sites:
+        ``kernel/collectives.py`` (the validated wrapper —
+        ``ppermute``/``ring_perm``/``stage_chain_perm`` prove every
+        permutation bijective-or-chain before it ships) plus the
+        schedule-IR executor (``all_reduce.py``/``schedule_ir.py``) and
+        the lockstep verifier (``analysis/lockstep_audit.py``, which
+        classifies them).  A locally spelled permutation skips
+        ``validate_perm`` — exactly how the cross-epoch wrap edge the
+        L003 check exists for gets hand-rolled; deliberate broken rings
+        (seeded analysis fixtures) carry ``# noqa`` with a
+        justification.  Scoped to ``autodist_tpu/`` and ``tools/``.
+
 Exit code 1 when any finding is reported.
 """
 import ast
@@ -248,6 +261,23 @@ def _ad10_applies(path):
         and _AD10_EXEMPT_DIR not in p.parts
 
 
+# AD11 shares AD01's engine+tool scope; kernel/collectives.py IS the
+# validated-permutation site (path-aware: parallel/collectives.py shares
+# the basename but must route through it), the schedule-IR executor
+# derives its ring from the phase program, and the lockstep verifier
+# classifies permutations (its normalizer assigns a list-comp to `perm`)
+_AD11_EXEMPT = ("all_reduce.py", "schedule_ir.py", "lockstep_audit.py",
+                "lint.py")
+
+
+def _ad11_applies(path):
+    p = Path(path)
+    if "kernel" in p.parts and p.name == "collectives.py":
+        return False
+    return any(part in _AD01_PARTS for part in p.parts) \
+        and p.name not in _AD11_EXEMPT
+
+
 class Checker(ast.NodeVisitor):
     def __init__(self, path, source):
         self.path = path
@@ -259,6 +289,7 @@ class Checker(ast.NodeVisitor):
         self._all_names = set()  # strings listed in __all__
         self._subprocess_names = set()  # names imported from subprocess
         self._socket_names = set()      # channel-creating names from socket
+        self._lax_ppermute_names = set()  # AD11: ppermute from jax.lax
         self._flop_ctx = 0     # AD03: inside a flops-named def/assign
 
     def add(self, lineno, code, msg):
@@ -286,6 +317,8 @@ class Checker(ast.NodeVisitor):
                 self._subprocess_names.add(a.asname or a.name)
             if node.module == "socket" and a.name in _AD06_CALLS:
                 self._socket_names.add(a.asname or a.name)  # AD06 aliases
+            if node.module == "jax.lax" and a.name == "ppermute":
+                self._lax_ppermute_names.add(a.asname or a.name)  # AD11
             self._record_import(a.asname or a.name, node.lineno)
 
     def visit_Name(self, node):
@@ -380,6 +413,19 @@ class Checker(ast.NodeVisitor):
                      "schedule_ir.py + all_reduce.run_schedule) so the "
                      "Y010/Y011 well-formedness checks and the X-audit's "
                      "intended channels stay authoritative")
+        # AD11: a permutation literal spelled at the call site skips the
+        # blessed wrapper's validate_perm (closed-ring/chain proof)
+        if (_ad11_applies(self.path)
+                and isinstance(node.value, (ast.List, ast.ListComp))
+                and any(getattr(t, "id", "") == "perm"
+                        for t in node.targets)):
+            self.add(node.lineno, "AD11",
+                     "hand-built permutation literal outside kernel/"
+                     "collectives.py: build perms with ring_perm/"
+                     "reverse_ring_perm/stage_chain_perm (or pass one "
+                     "through validate_perm) so every ppermute ships "
+                     "proven closed-ring-or-chain — a local literal is "
+                     "exactly how an L003 cross-epoch wrap slips in")
         flop_target = _ad03_applies(self.path) and any(
             "flop" in getattr(t, "id", "").lower() for t in node.targets)
         self._flop_ctx += flop_target
@@ -498,6 +544,25 @@ class Checker(ast.NodeVisitor):
                          f"(serving/slots.py) so byte/block accounting, "
                          f"shard layout and occupancy telemetry stay "
                          f"authoritative")
+        # AD11: raw lax.ppermute outside the blessed permutation sites —
+        # the kernel/collectives.py wrapper validates the perm first
+        if _ad11_applies(self.path):
+            bare = (isinstance(f, ast.Attribute) and f.attr == "ppermute"
+                    and ((isinstance(f.value, ast.Name)
+                          and f.value.id == "lax")
+                         or (isinstance(f.value, ast.Attribute)
+                             and f.value.attr == "lax")))
+            from_import = (isinstance(f, ast.Name)
+                           and f.id in self._lax_ppermute_names)
+            if bare or from_import:
+                self.add(node.lineno, "AD11",
+                         "raw lax.ppermute outside kernel/collectives.py: "
+                         "route permutes through the blessed wrapper "
+                         "(autodist_tpu.kernel.collectives.ppermute) so "
+                         "validate_perm proves the permutation closed-"
+                         "ring-or-chain before it can deadlock a pod; "
+                         "'# noqa' with a justification for seeded-"
+                         "broken fixtures")
         # AD10: a pallas_call outside ops/pallas/ — Mosaic kernel bodies
         # belong to the blessed (AOT-proved, interpret-tested) directory
         if _ad10_applies(self.path):

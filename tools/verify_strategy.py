@@ -31,6 +31,14 @@ Targets:
   (the F006 table every target must emit); with ``--selftest``, the
   seeded remat-everything case must be caught as F002 and the seeded
   dropped-donation case as F004.
+- ``--lockstep`` — run the cross-rank LOCKSTEP verifier (L-codes): each
+  target's step is expanded into every rank's ordered rendezvous trace
+  (jaxpr + schedule-IR + lowered module) and proven deadlock-free —
+  mismatched rendezvous L001, ordering cycles L002, invalid permutations
+  L003, deadlocking schedule-IR L004 — and every target must emit its
+  machine-readable L006 per-rank trace table; with ``--selftest``, the
+  seeded broken-ring case must fire exactly L003 and the seeded
+  divergent-cond case exactly L001 (both clean under every other pass).
 - ``--regression`` — run the cross-run REGRESSION tier (R-codes): each
   record target is diffed against its blessed baseline in
   ``records/baselines/<name>.json`` (throughput/engine-overhead R001,
@@ -174,6 +182,12 @@ def main(argv=None):
                          "(F-codes): realized-vs-model FLOPs, recompute, "
                          "dtype and donation checks, predicted MFU "
                          "ceiling; every target must emit its F006 table")
+    ap.add_argument("--lockstep", action="store_true",
+                    help="also run the cross-rank LOCKSTEP verifier "
+                         "(L-codes): expand each strategy's step into "
+                         "every rank's ordered rendezvous trace and "
+                         "prove it deadlock-free; every target must "
+                         "emit its L006 per-rank trace table")
     ap.add_argument("--suggest", action="store_true",
                     help="map each report's F-code findings to concrete "
                          "strategy/engine deltas (analysis.remediation): "
@@ -226,30 +240,28 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     _force_cpu_devices()
-    from autodist_tpu.analysis import (EVENT_PASSES, LOWERED_PASSES,
-                                       POSTMORTEM_PASSES,
+    from autodist_tpu.analysis import (EVENT_PASSES, LOCKSTEP_PASSES,
+                                       LOWERED_PASSES, POSTMORTEM_PASSES,
                                        REGRESSION_PASSES, RUNTIME_PASSES,
                                        SERVING_PASSES, STATIC_PASSES,
                                        TRACE_PASSES, verify_strategy)
-    from autodist_tpu.analysis.cases import (EXPECTED_AUDIT_ERROR_CODE,
-                                             EXPECTED_DONATION_CODE,
-                                             EXPECTED_ERROR_CODES,
-                                             EXPECTED_PRECISION_CODE,
-                                             EXPECTED_RECOMPUTE_CODE,
-                                             build_dropped_donation_case,
-                                             build_f32_contraction_case,
-                                             build_recompute_case,
-                                             build_rejected_case,
-                                             build_reshard_case)
+    from autodist_tpu.analysis.cases import (
+        EXPECTED_AUDIT_ERROR_CODE, EXPECTED_DONATION_CODE,
+        EXPECTED_ERROR_CODES, EXPECTED_LOCKSTEP_DIVERGENT_CODE,
+        EXPECTED_LOCKSTEP_RING_CODE, EXPECTED_PRECISION_CODE,
+        EXPECTED_RECOMPUTE_CODE, build_divergent_cond_collective_case,
+        build_dropped_donation_case, build_f32_contraction_case,
+        build_ppermute_ring_case, build_recompute_case,
+        build_rejected_case, build_reshard_case)
 
     if args.suggest:
         # remediation consumes the compute audit's F-codes
         args.compute = args.compute or not args.hlo
 
-    if (args.hlo or args.compute or args.runtime is not None) \
-            and args.static_only:
-        ap.error("--hlo/--compute/--runtime need the traced step; "
-                 "drop --static-only")
+    if (args.hlo or args.compute or args.lockstep
+            or args.runtime is not None) and args.static_only:
+        ap.error("--hlo/--compute/--lockstep/--runtime need the traced "
+                 "step; drop --static-only")
 
     hbm_bytes = int(args.hbm_gib * 1024 ** 3)
     if args.device_kind:
@@ -268,6 +280,10 @@ def main(argv=None):
         passes = STATIC_PASSES + TRACE_PASSES + ("compute-audit",)
     else:
         passes = None
+    if args.lockstep:
+        base = passes if passes is not None else \
+            STATIC_PASSES + TRACE_PASSES
+        passes = base + LOCKSTEP_PASSES
     if args.runtime is not None:
         base = passes if passes is not None else \
             STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
@@ -294,6 +310,9 @@ def main(argv=None):
         from autodist_tpu.telemetry.events import load_events
 
         event_records = load_events(args.events)
+    # with the lockstep tier selected, every record target must produce
+    # its machine-readable L006 per-rank trace table
+    want_l006 = bool(passes) and "lockstep-audit" in passes
     # with a lowered compute pass selected, every record target must
     # produce its machine-readable F006 compute table
     want_f006 = bool(passes) and "compute-audit" in passes
@@ -427,6 +446,13 @@ def main(argv=None):
             txt = format_suggestions(suggest_remediations(report))
             if txt:
                 print(f"  suggested deltas:\n{txt}")
+        if want_l006:
+            l6 = next((f for f in report.findings if f.code == "L006"),
+                      None)
+            if l6 is None:
+                print(f"[ERROR] {os.path.basename(path)}: lockstep "
+                      f"verifier produced no L006 trace table")
+                failed = True
         if want_p005:
             p5 = next((f for f in report.findings if f.code == "P005"),
                       None)
@@ -585,6 +611,29 @@ def main(argv=None):
                     else:
                         print(f"suggest selftest passed: {want} -> "
                               f"{r.action}")
+        if args.lockstep:
+            # the two seeded deadlock cases: clean under every other
+            # pass, each caught by the lockstep tier as EXACTLY its own
+            # code — the broken stage-chain+wrap permutation as L003,
+            # the byte-divergent conditional collective as L001
+            for label, build, want in (
+                    ("broken-ring", build_ppermute_ring_case,
+                     EXPECTED_LOCKSTEP_RING_CODE),
+                    ("divergent-cond", build_divergent_cond_collective_case,
+                     EXPECTED_LOCKSTEP_DIVERGENT_CODE)):
+                report = verify_strategy(passes=passes, **build())
+                results[f"<lockstep-{label}-selftest>"] = report
+                _print_report(f"lockstep selftest (expected {want})",
+                              report, args.verbose)
+                got = set(report.error_codes())
+                if got != {want}:
+                    print(f"[ERROR] lockstep selftest ({label}): "
+                          f"expected exactly {{{want!r}}} as the ERROR "
+                          f"set (got {sorted(got)})")
+                    failed = True
+                else:
+                    print(f"lockstep selftest passed: the {label} case "
+                          f"is {want} and nothing else")
         if args.regression:
             # the golden regression fixtures (tests/data/regression):
             # the seeded slow manifest must fire R001, the NaN manifest
