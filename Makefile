@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check postmortem-check check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check postmortem-check fleet-check check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -163,14 +163,29 @@ postmortem-check:
 	$(PY) tools/postmortem_check.py
 	$(PY) tools/verify_strategy.py --postmortem --selftest
 
+# fleet-scale gate (docs/observability.md "Fleet tier"): a 512-worker
+# simulated cluster (production StreamPublisher per worker over the real
+# length-prefixed-JSON socket) drives the selectors-based chief — the
+# pending queue must stay bounded with zero dropped frames, snapshot p99
+# must hold within 4x the same-machine 8-worker baseline (the O(top_k)
+# read path), and the scripted cascading straggler must surface in
+# ClusterView + fire on_straggler within the MTTR budget, with a clean
+# W005-only audit; the W-code fixtures must fire W001 (saturated chief)
+# and W002 (slow detection) with a clean 512-worker control
+# (--fleet --selftest)
+fleet-check:
+	$(PY) tools/fleet_check.py
+	$(PY) tools/verify_strategy.py --fleet --selftest
+
 # the pre-merge gate: lint + strategy verification + HLO audit + live
 # telemetry + runtime timeline + live control plane + chaos drills + the
-# cross-run perf gate + the serving gate + the postmortem gate
-# (tests/test_analysis.py + test_telemetry.py + test_timeline.py +
-# test_elastic.py + test_regression_audit.py + test_stream.py +
-# test_reaction_audit.py + test_serving.py + test_flight_recorder.py +
-# test_postmortem_audit.py run the same chains, so tier-1 exercises it)
-check: lint verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check postmortem-check
+# cross-run perf gate + the serving gate + the postmortem gate + the
+# fleet-scale gate (tests/test_analysis.py + test_telemetry.py +
+# test_timeline.py + test_elastic.py + test_regression_audit.py +
+# test_stream.py + test_reaction_audit.py + test_serving.py +
+# test_flight_recorder.py + test_postmortem_audit.py + test_sketch.py +
+# test_fleet.py run the same chains, so tier-1 exercises it)
+check: lint verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check postmortem-check fleet-check
 
 clean:
 	$(MAKE) -C native clean

@@ -35,6 +35,10 @@ pluggable passes producing a severity-ranked :class:`Report`:
   programs, proving the emitted schedule deadlock-free (mismatched
   rendezvous, ordering cycles, broken ppermute rings, deadlocking
   searched programs) — L-codes
+- ``fleet-audit`` — SCALE tier: the scale report a simulated-fleet run
+  produced (``tools/fleet_check.py``) judged against the bounded-chief
+  contract (fold-in saturation, MTTR detection latency, drop budget,
+  snapshot growth vs the committed 8-worker baseline) — W-codes
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on
@@ -43,9 +47,9 @@ See ``docs/analysis.md``.
 """
 from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
                                           StrategyVerificationError)
-from autodist_tpu.analysis.passes import (EVENT_PASSES, LOCKSTEP_PASSES,  # noqa: F401
-                                          LOWERED_PASSES, PASS_REGISTRY,
-                                          POSTMORTEM_PASSES,
+from autodist_tpu.analysis.passes import (EVENT_PASSES, FLEET_PASSES,  # noqa: F401
+                                          LOCKSTEP_PASSES, LOWERED_PASSES,
+                                          PASS_REGISTRY, POSTMORTEM_PASSES,
                                           REGRESSION_PASSES, RUNTIME_PASSES,
                                           SERVING_PASSES, STATIC_PASSES,
                                           TRACE_PASSES)

@@ -1,0 +1,218 @@
+"""Fleet audit: the SCALE tier (W-codes) of the verification stack.
+
+Every other observability tier judges a run's *content*; this pass judges
+whether observability itself HELD UP under fleet load
+(docs/observability.md "Fleet tier").  Input is a *scale report* — the
+JSON `tools/fleet_check.py` assembles from a simulated-cluster run: the
+chief's self-metrics (fold-in/snapshot latency sketches, queue-depth
+series, dropped-frame counters, RSS), the drop ledger, and the scripted
+scenario's detection record (when the injected straggler became
+detectable vs when ``ClusterView`` surfaced it).
+
+  W000 INFO    fleet audit skipped (no scale report supplied)
+  W001 ERROR   chief fold-in saturation — the pending queue kept growing
+               while frames dropped; the chief is not keeping up with
+               the cluster's frame rate
+  W002 ERROR   detection latency — the scripted straggler/anomaly was
+               not surfaced in ClusterView within the MTTR budget at the
+               scenario's worker count (or never surfaced at all)
+  W003 WARNING dropped frames/events beyond budget — best-effort
+               delivery is the contract, silent-loss-at-scale is not
+  W004 WARNING chief snapshot latency growing superlinearly vs the
+               committed 8-worker baseline (records/baselines/
+               fleet_chief.json) — an O(workers) read path crept back in
+  W005 INFO    machine-readable scale table (workers, frames/s, fold-in
+               p99, memory ceiling; ``Finding.data`` — consumed by
+               ``tools/verify_strategy.py --fleet``)
+
+Ranked in the one Report alongside C/S/D/H/Y/X/F/T/R/E/Q/L/P findings.
+"""
+import json
+import os
+from typing import List
+
+from autodist_tpu.analysis.report import Finding, Severity
+
+# Detection budget (W002): the fleet MTTR gate reuses the control-plane
+# default — a straggler the chief cannot name within seconds at 512
+# workers will never be named at pod scale.
+MTTR_BUDGET_S = 5.0
+# W003: tolerated fraction of (frames + events) dropped anywhere along
+# the pipe before best-effort turns into not-actually-observing.
+DROP_BUDGET_FRAC = 0.005
+# W004: the bounded chief contract — snapshot latency at ANY worker
+# count stays within this multiple of the committed 8-worker baseline.
+SNAPSHOT_GROWTH_LIMIT = 4.0
+# W001: the last third of the queue-depth series must exceed the first
+# third by this factor (with drops) to count as saturation, not a burst.
+QUEUE_GROWTH_FACTOR = 2.0
+
+BASELINE_NAME = os.path.join("records", "baselines", "fleet_chief.json")
+
+
+def _f(sev, code, msg, subject="", data=None):
+    return Finding(Severity(sev), code, "fleet-audit", msg, subject,
+                   data=data)
+
+
+def load_scale(path):
+    """Read a scale-report JSON file."""
+    with open(path) as f:
+        scale = json.load(f)
+    if not isinstance(scale, dict):
+        raise ValueError(f"scale report {path} must hold one JSON object")
+    return scale
+
+
+def committed_baseline(root="."):
+    """The committed 8-worker chief baseline, ``None`` when absent."""
+    path = os.path.join(root, BASELINE_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _queue_growing(series):
+    """True when the tail of the depth series runs well above its head —
+    sustained growth, not a drained burst."""
+    series = [s for s in (series or ()) if isinstance(s, (int, float))]
+    if len(series) < 3:
+        # Too short to see a trend; saturation shows as a non-empty tail.
+        return bool(series) and series[-1] > 0
+    third = max(1, len(series) // 3)
+    head = sum(series[:third]) / third
+    tail = sum(series[-third:]) / third
+    return tail > 0 and tail >= QUEUE_GROWTH_FACTOR * max(head, 1.0)
+
+
+def fleet_audit(scale, *, mttr_budget_s=None, drop_budget_frac=None,
+                snapshot_growth_limit=None) -> List[Finding]:
+    """Audit one scale report; returns the W findings (W005 always last)."""
+    if not scale:
+        return [_f(Severity.INFO, "W000", "fleet audit skipped: no scale report "
+                   "supplied", "fleet")]
+    budget_s = mttr_budget_s if mttr_budget_s is not None else MTTR_BUDGET_S
+    drop_frac = (drop_budget_frac if drop_budget_frac is not None
+                 else DROP_BUDGET_FRAC)
+    growth_limit = (snapshot_growth_limit if snapshot_growth_limit is not None
+                    else SNAPSHOT_GROWTH_LIMIT)
+    findings = []
+    workers = scale.get("workers")
+    subject = f"{workers} workers" if workers else "fleet"
+    chief = scale.get("chief") or {}
+    qd = chief.get("queue_depth") or {}
+    dropped = chief.get("frames_dropped") or 0
+
+    # W001: queue depth growing while frames drop = the chief lost the race
+    if dropped and _queue_growing(qd.get("series")):
+        findings.append(_f(
+            Severity.ERROR, "W001",
+            f"chief fold-in saturation: pending queue grew to "
+            f"{qd.get('max')} (bound {qd.get('bound')}) while "
+            f"{dropped} frames dropped — the chief cannot keep up with "
+            f"this cluster's frame rate", subject,
+            data={"queue_depth": qd, "frames_dropped": dropped}))
+
+    # W002: the scripted signal must surface within the MTTR budget
+    det = scale.get("detection")
+    if det:
+        det_budget = det.get("budget_s", budget_s)
+        latency = det.get("latency_s")
+        who = det.get("addr") or f"worker {det.get('worker')}"
+        if det.get("surfaced_t") is None or latency is None:
+            findings.append(_f(
+                Severity.ERROR, "W002",
+                f"detection latency: scripted {det.get('scenario', 'fault')} "
+                f"on {who} was NEVER surfaced in ClusterView "
+                f"(budget {det_budget}s at {workers} workers)", subject,
+                data=dict(det)))
+        elif latency > det_budget:
+            findings.append(_f(
+                Severity.ERROR, "W002",
+                f"detection latency: scripted {det.get('scenario', 'fault')} "
+                f"on {who} surfaced after {latency:.2f}s — beyond the "
+                f"{det_budget}s MTTR budget at {workers} workers", subject,
+                data=dict(det)))
+
+    # W003: counted drops anywhere along the pipe, beyond budget
+    drops = dict(scale.get("drops") or {})
+    total_drops = sum(v for v in drops.values()
+                      if isinstance(v, (int, float)))
+    frames = scale.get("frames") or 0
+    frac = total_drops / max(1.0, float(frames))
+    if total_drops and frac > drop_frac:
+        findings.append(_f(
+            Severity.WARNING, "W003",
+            f"{total_drops} frames/events dropped "
+            f"({100.0 * frac:.2f}% of {frames} frames) — beyond the "
+            f"{100.0 * drop_frac:.2f}% best-effort budget", subject,
+            data={"drops": drops, "frames": frames, "frac": frac,
+                  "budget_frac": drop_frac}))
+
+    # W004: snapshot latency vs the committed 8-worker baseline
+    baseline = scale.get("baseline")
+    snap_p99 = (chief.get("snapshot_us") or {}).get("p99")
+    if (baseline and snap_p99 is not None
+            and baseline.get("snapshot_us_p99")
+            and (workers or 0) > (baseline.get("workers") or 0)):
+        allowed = baseline["snapshot_us_p99"] * growth_limit
+        if snap_p99 > allowed:
+            findings.append(_f(
+                Severity.WARNING, "W004",
+                f"chief snapshot p99 {snap_p99:.0f}us at {workers} workers "
+                f"exceeds {growth_limit:.0f}x the "
+                f"{baseline.get('workers')}-worker baseline "
+                f"({baseline['snapshot_us_p99']:.0f}us) — the bounded "
+                f"snapshot contract regressed", subject,
+                data={"snapshot_us_p99": snap_p99, "baseline": baseline,
+                      "growth_limit": growth_limit}))
+
+    flagged = [f.code for f in findings]
+    findings.append(_f(
+        Severity.INFO, "W005",
+        f"scale table: {workers} workers, "
+        f"{scale.get('frames_per_s', 0):.0f} frames/s, fold-in p99 "
+        f"{(chief.get('fold_in_us') or {}).get('p99') or 0:.1f}us, "
+        f"rss {chief.get('rss_bytes') or 0} bytes"
+        + (f"; flagged: {', '.join(flagged)}" if flagged else ""),
+        subject,
+        data={"workers": workers, "steps": scale.get("steps"),
+              "scenario": scale.get("scenario"),
+              "frames": frames, "frames_per_s": scale.get("frames_per_s"),
+              "fold_in_us": chief.get("fold_in_us"),
+              "snapshot_us": chief.get("snapshot_us"),
+              "queue_depth": {k: v for k, v in qd.items()
+                              if k != "series"},
+              "rss_bytes": chief.get("rss_bytes"),
+              "drops": drops, "detection": det,
+              "baseline": baseline, "flagged": flagged}))
+    return findings
+
+
+def scale_from_context(ctx):
+    """Resolve ``ctx.fleet_scale`` (dict, or a path to a JSON report)."""
+    scale = getattr(ctx, "fleet_scale", None)
+    if isinstance(scale, str):
+        return load_scale(scale)
+    return scale
+
+
+def fleet_audit_pass(ctx) -> List[Finding]:
+    """Registry pass: audit the context's scale report (W000 when absent)
+    and park the W005 table on ``ctx.fleet_summary``."""
+    scale = scale_from_context(ctx)
+    findings = fleet_audit(
+        scale, mttr_budget_s=getattr(ctx, "mttr_budget_s", None))
+    ctx.fleet_summary = next(
+        (f.data for f in findings if f.code == "W005"), None)
+    return findings
+
+
+def audit_fixture(scale_path, *, mttr_budget_s=None) -> List[Finding]:
+    """Audit one scale-report JSON file (the --fleet standalone target
+    and the golden --selftest fixtures)."""
+    return fleet_audit(load_scale(scale_path), mttr_budget_s=mttr_budget_s)

@@ -945,6 +945,16 @@ def postmortem_audit_pass(ctx):
     return _run(ctx)
 
 
+def fleet_audit_pass(ctx):
+    """Scale tier pass: judge whether observability held up under fleet
+    load — chief fold-in saturation, detection latency at worker count,
+    drop budgets, snapshot-latency growth
+    (:mod:`autodist_tpu.analysis.fleet_audit`)."""
+    from autodist_tpu.analysis.fleet_audit import fleet_audit_pass as _run
+
+    return _run(ctx)
+
+
 PASS_REGISTRY = {
     "sharding": sharding_pass,
     "hierarchy": hierarchy_pass,
@@ -960,6 +970,7 @@ PASS_REGISTRY = {
     "reaction-audit": reaction_audit_pass,
     "serving-audit": serving_audit_pass,
     "postmortem-audit": postmortem_audit_pass,
+    "fleet-audit": fleet_audit_pass,
 }
 
 STATIC_PASSES = ("sharding", "hierarchy", "hbm-static")
@@ -999,3 +1010,8 @@ SERVING_PASSES = ("serving-audit",)
 # the CLI's --postmortem, ElasticTrainer's dump-triggered audit, and
 # tools/postmortem_check.py
 POSTMORTEM_PASSES = ("postmortem-audit",)
+# the SCALE tier: judge a fleet-simulator run's scale report (chief
+# self-metrics, drop ledger, scripted-fault detection latency); opt-in
+# via verify_strategy(passes=..., fleet_scale=...), the CLI's --fleet,
+# and tools/fleet_check.py
+FLEET_PASSES = ("fleet-audit",)

@@ -19,9 +19,9 @@ does not mask every other finding behind a trace error.
 import dataclasses
 from typing import Any, Dict, Optional
 
-from autodist_tpu.analysis.passes import (EVENT_PASSES, LOCKSTEP_PASSES,
-                                          LOWERED_PASSES, PASS_REGISTRY,
-                                          POSTMORTEM_PASSES,
+from autodist_tpu.analysis.passes import (EVENT_PASSES, FLEET_PASSES,
+                                          LOCKSTEP_PASSES, LOWERED_PASSES,
+                                          PASS_REGISTRY, POSTMORTEM_PASSES,
                                           REGRESSION_PASSES, RUNTIME_PASSES,
                                           SERVING_PASSES, STATIC_PASSES,
                                           TRACE_PASSES)
@@ -98,6 +98,10 @@ class AnalysisContext:
     # latest bundle is taken) and the audit's P005 table
     postmortem_bundle: Any = None
     postmortem_summary: Optional[dict] = None
+    # scale (fleet) tier: the fleet-simulator run's scale report (a dict
+    # or a path to its JSON) and the audit's W005 scale table
+    fleet_scale: Any = None
+    fleet_summary: Optional[dict] = None
 
 
 def _mesh_info(strategy, resource_spec, mesh):
@@ -205,7 +209,7 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
                        mttr_budget_s=None, serving_metrics=None,
                        decode_collectives=None,
                        serving_budgets=None,
-                       postmortem_bundle=None) -> Report:
+                       postmortem_bundle=None, fleet_scale=None) -> Report:
     """Verify an already-built :class:`GraphTransformer` (the engine's
     in-session entry: the runner's ``verify=`` knob, ``aot_compile``, and
     the watchdog's post-capture analysis reuse the transformer they
@@ -223,7 +227,7 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
         serving_metrics=serving_metrics,
         decode_collectives=decode_collectives,
         serving_budgets=serving_budgets,
-        postmortem_bundle=postmortem_bundle)
+        postmortem_bundle=postmortem_bundle, fleet_scale=fleet_scale)
     ctx.transformer = transformer
     report = Report(strategy_id=getattr(transformer.strategy, "id", ""))
     selected = tuple(passes) if passes is not None else \
@@ -263,6 +267,10 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
     for name in selected:
         if name in POSTMORTEM_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
+    # scale (fleet) tier: audits the attached scale report
+    for name in selected:
+        if name in FLEET_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
     # cross-run tier last: it harvests whatever the earlier tiers left on
     # the context (F006 ceiling, X006 bytes, manifest walls/health)
     for name in selected:
@@ -279,7 +287,7 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
                     event_records=None, mttr_budget_s=None,
                     serving_metrics=None, decode_collectives=None,
                     serving_budgets=None, postmortem_bundle=None,
-                    **transformer_kwargs) -> Report:
+                    fleet_scale=None, **transformer_kwargs) -> Report:
     """Statically verify a strategy before any compile.
 
     Args:
@@ -321,6 +329,9 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         ``"postmortem-audit"`` is selected — an assembled black-box
         bundle dict or a path (bundle dir / assembled JSON / run dir
         whose latest bundle is taken).
+      fleet_scale: scale (fleet) tier input when ``"fleet-audit"`` is
+        selected — a fleet-simulator scale report dict or a path to its
+        JSON (``tools/fleet_check.py`` output).
       transformer_kwargs: forwarded to :class:`GraphTransformer`
         (``data_axes``, ``batch_spec``, ``accum_steps``, ...).
 
@@ -341,7 +352,7 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         serving_metrics=serving_metrics,
         decode_collectives=decode_collectives,
         serving_budgets=serving_budgets,
-        postmortem_bundle=postmortem_bundle)
+        postmortem_bundle=postmortem_bundle, fleet_scale=fleet_scale)
     report = Report(strategy_id=getattr(strategy, "id", ""))
 
     selected = tuple(passes) if passes is not None else \
@@ -412,6 +423,12 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
     # available for the P002 culprit join
     for name in selected:
         if name in POSTMORTEM_PASSES:
+            report.extend(PASS_REGISTRY[name](ctx))
+
+    # scale (fleet) tier: audits the attached fleet scale report (chief
+    # self-metrics, drop ledger, scripted-fault detection latency)
+    for name in selected:
+        if name in FLEET_PASSES:
             report.extend(PASS_REGISTRY[name](ctx))
 
     # cross-run (regression) tier last: it diffs whatever the earlier

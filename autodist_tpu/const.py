@@ -109,6 +109,13 @@ class ENV(Enum):
     # "<kind>@<step>[:<arg>]" — kind in {kill_worker, delay, preempt} —
     # consumed by ElasticTrainer on the CPU mesh.  Empty = no injection.
     AUTODIST_CHAOS = (lambda v: v or "",)
+    # fleet-scale observability budgets (telemetry/stream.py fleet_budget;
+    # docs/observability.md "Fleet tier"): raw strings here, validated at
+    # the resolution site so a bad value reports the full name/value table
+    # of accepted knobs.  Empty = module default.
+    AUTODIST_FLEET_HEARTBEAT_TIMEOUT_S = (lambda v: v or "",)
+    AUTODIST_FLEET_MAX_FRAME_BYTES = (lambda v: v or "",)
+    AUTODIST_FLEET_QUEUE_BOUND = (lambda v: v or "",)
     SYS_DATA_PATH = (lambda v: v or "",)
     SYS_RESOURCE_PATH = (lambda v: v or "",)
 

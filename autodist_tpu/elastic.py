@@ -184,7 +184,7 @@ class ElasticTrainer:
                  optimizer, *, checkpoint_dir, distribute_kwargs=None,
                  verify_restore=True, chaos=None, max_replans=8,
                  on_straggler=None, on_anomaly=None, event_log=None,
-                 mttr_budget_s=None):
+                 mttr_budget_s=None, heartbeat_timeout_s=None):
         from autodist_tpu.autodist import AutoDist
         from autodist_tpu.cluster import Cluster
 
@@ -215,12 +215,21 @@ class ElasticTrainer:
         self._anomaly_streak = {}     # check -> consecutive signals
         self.anomaly_signals = 0
         self._poison_next = False     # armed by the nan@N chaos event
-        from autodist_tpu.telemetry.events import ClusterEventLog
+        from autodist_tpu.telemetry.events import ClusterEventLog, \
+            PendingCauses
+        from autodist_tpu.telemetry.stream import fleet_budget
 
         self.event_log = event_log if event_log is not None \
             else ClusterEventLog()
         self.mttr_budget_s = mttr_budget_s
-        self._pending_causes = {}     # (signal, subject) -> cause token
+        # instance override: ctor arg > AUTODIST_FLEET_HEARTBEAT_TIMEOUT_S
+        # env > the class default (fleet scenarios need tighter budgets)
+        if heartbeat_timeout_s is not None:
+            self.HEARTBEAT_TIMEOUT_S = float(heartbeat_timeout_s)
+        elif ENV.AUTODIST_FLEET_HEARTBEAT_TIMEOUT_S.val:
+            self.HEARTBEAT_TIMEOUT_S = fleet_budget("heartbeat_timeout_s")
+        # bounded: a chief that never answers must not grow this map
+        self._pending_causes = PendingCauses()
         self._stale_seen = set()      # workers already flagged E004-stale
         self._events_run_dir = None   # run dir holding the event mirror
         self._self_worker = 0         # this process's stream worker index

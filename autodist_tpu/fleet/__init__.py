@@ -1,0 +1,17 @@
+"""Fleet-scale harness: a synthetic cluster driving the REAL control plane.
+
+ROADMAP item 5 / docs/observability.md "Fleet tier".  Everything else in
+the repo is verified on 4-8 process CPU meshes; this package provides the
+scale dimension: :class:`~autodist_tpu.fleet.simulator.FleetSimulator`
+drives hundreds of synthetic workers through the real length-prefixed
+telemetry socket (heartbeats, membership epochs, step walls) under
+scripted fault/traffic scenarios
+(:mod:`~autodist_tpu.fleet.scenarios`), while the chief under test is the
+production :class:`~autodist_tpu.telemetry.stream.TelemetryCollector` /
+``ClusterView`` pair.  The W-code scale audit
+(:mod:`autodist_tpu.analysis.fleet_audit`) judges the resulting scale
+report; ``tools/fleet_check.py`` / ``make fleet-check`` is the gate.
+"""
+from .scenarios import (SCENARIOS, ScenarioScript, build_scenario,  # noqa: F401
+                        load_scenario)
+from .simulator import FleetSimulator  # noqa: F401

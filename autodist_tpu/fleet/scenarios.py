@@ -1,0 +1,170 @@
+"""Scripted fleet scenarios: deterministic fault/traffic scripts.
+
+A scenario *script* is a plain JSON-able dict — writable to a file,
+loadable with :func:`load_scenario`, reproducible from its ``seed`` — that
+tells the simulator what to inject per (worker, virtual step):
+
+- ``stragglers``  — ``{worker, start_step, factor}``: from ``start_step``
+  on, the worker's step walls are multiplied by ``factor`` (the live-skew
+  T002 signal the chief must surface);
+- ``preemptions`` — ``{worker, step, down_steps}``: the worker's stream
+  drops at ``step`` and rejoins ``down_steps`` later with a bumped
+  membership epoch (a new connection + ``epoch`` gauge);
+- ``blackouts``   — ``{worker, start_step, steps}``: the worker sends
+  NOTHING (no heartbeats either) for the window — the heartbeat-gap
+  surface;
+- ``load``        — ``{period_steps, amplitude}``: a diurnal wall-time
+  swing shared by every worker.
+
+The four stock generators (:data:`SCENARIOS`) mirror the failure shapes
+named by ROADMAP item 5: cascading stragglers, rolling preemptions,
+diurnal load, heartbeat blackouts.  All randomness is owned by the
+caller-supplied seed; two builds with one seed are identical scripts.
+"""
+import json
+import math
+import random
+
+__all__ = ["SCENARIOS", "ScenarioScript", "build_scenario", "load_scenario",
+           "cascading_stragglers", "rolling_preemptions", "diurnal_load",
+           "heartbeat_blackout"]
+
+
+def cascading_stragglers(workers, *, seed=0, start_step=4, count=None,
+                         every=2, factor=3.0):
+    """One worker degrades, then its neighbors follow — the cascade shape
+    where a rack's shared switch saturates one host at a time."""
+    rng = random.Random(seed)
+    count = count if count is not None else max(1, workers // 128)
+    first = rng.randrange(workers)
+    stragglers = [{"worker": (first + i) % workers,
+                   "start_step": start_step + i * every,
+                   "factor": factor} for i in range(count)]
+    return {"name": "cascading_stragglers", "workers": workers, "seed": seed,
+            "stragglers": stragglers, "preemptions": [], "blackouts": [],
+            "load": None}
+
+
+def rolling_preemptions(workers, *, seed=0, start_step=3, every=2,
+                        batch=None, down_steps=2):
+    """Batches of workers preempted in waves (spot reclaim / maintenance
+    drain), each rejoining with a bumped membership epoch."""
+    rng = random.Random(seed)
+    batch = batch if batch is not None else max(1, workers // 64)
+    pool = list(range(workers))
+    rng.shuffle(pool)
+    preemptions = []
+    for i, w in enumerate(pool[:batch * 3]):
+        preemptions.append({"worker": w,
+                            "step": start_step + (i // batch) * every,
+                            "down_steps": down_steps})
+    return {"name": "rolling_preemptions", "workers": workers, "seed": seed,
+            "stragglers": [], "preemptions": preemptions, "blackouts": [],
+            "load": None}
+
+
+def diurnal_load(workers, *, seed=0, period_steps=16, amplitude=0.5):
+    """Cluster-wide sinusoidal wall-time swing (traffic follows the sun)."""
+    return {"name": "diurnal_load", "workers": workers, "seed": seed,
+            "stragglers": [], "preemptions": [], "blackouts": [],
+            "load": {"period_steps": period_steps, "amplitude": amplitude}}
+
+
+def heartbeat_blackout(workers, *, seed=0, start_step=4, duration_steps=4,
+                       count=None):
+    """A clique of workers goes fully silent (network partition) then
+    returns — the stale-worker / heartbeat-gap surface."""
+    rng = random.Random(seed)
+    count = count if count is not None else max(1, workers // 64)
+    chosen = rng.sample(range(workers), min(count, workers))
+    blackouts = [{"worker": w, "start_step": start_step,
+                  "steps": duration_steps} for w in chosen]
+    return {"name": "heartbeat_blackout", "workers": workers, "seed": seed,
+            "stragglers": [], "preemptions": [], "blackouts": blackouts,
+            "load": None}
+
+
+SCENARIOS = {
+    "cascading_stragglers": cascading_stragglers,
+    "rolling_preemptions": rolling_preemptions,
+    "diurnal_load": diurnal_load,
+    "heartbeat_blackout": heartbeat_blackout,
+}
+
+
+def build_scenario(name, workers, *, seed=0, **kwargs):
+    """Build a stock scenario script by name (see :data:`SCENARIOS`)."""
+    try:
+        gen = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown scenario {name!r}; accepted names: "
+            + ", ".join(sorted(SCENARIOS))) from None
+    return gen(workers, seed=seed, **kwargs)
+
+
+def load_scenario(path):
+    """Read a scenario script from a JSON file."""
+    with open(path) as f:
+        script = json.load(f)
+    if not isinstance(script, dict):
+        raise ValueError(f"scenario file {path} must hold one JSON object")
+    return script
+
+
+class ScenarioScript:
+    """Query wrapper over a scenario script dict: what happens to worker
+    ``w`` at virtual step ``s``?"""
+
+    def __init__(self, script=None):
+        script = script or {}
+        self.script = script
+        self.name = script.get("name", "idle")
+        self._stragglers = list(script.get("stragglers") or ())
+        self._load = script.get("load")
+        self._blackout_windows = {}
+        for b in script.get("blackouts") or ():
+            self._blackout_windows.setdefault(b["worker"], []).append(
+                (b["start_step"], b["start_step"] + b["steps"]))
+        self._preempt_at = {}
+        self._rejoin_at = {}
+        for p in script.get("preemptions") or ():
+            down = p.get("down_steps", 2)
+            self._preempt_at.setdefault(p["step"], []).append(p["worker"])
+            self._rejoin_at.setdefault(p["step"] + down, []).append(
+                p["worker"])
+        self._down = set()
+
+    def wall_multiplier(self, worker, step):
+        m = 1.0
+        if self._load:
+            period = max(1, self._load.get("period_steps", 16))
+            amp = self._load.get("amplitude", 0.5)
+            m *= 1.0 + amp * (0.5 + 0.5 * math.sin(
+                2.0 * math.pi * step / period))
+        for s in self._stragglers:
+            if s["worker"] == worker and step >= s["start_step"]:
+                m *= s["factor"]
+        return m
+
+    def is_straggling(self, worker, step):
+        return any(s["worker"] == worker and step >= s["start_step"]
+                   for s in self._stragglers)
+
+    def first_straggler(self):
+        """The earliest-starting straggler entry (the MTTR subject)."""
+        if not self._stragglers:
+            return None
+        return min(self._stragglers, key=lambda s: s["start_step"])
+
+    def blackout(self, worker, step):
+        return any(lo <= step < hi
+                   for lo, hi in self._blackout_windows.get(worker, ()))
+
+    def preempt_now(self, step):
+        """Workers whose stream drops at this step."""
+        return self._preempt_at.get(step, [])
+
+    def rejoin_now(self, step):
+        """Workers rejoining (epoch + 1) at this step."""
+        return self._rejoin_at.get(step, [])

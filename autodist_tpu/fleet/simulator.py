@@ -1,0 +1,135 @@
+"""Fleet cluster simulator: synthetic workers over the REAL wire protocol.
+
+Each simulated worker is a production
+:class:`~autodist_tpu.telemetry.stream.StreamPublisher` — the same bounded
+queue + sender thread + length-prefixed-JSON socket client the training
+session uses — so the chief under test sees real connections, real
+``hello`` handshakes, real heartbeats, and real membership-epoch bumps,
+not a mock.  The scenario script decides what each worker reports per
+*virtual* step (walls are synthetic; wall-clock only paces the stream), so
+a 512-worker hour-long failure cascade replays in seconds.
+
+The run's return value is the send-side half of the scale report consumed
+by ``tools/fleet_check.py`` and the W-code audit: frames sent/dropped,
+reconnects, and the injection timestamps (when the scripted straggler
+became *detectable*) that anchor the W002 detection-latency measurement.
+"""
+import random
+import time
+
+from ..telemetry.stream import _MIN_SKEW_STEPS, _RECENT_WALLS, StreamPublisher
+from .scenarios import ScenarioScript
+
+__all__ = ["FleetSimulator"]
+
+
+class FleetSimulator:
+    """Drive ``workers`` synthetic workers against a collector address.
+
+    ``scenario`` is a script dict (see :mod:`~autodist_tpu.fleet.scenarios`)
+    or ``None`` for an idle, healthy cluster.  All jitter derives from
+    ``seed``; two runs with one seed publish identical wall series.
+    """
+
+    def __init__(self, address, workers=64, scenario=None, seed=0,
+                 base_wall_s=0.05, jitter=0.05, heartbeat_every=4,
+                 step_period_s=0.0, publisher_queue=256,
+                 close_timeout_s=1.0):
+        self.address = address
+        self.workers = workers
+        self.script = (scenario if isinstance(scenario, ScenarioScript)
+                       else ScenarioScript(scenario))
+        self.seed = seed
+        self.base_wall_s = base_wall_s
+        self.jitter = jitter
+        self.heartbeat_every = max(1, heartbeat_every)
+        self.step_period_s = step_period_s
+        self.publisher_queue = publisher_queue
+        self.close_timeout_s = close_timeout_s
+        self._epochs = {}
+
+    # -- internals --------------------------------------------------------
+    def _publisher(self, w):
+        return StreamPublisher(self.address, worker=w, addr=f"sim-{w}",
+                               maxsize=self.publisher_queue)
+
+    def _wall(self, rng, w, step):
+        jitter = 1.0 + self.jitter * rng.uniform(-1.0, 1.0)
+        return self.base_wall_s * self.script.wall_multiplier(w, step) * jitter
+
+    # -- the run ----------------------------------------------------------
+    def run(self, steps=16):
+        """Publish ``steps`` virtual steps from every worker; returns the
+        send-side scale stats."""
+        script = self.script
+        rngs = {w: random.Random((self.seed << 20) ^ w)
+                for w in range(self.workers)}
+        pubs = {w: self._publisher(w) for w in range(self.workers)}
+        for w in pubs:
+            self._epochs[w] = 0
+        down = set()
+        reconnects = 0
+        heartbeats = 0
+        # The MTTR subject: the scripted straggler becomes *detectable*
+        # once enough slow steady-state walls fill its recent-wall window
+        # to flip the upper median (half the window, floor _MIN_SKEW_STEPS).
+        subject = script.first_straggler()
+        armed_after = max(_MIN_SKEW_STEPS, _RECENT_WALLS // 2)
+        slow_sent = 0
+        first_sent_t = None
+        armed_t = None
+        t0 = time.time()
+        for step in range(steps):
+            for w in script.preempt_now(step):
+                if w in pubs and w not in down:
+                    pubs[w].close(timeout_s=self.close_timeout_s)
+                    down.add(w)
+            for w in script.rejoin_now(step):
+                if w in down:
+                    down.discard(w)
+                    self._epochs[w] += 1
+                    reconnects += 1
+                    pubs[w] = self._publisher(w)
+                    pubs[w].publish({"kind": "gauge", "name": "epoch",
+                                     "value": self._epochs[w],
+                                     "t": time.time()})
+            for w, pub in pubs.items():
+                if w in down or script.blackout(w, step):
+                    continue
+                wall = self._wall(rngs[w], w, step)
+                pub.publish({"kind": "step", "step": step, "wall_s": wall,
+                             "t": time.time()})
+                if (subject is not None and w == subject["worker"]
+                        and step >= subject["start_step"] and step > 0):
+                    slow_sent += 1
+                    now = time.time()
+                    if first_sent_t is None:
+                        first_sent_t = now
+                    if slow_sent == armed_after:
+                        armed_t = now
+                if step % self.heartbeat_every == 0:
+                    pub.publish({"kind": "heartbeat", "t": time.time()})
+                    heartbeats += 1
+            if self.step_period_s:
+                time.sleep(self.step_period_s)
+        for pub in pubs.values():
+            pub.close(timeout_s=self.close_timeout_s)
+        elapsed = max(1e-9, time.time() - t0)
+        sent = sum(p.sent for p in pubs.values())
+        dropped = sum(p.dropped for p in pubs.values())
+        dead = sum(1 for p in pubs.values() if p.dead)
+        injected = None
+        if subject is not None:
+            injected = dict(subject)
+            injected["addr"] = f"sim-{subject['worker']}"
+            injected["first_sent_t"] = first_sent_t
+            injected["armed_t"] = armed_t
+        return {
+            "workers": self.workers, "steps": steps,
+            "scenario": script.name, "seed": self.seed,
+            "frames_sent": sent, "frames_dropped": dropped,
+            "publishers_dead": dead, "reconnects": reconnects,
+            "heartbeats": heartbeats, "elapsed_s": elapsed,
+            "frames_per_s": sent / elapsed,
+            "injected": {"straggler": injected},
+        }

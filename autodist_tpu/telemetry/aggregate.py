@@ -91,10 +91,9 @@ def _parse_lines(path):
     return records, skipped
 
 
-def _median(xs):
-    xs = sorted(xs)
-    n = len(xs)
-    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+# Exact medians live in sketch.py (lint AD12 confines percentile sorts
+# in telemetry/ to that one module).
+from .sketch import median_of as _median  # noqa: E402
 
 
 def estimate_clock_offsets(per_worker, stats=None):

@@ -45,6 +45,58 @@ def make_cause(signal, *, worker=None, step=None, code=None, t=None):
             "code": code, "t": time.time() if t is None else t}
 
 
+def _count(name, value=1):
+    """Best-effort facade counter (no-op when telemetry is disabled)."""
+    try:
+        from autodist_tpu.telemetry import counter
+        counter(name, value)
+    except Exception:  # pragma: no cover - never let accounting raise
+        pass
+
+
+class PendingCauses:
+    """Bounded (signal, subject) -> cause-token map with drop-and-count.
+
+    The control loop parks a cause here when it fires a signal and pops it
+    when the chief answers with an action.  A chief that never answers
+    (dead, saturated, partitioned) must not grow this map without bound:
+    at ``maxlen`` the OLDEST pending cause is evicted and counted
+    (``dropped`` + the ``events.pending_dropped`` facade counter) — the
+    newest signal's causality is the one worth keeping for the eventual
+    postmortem.
+    """
+
+    def __init__(self, maxlen=1024):
+        self.maxlen = maxlen
+        self.dropped = 0
+        self._d = {}
+
+    def setdefault(self, key, cause):
+        if key in self._d:
+            return self._d[key]
+        if len(self._d) >= self.maxlen:
+            self._d.pop(next(iter(self._d)))
+            self.dropped += 1
+            _count("events.pending_dropped")
+        self._d[key] = cause
+        return cause
+
+    def pop(self, key, default=None):
+        return self._d.pop(key, default)
+
+    def get(self, key, default=None):
+        return self._d.get(key, default)
+
+    def __len__(self):
+        return len(self._d)
+
+    def __contains__(self, key):
+        return key in self._d
+
+    def __bool__(self):
+        return bool(self._d)
+
+
 class ClusterEventLog:
     """Append-only causal event log, optionally mirrored to JSONL.
 
@@ -53,10 +105,23 @@ class ClusterEventLog:
     on disk (size-capped by the writer's own rotation).
     """
 
-    def __init__(self, writer=None, maxlen=4096):
+    def __init__(self, writer=None, maxlen=4096, sample_workers_threshold=64,
+                 sample_keep=4, sample_every=8):
         self._events = deque(maxlen=maxlen)
         self._writer = writer
         self.dropped = 0
+        # Fleet-scale sampling (docs/observability.md "Fleet tier"): past
+        # ``sample_workers_threshold`` distinct signalling workers, each
+        # (signal, worker) group keeps its first ``sample_keep`` records
+        # then one in ``sample_every`` — skipped records are counted
+        # (``sampled_out`` + per-record tallies), never silently lost.
+        self.sample_workers_threshold = sample_workers_threshold
+        self.sample_keep = sample_keep
+        self.sample_every = sample_every
+        self.sampled_out = 0
+        self._signal_workers = set()
+        self._group_counts = {}
+        self._group_skipped = {}
 
     @property
     def mirrored(self):
@@ -81,13 +146,35 @@ class ClusterEventLog:
                     persistent=False, **fields):
         """Record a signal event; returns its cause token for the action."""
         cause = make_cause(signal, worker=worker, step=step, code=code)
+        if not self._sample_admit(signal, worker):
+            return cause
         rec = {"kind": "cluster_event", "event": "signal",
                "signal": signal, "worker": worker, "step": step,
                "code": code, "persistent": bool(persistent),
                "t": cause["t"]}
+        skipped = self._group_skipped.pop((signal, worker), 0)
+        if skipped:
+            rec["sampled_out"] = skipped
         rec.update(fields)
         self._append(rec)
         return cause
+
+    def _sample_admit(self, signal, worker):
+        """Fleet-scale signal sampling: True when this signal should get a
+        full log record.  The cause token is ALWAYS returned to the caller
+        regardless — sampling trims the log, never the control loop."""
+        self._signal_workers.add(worker)
+        group = (signal, worker)
+        n = self._group_counts.get(group, 0) + 1
+        self._group_counts[group] = n
+        if len(self._signal_workers) <= self.sample_workers_threshold:
+            return True
+        if n <= self.sample_keep or n % self.sample_every == 0:
+            return True
+        self.sampled_out += 1
+        self._group_skipped[group] = self._group_skipped.get(group, 0) + 1
+        _count("events.signals_sampled_out")
+        return False
 
     def record(self, event, *, step=None, cause=None, latency_s=None,
                **fields):

@@ -47,10 +47,9 @@ def _std(xs, mean):
     return math.sqrt(sum((x - mean) ** 2 for x in xs) / len(xs))
 
 
-def _median(xs):
-    xs = sorted(xs)
-    n = len(xs)
-    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+# Exact medians live in sketch.py (lint AD12 confines percentile sorts
+# in telemetry/ to that one module).
+from .sketch import median_of as _median  # noqa: E402
 
 
 class HealthMonitor:

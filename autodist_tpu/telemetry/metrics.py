@@ -23,6 +23,8 @@ import threading
 import time
 from collections import deque
 
+from .sketch import quantiles_of
+
 # raw-event ring capacity (per registry) and per-histogram reservoir cap
 DEFAULT_RING_CAPACITY = 4096
 DEFAULT_HIST_CAPACITY = 1024
@@ -35,15 +37,12 @@ def _label_key(labels):
 
 def percentiles(values, qs=(0.5, 0.9, 0.99)):
     """Nearest-rank percentiles of ``values`` (no numpy needed, but exact
-    enough for step-time reporting); returns {q: value}."""
-    if not values:
-        return {q: None for q in qs}
-    xs = sorted(values)
-    out = {}
-    for q in qs:
-        idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
-        out[q] = xs[idx]
-    return out
+    enough for step-time reporting); returns {q: value}.
+
+    Delegates to the one blessed percentile implementation (lint AD12
+    confines percentile sorts in telemetry/ to sketch.py).
+    """
+    return quantiles_of(values, qs)
 
 
 class MetricsRegistry:

@@ -1,0 +1,209 @@
+"""Mergeable bounded quantile sketches + the blessed exact-percentile helpers.
+
+The chief-side hot paths (``ClusterView`` fold-in, manifest merge) must not
+re-sort every worker's wall series per snapshot once clusters reach fleet
+scale (docs/observability.md "Fleet tier").  This module is the ONE
+implementation both sides share:
+
+- :class:`QuantileSketch` — a deterministic log-bucketed histogram sketch.
+  Values land in geometric bins (growth :data:`GROWTH`), so memory is
+  bounded by the dynamic range (a few hundred sparse bins for
+  nanoseconds..hours) and *merge is exact bin-wise addition* — associative
+  and commutative by construction, which is what lets per-worker sketches
+  fold into cluster aggregates in any arrival order.  Quantiles come back
+  within :data:`REL_ERROR` relative error, clamped to the exact observed
+  ``[min, max]`` (single-sample and all-equal inputs are exact).
+- exact helpers (:func:`median_of`, :func:`upper_median`,
+  :func:`quantiles_of`) for small bounded series (e.g. an 8-deep recent-wall
+  deque) where an exact sort is cheaper than a sketch.
+
+Lint rule AD12 confines exact-percentile ``sorted()`` /
+``statistics.quantiles`` computations inside ``autodist_tpu/telemetry`` to
+this file; every other telemetry module delegates here.
+"""
+import math
+
+# Geometric bin growth.  A value in bin i is known to within one bin edge,
+# i.e. within sqrt(GROWTH) ~ 2.5% of its reported representative.
+GROWTH = 1.05
+_LOG_GROWTH = math.log(GROWTH)
+
+# Values at or below this magnitude share the "tiny" bin; quantiles for
+# them report the exact observed minimum.
+MIN_TRACKED = 1e-9
+
+# Documented worst-case relative quantile error (tests pin against this).
+REL_ERROR = 0.05
+
+
+# -- exact helpers for small bounded series ----------------------------------
+
+def median_of(xs):
+    """Exact statistical median (mean of middle two when even); ``None``
+    on empty input."""
+    xs = sorted(xs)
+    n = len(xs)
+    if not n:
+        return None
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def upper_median(xs):
+    """Exact upper median ``sorted(xs)[n // 2]`` — the live skew contract
+    (:meth:`ClusterView.step_skew`) has always used the upper median so a
+    two-of-four slow streak flips the signal; ``None`` on empty input."""
+    xs = sorted(xs)
+    if not xs:
+        return None
+    return xs[len(xs) // 2]
+
+
+def quantiles_of(values, qs=(0.5, 0.9, 0.99)):
+    """Exact nearest-rank percentiles ``{q: value}`` (``None``-filled on
+    empty input)."""
+    if not values:
+        return {q: None for q in qs}
+    xs = sorted(values)
+    out = {}
+    for q in qs:
+        idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+        out[q] = xs[idx]
+    return out
+
+
+# -- the mergeable sketch -----------------------------------------------------
+
+def _bin_index(x):
+    return int(math.floor(math.log(x / MIN_TRACKED) / _LOG_GROWTH))
+
+
+def _bin_representative(idx):
+    # Geometric midpoint of the bin's edges: equidistant (in relative
+    # terms) from both, which is what bounds the error at sqrt(GROWTH).
+    return MIN_TRACKED * math.exp((idx + 0.5) * _LOG_GROWTH)
+
+
+class QuantileSketch:
+    """Deterministic log-bucketed quantile sketch over non-negative values.
+
+    ``add``/``merge`` are O(1) per value/bin; ``quantile`` walks the sparse
+    bins.  Negative values are accepted but pooled with the tiny bin (the
+    telemetry series this serves — walls, latencies, depths — are
+    non-negative; the exact ``min`` is still tracked so ``quantile(0)`` is
+    right regardless).
+    """
+
+    __slots__ = ("bins", "count", "total", "vmin", "vmax", "tiny")
+
+    def __init__(self):
+        self.bins = {}
+        self.count = 0
+        self.total = 0.0
+        self.vmin = None
+        self.vmax = None
+        self.tiny = 0  # values <= MIN_TRACKED (incl. zero/negative)
+
+    def add(self, x):
+        x = float(x)
+        self.count += 1
+        self.total += x
+        self.vmin = x if self.vmin is None else min(self.vmin, x)
+        self.vmax = x if self.vmax is None else max(self.vmax, x)
+        if x <= MIN_TRACKED:
+            self.tiny += 1
+        else:
+            idx = _bin_index(x)
+            self.bins[idx] = self.bins.get(idx, 0) + 1
+
+    def extend(self, xs):
+        for x in xs:
+            self.add(x)
+        return self
+
+    def merge(self, other):
+        """Fold ``other`` into ``self`` (bin-wise add; exact, order-free)."""
+        for idx, c in other.bins.items():
+            self.bins[idx] = self.bins.get(idx, 0) + c
+        self.count += other.count
+        self.total += other.total
+        self.tiny += other.tiny
+        if other.vmin is not None:
+            self.vmin = other.vmin if self.vmin is None else min(self.vmin,
+                                                                 other.vmin)
+        if other.vmax is not None:
+            self.vmax = other.vmax if self.vmax is None else max(self.vmax,
+                                                                 other.vmax)
+        return self
+
+    def copy(self):
+        out = QuantileSketch()
+        out.bins = dict(self.bins)
+        out.count = self.count
+        out.total = self.total
+        out.vmin = self.vmin
+        out.vmax = self.vmax
+        out.tiny = self.tiny
+        return out
+
+    def quantile(self, q):
+        """Nearest-rank quantile estimate; ``None`` when empty."""
+        if not self.count:
+            return None
+        rank = min(self.count - 1, max(0, int(round(q * (self.count - 1)))))
+        if rank == 0:
+            return self.vmin
+        if rank == self.count - 1:
+            return self.vmax
+        seen = self.tiny
+        if rank < seen:
+            return self.vmin
+        for idx in sorted(self.bins):
+            seen += self.bins[idx]
+            if rank < seen:
+                rep = _bin_representative(idx)
+                return min(self.vmax, max(self.vmin, rep))
+        return self.vmax  # pragma: no cover - rank always lands in a bin
+
+    def p50(self):
+        return self.quantile(0.5)
+
+    def p99(self):
+        return self.quantile(0.99)
+
+    def mean(self):
+        return self.total / self.count if self.count else None
+
+    def summary(self):
+        """JSON-able digest ``{count, min, max, mean, p50, p90, p99}``."""
+        return {"count": self.count, "min": self.vmin, "max": self.vmax,
+                "mean": self.mean(), "p50": self.quantile(0.5),
+                "p90": self.quantile(0.9), "p99": self.quantile(0.99)}
+
+    def to_dict(self):
+        return {"growth": GROWTH, "count": self.count, "total": self.total,
+                "min": self.vmin, "max": self.vmax, "tiny": self.tiny,
+                "bins": {str(i): c for i, c in self.bins.items()}}
+
+    @classmethod
+    def from_dict(cls, d):
+        out = cls()
+        out.count = int(d.get("count", 0))
+        out.total = float(d.get("total", 0.0))
+        out.vmin = d.get("min")
+        out.vmax = d.get("max")
+        out.tiny = int(d.get("tiny", 0))
+        out.bins = {int(i): int(c) for i, c in d.get("bins", {}).items()}
+        return out
+
+    def __eq__(self, other):
+        if not isinstance(other, QuantileSketch):
+            return NotImplemented
+        return (self.bins == other.bins and self.count == other.count
+                and self.tiny == other.tiny and self.vmin == other.vmin
+                and self.vmax == other.vmax
+                and abs(self.total - other.total) <= 1e-9 * max(
+                    1.0, abs(self.total), abs(other.total)))
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"QuantileSketch(count={self.count}, min={self.vmin}, "
+                f"max={self.vmax}, bins={len(self.bins)})")

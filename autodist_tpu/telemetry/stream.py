@@ -10,11 +10,11 @@ collector, and the chief maintains a live :class:`ClusterView` that feeds
 
 Wire format (stdlib-only, deliberately boring): one frame is a 4-byte
 big-endian unsigned length prefix followed by that many bytes of UTF-8
-JSON (one object).  Frames larger than :data:`MAX_FRAME_BYTES` are
-rejected at both ends.  Frame kinds mirror the manifest schema where one
-exists (``step``, ``health_finding``, ``runtime_finding``, ``gauge``)
-plus two stream-only kinds: ``hello`` (worker rank/address/pid handshake)
-and ``heartbeat``.
+JSON (one object).  Frames larger than the frame-byte cap are rejected at
+both ends.  Frame kinds mirror the manifest schema where one exists
+(``step``, ``health_finding``, ``runtime_finding``, ``gauge``) plus two
+stream-only kinds: ``hello`` (worker rank/address/pid handshake) and
+``heartbeat``.
 
 Delivery is best-effort by contract:
 
@@ -25,17 +25,26 @@ Delivery is best-effort by contract:
   publisher logs one counted warning (``stream.connect_failures``) and
   every subsequent frame is dropped-and-counted, never raised.
 
-The chief side (:class:`TelemetryCollector`) accepts any number of worker
-connections and folds frames into a thread-safe :class:`ClusterView`
-(per-worker last-seen step, recent step walls, heartbeat age, pending
-health/runtime findings).  ``ClusterView.step_skew`` applies the same
-T002 straggler contract as the post-hoc timeline
+The chief side (:class:`TelemetryCollector`) is built to hold fleet scale
+(docs/observability.md "Fleet tier"): ONE ``selectors``-based event-loop
+thread accepts every worker connection and decodes frames incrementally
+(:class:`FrameDecoder`), decoded frames land on a *bounded* pending queue
+(dropped-and-counted on saturation, never silently), and a per-iteration
+fold budget streams them into a thread-safe :class:`ClusterView` whose
+per-worker state is bounded (recent-wall deque + mergeable
+:class:`~autodist_tpu.telemetry.sketch.QuantileSketch`).  The chief meters
+its own overhead (``chief.fold_in_us``, ``chief.snapshot_us``,
+``chief.queue_depth``, ``chief.frames_dropped``, ``chief.rss_bytes``) into
+the manifest like any worker's gauges.  ``ClusterView.step_skew`` applies
+the same T002 straggler contract as the post-hoc timeline
 (:func:`autodist_tpu.telemetry.timeline.step_skew`).
 """
+import heapq
 import json
 import logging
 import os
 import queue
+import selectors
 import socket
 import struct
 import threading
@@ -43,11 +52,13 @@ import time
 from collections import deque
 
 from ..const import ENV
+from .sketch import QuantileSketch, upper_median
 
 logger = logging.getLogger(__name__)
 
 # Hard cap on one frame's JSON payload; a frame this size is a bug, not a
-# metric, so both ends drop-and-count rather than buffer it.
+# metric, so both ends drop-and-count rather than buffer it.  Override via
+# AUTODIST_FLEET_MAX_FRAME_BYTES (see fleet_budget).
 MAX_FRAME_BYTES = 1 << 20
 
 _LEN = struct.Struct(">I")
@@ -66,6 +77,56 @@ _RECENT_WALLS = 8
 _MIN_SKEW_STEPS = 3
 
 
+# -- fleet-overridable budgets ------------------------------------------------
+# Fleet scenarios need tighter and looser budgets than the hardcoded
+# constants; each knob resolves explicit argument > AUTODIST_FLEET_* env
+# > module default.  name -> (env knob, default, caster).
+_FLEET_BUDGETS = {
+    "heartbeat_timeout_s": ("AUTODIST_FLEET_HEARTBEAT_TIMEOUT_S", 10.0, float),
+    "max_frame_bytes": ("AUTODIST_FLEET_MAX_FRAME_BYTES", MAX_FRAME_BYTES, int),
+    "queue_bound": ("AUTODIST_FLEET_QUEUE_BOUND", 4096, int),
+}
+
+
+def _budget_choices():
+    return ", ".join(f"{env!r} (={default})"
+                     for env, default, _ in sorted(_FLEET_BUDGETS.values()))
+
+
+def fleet_budget(name, override=None):
+    """Resolve one fleet budget: ``override`` > env knob > default.
+
+    Raises ``ValueError`` naming every accepted knob/default (the PR 2
+    name/value-table convention) on an unknown budget or a bad env value.
+    """
+    try:
+        env_name, default, cast = _FLEET_BUDGETS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown fleet budget {name!r}; accepted names/values: "
+            + ", ".join(f"{k!r} (={v[1]})"
+                        for k, v in sorted(_FLEET_BUDGETS.items()))) from None
+    if override is not None:
+        return override
+    raw = ENV[env_name].val
+    if not raw:
+        return default
+    try:
+        val = cast(raw)
+    except (TypeError, ValueError):
+        val = None
+    if val is None or val <= 0:
+        raise ValueError(
+            f"Bad {env_name}={raw!r}; expected a positive {cast.__name__}; "
+            f"accepted knobs/defaults: {_budget_choices()}")
+    return val
+
+
+def frame_byte_cap():
+    """The effective per-frame byte cap (env-overridable)."""
+    return fleet_budget("max_frame_bytes")
+
+
 def _bump(name, value=1):
     """Best-effort facade counter (no-op when telemetry is disabled)."""
     try:  # local import: the facade lazily imports this module back
@@ -75,10 +136,32 @@ def _bump(name, value=1):
         pass
 
 
+def _gauge(name, value):
+    """Best-effort facade gauge (no-op when telemetry is disabled)."""
+    try:
+        from . import gauge
+        gauge(name, value)
+    except Exception:  # pragma: no cover - never let accounting raise
+        pass
+
+
+def _rss_bytes():
+    """Current process RSS in bytes (``None`` when unreadable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # pragma: no cover - platform without rusage
+            return None
+
+
 def encode_frame(obj):
     """``dict`` -> length-prefixed JSON bytes (raises on oversized)."""
     payload = json.dumps(obj, separators=(",", ":"), default=str).encode()
-    if len(payload) > MAX_FRAME_BYTES:
+    if len(payload) > frame_byte_cap():
         raise ValueError(f"frame too large: {len(payload)} bytes")
     return _LEN.pack(len(payload)) + payload
 
@@ -95,17 +178,18 @@ def _recv_exact(sock, n):
 
 
 def recv_frames(sock):
-    """Yield decoded frames from ``sock`` until EOF / error.
+    """Yield decoded frames from a blocking ``sock`` until EOF / error.
 
     Malformed frames (oversized length, bad JSON) terminate the stream —
     the framing is broken at that point, there is nothing to resync on.
     """
+    cap = frame_byte_cap()
     while True:
         header = _recv_exact(sock, _LEN.size)
         if header is None:
             return
         (length,) = _LEN.unpack(header)
-        if length > MAX_FRAME_BYTES:
+        if length > cap:
             raise ValueError(f"frame length {length} exceeds cap")
         payload = _recv_exact(sock, length)
         if payload is None:
@@ -113,9 +197,68 @@ def recv_frames(sock):
         yield json.loads(payload.decode())
 
 
+class FrameDecoder:
+    """Incremental length-prefixed frame decoder for non-blocking reads.
+
+    ``feed(data)`` returns the frames the new bytes completed; partial
+    frames stay buffered.  Raises ``ValueError`` when the stream is
+    unrecoverable (oversized length, bad JSON) — framing is broken at
+    that point, there is nothing to resync on.
+    """
+
+    __slots__ = ("_buf",)
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data):
+        buf = self._buf
+        buf.extend(data)
+        cap = frame_byte_cap()
+        out = []
+        pos = 0
+        n = len(buf)
+        while n - pos >= _LEN.size:
+            (length,) = _LEN.unpack_from(buf, pos)
+            if length > cap:
+                raise ValueError(f"frame length {length} exceeds cap {cap}")
+            end = pos + _LEN.size + length
+            if end > n:
+                break
+            out.append(json.loads(bytes(buf[pos + _LEN.size:end]).decode()))
+            pos = end
+        if pos:
+            del buf[:pos]
+        return out
+
+    def pending_bytes(self):
+        return len(self._buf)
+
+
 def stream_address_from_env():
     """The collector ``host:port`` handed down by the chief ('' = off)."""
     return ENV.AUTODIST_TELEMETRY_STREAM.val
+
+
+def rank_workers(workers, k=None, *, now=None):
+    """Worst-first worker ids from a snapshot ``workers`` dict.
+
+    Ranking is recent wall p50 (descending), then heartbeat age
+    (descending) — shared by the chief's bounded snapshot and
+    ``tools/monitor.py --top`` so both name the same worst workers.
+    """
+    def score(item):
+        _, row = item
+        p50 = row.get("wall_p50_s")
+        if p50 is None:
+            p50 = row.get("last_step_wall_s")
+        hb = row.get("heartbeat_age_s")
+        return ((-1.0 if p50 is None else float(p50)),
+                (-1.0 if hb is None else float(hb)))
+
+    ranked = sorted(workers.items(), key=score, reverse=True)
+    ids = [w for w, _ in ranked]
+    return ids if k is None else ids[:k]
 
 
 class StreamPublisher:
@@ -229,22 +372,38 @@ class StreamPublisher:
 class ClusterView:
     """Chief-side live state: what every worker reported most recently.
 
-    Thread-safe; the collector's reader threads call :meth:`ingest`, the
+    Thread-safe; the collector's event loop calls :meth:`ingest`, the
     trainer/monitor poll the read side.  Findings (health + runtime) are
     queued per-worker and drained once by :meth:`pop_findings` so the
-    trainer feeds each signal to ``note_anomaly`` exactly once.
+    trainer feeds each signal to ``note_anomaly`` exactly once; when the
+    pending deque saturates the oldest finding is dropped AND counted
+    (``findings_dropped``), never silently.
+
+    Per-worker state is bounded for fleet scale: a fixed recent-wall deque
+    with its upper median cached at ingest (no per-snapshot sorts) plus a
+    mergeable :class:`QuantileSketch` of all steady-state walls.  Past
+    ``snapshot_full_below`` workers, :meth:`snapshot` serves the ``top_k``
+    worst workers from a periodically refreshed cache instead of
+    materializing every row (``snapshot(top=0)`` forces the full table).
     """
 
-    def __init__(self, max_pending_findings=256):
+    def __init__(self, max_pending_findings=256, top_k=16,
+                 snapshot_full_below=64, refresh_s=1.0):
         self._lock = threading.Lock()
         self._workers = {}
         self._findings = deque(maxlen=max_pending_findings)
         self.frames = 0
+        self.findings_dropped = 0
+        self.top_k = top_k
+        self.snapshot_full_below = snapshot_full_below
+        self.refresh_s = refresh_s
+        self._cache = None  # refresh() fills {"t","front","skew","ranked"}
 
     def _entry(self, w):
         return self._workers.setdefault(w, {
             "addr": None, "pid": None, "last_step": None,
             "last_step_wall_s": None, "recent_walls": deque(maxlen=_RECENT_WALLS),
+            "recent_p50": None, "wall_sketch": QuantileSketch(),
             "last_seen_t": None, "last_heartbeat_t": None,
             "health": "ok", "gauges": {}, "findings": 0,
         })
@@ -275,6 +434,8 @@ class ClusterView:
                     # Step 0 includes compile; keep skew on steady state.
                     if not step == 0:
                         e["recent_walls"].append(float(wall))
+                        e["recent_p50"] = upper_median(e["recent_walls"])
+                        e["wall_sketch"].add(float(wall))
             elif kind == "heartbeat":
                 e["last_heartbeat_t"] = now
             elif kind in ("health_finding", "runtime_finding"):
@@ -282,6 +443,9 @@ class ClusterView:
                 sev = str(frame.get("severity", "")).lower()
                 if kind == "health_finding" and sev in ("error", "warning"):
                     e["health"] = sev
+                if len(self._findings) == self._findings.maxlen:
+                    self.findings_dropped += 1
+                    _bump("stream.findings_dropped")
                 self._findings.append(dict(frame))
             elif kind == "gauge":
                 name = frame.get("name")
@@ -308,22 +472,10 @@ class ClusterView:
             return e["addr"]
         return f"worker {w}"
 
-    def step_skew(self, rel_threshold=0.25, abs_threshold_s=0.05):
-        """Live step-wall skew under the post-hoc T002 contract.
-
-        Median of each worker's recent walls; ``None`` with fewer than two
-        workers reporting >= 3 steady-state steps; names the
-        ``straggler`` / ``straggler_addr`` when the slowest exceeds the
-        fastest by ``max(rel * fastest, abs)``.
-        """
-        with self._lock:
-            walls = {w: list(e["recent_walls"])
-                     for w, e in self._workers.items()
-                     if len(e["recent_walls"]) >= _MIN_SKEW_STEPS}
-            addrs = {w: e["addr"] for w, e in self._workers.items()}
-        if len(walls) < 2:
+    @staticmethod
+    def _skew_from(medians, addrs, rel_threshold, abs_threshold_s):
+        if len(medians) < 2:
             return None
-        medians = {w: sorted(v)[len(v) // 2] for w, v in walls.items()}
         fastest = min(medians.values())
         slowest_w = max(medians, key=lambda w: medians[w])
         skew = medians[slowest_w] - fastest
@@ -337,6 +489,21 @@ class ClusterView:
                                      or f"worker {slowest_w}")
         return out
 
+    def step_skew(self, rel_threshold=0.25, abs_threshold_s=0.05):
+        """Live step-wall skew under the post-hoc T002 contract.
+
+        Median of each worker's recent walls (cached at ingest — no sort
+        here); ``None`` with fewer than two workers reporting >= 3
+        steady-state steps; names the ``straggler`` / ``straggler_addr``
+        when the slowest exceeds the fastest by ``max(rel * fastest, abs)``.
+        """
+        with self._lock:
+            medians = {w: e["recent_p50"] for w, e in self._workers.items()
+                       if len(e["recent_walls"]) >= _MIN_SKEW_STEPS
+                       and e["recent_p50"] is not None}
+            addrs = {w: e["addr"] for w, e in self._workers.items()}
+        return self._skew_from(medians, addrs, rel_threshold, abs_threshold_s)
+
     def stale_workers(self, timeout_s, now=None):
         """Workers silent (no frame of any kind) for > ``timeout_s``."""
         now = time.time() if now is None else now
@@ -346,32 +513,99 @@ class ClusterView:
                     if e["last_seen_t"] is not None
                     and now - e["last_seen_t"] > timeout_s}
 
-    def snapshot(self, now=None):
-        """JSON-able live summary (the monitor's data source)."""
+    def refresh(self, now=None):
+        """Recompute the bounded-snapshot cache (front step, skew, the
+        ``top_k`` worst workers) in one O(workers) pass.
+
+        The collector's event loop calls this on its self-meter tick so
+        reads stay O(top_k) at scale; :meth:`snapshot` also calls it
+        lazily when the cache is older than ``refresh_s``.
+        """
         now = time.time() if now is None else now
         with self._lock:
-            steps = [e["last_step"] for e in self._workers.values()
-                     if e["last_step"] is not None]
-            front = max(steps) if steps else None
+            medians = {}
+            addrs = {}
+            front = None
+            scored = []
+            for w, e in self._workers.items():
+                step = e["last_step"]
+                if step is not None and (front is None or step > front):
+                    front = step
+                addrs[w] = e["addr"]
+                p50 = e["recent_p50"]
+                if p50 is not None and len(e["recent_walls"]) >= _MIN_SKEW_STEPS:
+                    medians[w] = p50
+                hb = e["last_heartbeat_t"]
+                scored.append(((p50 if p50 is not None else -1.0,
+                                (now - hb) if hb is not None else -1.0), w))
+        ranked = [w for _, w in heapq.nlargest(self.top_k, scored)]
+        skew = self._skew_from(medians, addrs, 0.25, 0.05)
+        self._cache = {"t": now, "front": front, "skew": skew,
+                       "ranked": ranked}
+        return self._cache
+
+    def _row(self, e, front, now):
+        return {
+            "addr": e["addr"], "last_step": e["last_step"],
+            "last_step_wall_s": e["last_step_wall_s"],
+            "wall_p50_s": e["recent_p50"],
+            "steps_behind": (front - e["last_step"]
+                             if front is not None
+                             and e["last_step"] is not None else None),
+            "age_s": (now - e["last_seen_t"]
+                      if e["last_seen_t"] is not None else None),
+            "heartbeat_age_s": (now - e["last_heartbeat_t"]
+                                if e["last_heartbeat_t"] is not None
+                                else None),
+            "health": e["health"], "findings": e["findings"],
+            "gauges": dict(e["gauges"]),
+        }
+
+    def snapshot(self, now=None, top=None):
+        """JSON-able live summary (the monitor's data source).
+
+        ``top=None`` auto-selects: the full per-worker table below
+        ``snapshot_full_below`` workers, else the ``top_k`` worst workers
+        (fleet clusters must not pay O(workers) per poll).  ``top=K``
+        forces exactly the K worst; ``top=0`` forces the full table.
+        ``workers_total`` always carries the true cluster size.
+        """
+        now = time.time() if now is None else now
+        with self._lock:
+            n = len(self._workers)
+        if top is None:
+            k = None if n <= self.snapshot_full_below else self.top_k
+        elif top <= 0:
+            k = None
+        else:
+            k = top
+        if k is None:
+            with self._lock:
+                steps = [e["last_step"] for e in self._workers.values()
+                         if e["last_step"] is not None]
+                front = max(steps) if steps else None
+                workers = {w: self._row(e, front, now)
+                           for w, e in sorted(self._workers.items())}
+                frames = self.frames
+            skew = self.step_skew()
+            return {"workers": workers, "frames": frames,
+                    "front_step": front, "workers_total": n,
+                    "skew_s": skew["skew_s"] if skew else None,
+                    "straggler_addr": skew["straggler_addr"] if skew else None}
+        cache = self._cache
+        if cache is None or now - cache["t"] > self.refresh_s:
+            cache = self.refresh(now)
+        front = cache["front"]
+        with self._lock:
             workers = {}
-            for w, e in sorted(self._workers.items()):
-                workers[w] = {
-                    "addr": e["addr"], "last_step": e["last_step"],
-                    "last_step_wall_s": e["last_step_wall_s"],
-                    "steps_behind": (front - e["last_step"]
-                                     if front is not None
-                                     and e["last_step"] is not None else None),
-                    "age_s": (now - e["last_seen_t"]
-                              if e["last_seen_t"] is not None else None),
-                    "heartbeat_age_s": (now - e["last_heartbeat_t"]
-                                        if e["last_heartbeat_t"] is not None
-                                        else None),
-                    "health": e["health"], "findings": e["findings"],
-                    "gauges": dict(e["gauges"]),
-                }
-        skew = self.step_skew()
-        return {"workers": workers, "frames": self.frames,
-                "front_step": front,
+            for w in cache["ranked"][:k]:
+                e = self._workers.get(w)
+                if e is not None:
+                    workers[w] = self._row(e, front, now)
+            frames = self.frames
+        skew = cache["skew"]
+        return {"workers": workers, "frames": frames,
+                "front_step": front, "workers_total": n,
                 "skew_s": skew["skew_s"] if skew else None,
                 "straggler_addr": skew["straggler_addr"] if skew else None}
 
@@ -379,23 +613,45 @@ class ClusterView:
 class TelemetryCollector:
     """Chief-side listener: accepts worker streams, feeds a ClusterView.
 
-    One daemon accept thread plus one daemon reader thread per
-    connection; every decoded frame is folded into ``view`` and then
-    handed to the optional ``on_frame`` callback.  Broken/oversized
-    frames tear down that one connection (counted), never the collector.
+    ONE daemon event-loop thread multiplexes accept + read over a
+    ``selectors`` selector (no thread-per-connection: 512 workers cost 512
+    socket registrations, not 512 stacks).  Decoded frames land on a
+    bounded pending deque (``queue_bound``, env-overridable via
+    AUTODIST_FLEET_QUEUE_BOUND) — saturation drops-and-counts
+    (``frames_dropped``), never blocks the loop — and each loop iteration
+    folds at most ``fold_batch`` frames into ``view`` then hands them to
+    the optional ``on_frame`` callback.  Broken/oversized frames tear down
+    that one connection (counted), never the collector.
+
+    The chief meters itself: ``fold_in_us`` / ``snapshot_us`` sketches,
+    a ``queue_depth_series`` sampled every ``meter_period_s``, and
+    ``rss_bytes``; :meth:`self_metrics` returns the digest and the same
+    values stream into the manifest as ``chief.*`` gauges through the
+    telemetry facade, like any worker's.
     """
 
-    def __init__(self, host="127.0.0.1", port=0, view=None, on_frame=None):
+    def __init__(self, host="127.0.0.1", port=0, view=None, on_frame=None,
+                 queue_bound=None, fold_batch=512, meter_period_s=1.0):
         self._host = host
         self._port = port
         self.view = view if view is not None else ClusterView()
         self._on_frame = on_frame
         self._sock = None
-        self._threads = []
+        self._sel = None
+        self._thread = None
         self._stopping = False
+        self._pending = deque()
+        self.queue_bound = fleet_budget("queue_bound", queue_bound)
+        self._fold_batch = fold_batch
+        self._meter_period_s = meter_period_s
         self.connections = 0
         self.frames = 0
         self.bad_frames = 0
+        self.frames_dropped = 0
+        self.fold_in_us = QuantileSketch()
+        self.snapshot_us = QuantileSketch()
+        self.queue_depth_series = deque(maxlen=512)
+        self.rss_bytes = None
 
     @property
     def address(self):
@@ -404,54 +660,148 @@ class TelemetryCollector:
         host, port = self._sock.getsockname()[:2]
         return f"{self._host}:{port}"
 
+    def queue_depth(self):
+        return len(self._pending)
+
     def start(self):
         """Bind + listen; returns the bound ``host:port``."""
-        self._sock = socket.create_server((self._host, self._port))
-        self._sock.settimeout(0.5)
-        t = threading.Thread(target=self._accept_loop,
-                             name="telemetry-collector", daemon=True)
-        t.start()
-        self._threads.append(t)
+        # backlog sized for fleet connect storms (hundreds of simulated
+        # workers dialing in within one select tick)
+        self._sock = socket.create_server((self._host, self._port),
+                                          backlog=1024)
+        self._sock.setblocking(False)
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._sock, selectors.EVENT_READ, None)
+        self._stopping = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="telemetry-collector",
+                                        daemon=True)
+        self._thread.start()
         return self.address
 
-    def _accept_loop(self):
+    # -- event loop -------------------------------------------------------
+    def _loop(self):
+        next_meter = time.monotonic() + self._meter_period_s
         while not self._stopping:
             try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            self.connections += 1
-            t = threading.Thread(target=self._read_loop, args=(conn,),
-                                 name="telemetry-collector-conn", daemon=True)
-            t.start()
-            self._threads.append(t)
+                events = self._sel.select(timeout=0.05)
+            except OSError:  # pragma: no cover - selector closed at stop
+                break
+            for key, _ in events:
+                if key.data is None:
+                    self._accept()
+                else:
+                    self._read(key)
+            self._fold(self._fold_batch)
+            now = time.monotonic()
+            if now >= next_meter:
+                next_meter = now + self._meter_period_s
+                self._self_meter()
+        self._fold(None)  # drain whatever is still pending on the way out
 
-    def _read_loop(self, conn):
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except (BlockingIOError, OSError):
+                return
+            conn.setblocking(False)
+            self.connections += 1
+            self._sel.register(conn, selectors.EVENT_READ, FrameDecoder())
+
+    def _read(self, key):
+        conn = key.fileobj
         try:
-            with conn:
-                conn.settimeout(None)
-                for frame in recv_frames(conn):
-                    self.frames += 1
-                    try:
-                        self.view.ingest(frame)
-                        if self._on_frame is not None:
-                            self._on_frame(frame)
-                    except Exception:  # pragma: no cover - view never raises
-                        self.bad_frames += 1
-        except (OSError, ValueError, json.JSONDecodeError):
+            data = conn.recv(65536)
+        except BlockingIOError:  # pragma: no cover - spurious readiness
+            return
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not data:
+            self._close_conn(conn)
+            return
+        try:
+            frames = key.data.feed(data)
+        except ValueError:
             self.bad_frames += 1
             _bump("stream.bad_frames")
+            self._close_conn(conn)
+            return
+        for frame in frames:
+            self.frames += 1
+            if len(self._pending) >= self.queue_bound:
+                self.frames_dropped += 1
+                _bump("chief.frames_dropped")
+            else:
+                self._pending.append(frame)
+
+    def _close_conn(self, conn):
+        try:
+            self._sel.unregister(conn)
+        except (KeyError, ValueError, OSError):  # pragma: no cover
+            pass
+        try:
+            conn.close()
+        except OSError:  # pragma: no cover
+            pass
+
+    def _fold(self, budget):
+        pending = self._pending
+        n = len(pending) if budget is None else min(budget, len(pending))
+        for _ in range(n):
+            frame = pending.popleft()
+            t0 = time.perf_counter_ns()
+            try:
+                self.view.ingest(frame)
+                if self._on_frame is not None:
+                    self._on_frame(frame)
+            except Exception:  # pragma: no cover - view never raises
+                self.bad_frames += 1
+            self.fold_in_us.add((time.perf_counter_ns() - t0) / 1e3)
+
+    def _self_meter(self):
+        # Keeping the bounded-snapshot cache warm is fold-side work; the
+        # metered snapshot below is what a monitor poll actually costs.
+        self.view.refresh()
+        t0 = time.perf_counter_ns()
+        self.view.snapshot()
+        self.snapshot_us.add((time.perf_counter_ns() - t0) / 1e3)
+        self.queue_depth_series.append(len(self._pending))
+        self.rss_bytes = _rss_bytes()
+        _gauge("chief.fold_in_us", self.fold_in_us.p99() or 0.0)
+        _gauge("chief.snapshot_us", self.snapshot_us.p99() or 0.0)
+        _gauge("chief.queue_depth", float(len(self._pending)))
+        _gauge("chief.frames_dropped", float(self.frames_dropped))
+        _gauge("chief.rss_bytes", float(self.rss_bytes or 0))
+
+    def self_metrics(self):
+        """JSON-able chief self-observation digest (the scale report's
+        ``chief`` block)."""
+        series = list(self.queue_depth_series)
+        return {
+            "fold_in_us": self.fold_in_us.summary(),
+            "snapshot_us": self.snapshot_us.summary(),
+            "queue_depth": {"bound": self.queue_bound,
+                            "last": series[-1] if series else 0,
+                            "max": max(series) if series else 0,
+                            "series": series},
+            "frames_dropped": self.frames_dropped,
+            "rss_bytes": self.rss_bytes,
+        }
 
     def stop(self):
-        """Stop accepting and close the listening socket (idempotent)."""
+        """Stop the event loop and close every socket (idempotent)."""
         self._stopping = True
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:  # pragma: no cover
-                pass
-        for t in self._threads:
-            t.join(timeout=1.0)
-        self._threads = []
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if self._sel is not None:
+            for key in list(self._sel.get_map().values()):
+                try:
+                    key.fileobj.close()
+                except OSError:  # pragma: no cover
+                    pass
+            self._sel.close()
+            self._sel = None
+        self._sock = None

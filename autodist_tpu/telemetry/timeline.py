@@ -261,12 +261,9 @@ def summarize_trace(path_or_dir):
 _MEDIAN_MIN_STEPS = 2   # need steady-state walls; step 0 carries compile
 
 
-def _median(xs):
-    xs = sorted(xs)
-    n = len(xs)
-    if not n:
-        return None
-    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+# Exact medians live in sketch.py (lint AD12 confines percentile sorts
+# in telemetry/ to that one module).
+from .sketch import median_of as _median  # noqa: E402
 
 
 def worker_step_walls(records):

@@ -22,6 +22,8 @@ Knobs (constructor args; env overrides via the session:
 """
 from collections import deque
 
+from .sketch import median_of
+
 
 class SlowStepWatchdog:
     def __init__(self, multiple=3.0, window=32, min_steps=5, cooldown=20,
@@ -44,11 +46,7 @@ class SlowStepWatchdog:
         self.last_arm_reason = None
 
     def rolling_median(self):
-        if not self._times:
-            return None
-        xs = sorted(self._times)
-        n = len(xs)
-        return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+        return median_of(self._times)
 
     def observe(self, step, wall_s):
         """Record one step's wall time; returns True when this step was a

@@ -1,0 +1,349 @@
+"""Fleet tier: simulator, streaming chief, W-code audit, fleet budgets
+(autodist_tpu/fleet/, tools/fleet_check.py, analysis/fleet_audit.py —
+docs/observability.md "Fleet tier").
+
+Pins the scenario scripts' determinism and injection shapes, the
+env/ctor-overridable fleet budgets (name/value-table ValueError
+convention), the drop-and-count bounds (PendingCauses flood, event-log
+signal sampling), the worst-first ranking shared by the bounded snapshot
+and ``monitor --top``, the W-code audit against the golden fixtures that
+``verify_strategy --fleet --selftest`` replays, lint AD12 (exact
+percentiles confined to telemetry/sketch.py), and — end to end over the
+REAL length-prefixed socket — a small fleet leg where the scripted
+straggler surfaces within the MTTR budget and fires the unchanged
+``ElasticTrainer`` hook logic.  The full 512-worker gate runs as the
+``slow``-marked leg (and in CI as ``make fleet-check``).
+"""
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from autodist_tpu.analysis.fleet_audit import (DROP_BUDGET_FRAC,
+                                               MTTR_BUDGET_S,
+                                               SNAPSHOT_GROWTH_LIMIT,
+                                               _queue_growing, audit_fixture,
+                                               fleet_audit)
+from autodist_tpu.fleet import (SCENARIOS, FleetSimulator, ScenarioScript,
+                                build_scenario)
+from autodist_tpu.telemetry.events import ClusterEventLog, PendingCauses
+from autodist_tpu.telemetry.stream import (TelemetryCollector, fleet_budget,
+                                           frame_byte_cap, rank_workers)
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "fleet")
+
+
+# -- scenario scripts ---------------------------------------------------------
+
+
+def test_scenarios_are_deterministic_and_json_able():
+    for name in SCENARIOS:
+        a = build_scenario(name, 64, seed=5)
+        b = build_scenario(name, 64, seed=5)
+        assert a == b, f"{name} not seed-deterministic"
+        assert json.loads(json.dumps(a)) == a
+        assert build_scenario(name, 64, seed=6) != a or name == "diurnal_load"
+
+
+def test_build_scenario_rejects_unknown_names_with_table():
+    with pytest.raises(ValueError) as e:
+        build_scenario("rack_fire", 8)
+    msg = str(e.value)
+    for name in SCENARIOS:
+        assert name in msg
+
+
+def test_heartbeat_blackout_carries_its_blackouts():
+    # regression: the generator built the blackout list then returned []
+    script = build_scenario("heartbeat_blackout", 64, seed=1)
+    assert script["blackouts"], "blackout scenario scripted no blackouts"
+    wrap = ScenarioScript(script)
+    b = script["blackouts"][0]
+    assert wrap.blackout(b["worker"], b["start_step"])
+    assert wrap.blackout(b["worker"], b["start_step"] + b["steps"] - 1)
+    assert not wrap.blackout(b["worker"], b["start_step"] + b["steps"])
+
+
+def test_scenario_script_queries():
+    script = ScenarioScript({
+        "name": "mix",
+        "stragglers": [{"worker": 3, "start_step": 4, "factor": 3.0}],
+        "preemptions": [{"worker": 5, "step": 2, "down_steps": 2}],
+        "blackouts": [],
+        "load": {"period_steps": 8, "amplitude": 0.5},
+    })
+    assert not script.is_straggling(3, 3)
+    assert script.is_straggling(3, 4)
+    assert script.wall_multiplier(3, 4) > 3.0 * 0.99  # factor x load >= 1
+    assert script.wall_multiplier(0, 0) >= 1.0        # load only lifts
+    assert script.preempt_now(2) == [5]
+    assert script.rejoin_now(4) == [5]
+    assert script.first_straggler()["worker"] == 3
+    assert ScenarioScript(None).first_straggler() is None
+
+
+# -- fleet budgets: ctor > env > default --------------------------------------
+
+
+def test_fleet_budget_resolution_order(monkeypatch):
+    monkeypatch.delenv("AUTODIST_FLEET_QUEUE_BOUND", raising=False)
+    assert fleet_budget("queue_bound") == 4096
+    monkeypatch.setenv("AUTODIST_FLEET_QUEUE_BOUND", "128")
+    assert fleet_budget("queue_bound") == 128
+    assert fleet_budget("queue_bound", 9) == 9        # explicit arg wins
+    collector = TelemetryCollector(queue_bound=None)
+    assert collector.queue_bound == 128               # ctor reads the env
+    assert TelemetryCollector(queue_bound=7).queue_bound == 7
+
+
+def test_fleet_budget_bad_values_name_every_knob(monkeypatch):
+    monkeypatch.setenv("AUTODIST_FLEET_HEARTBEAT_TIMEOUT_S", "soon")
+    with pytest.raises(ValueError) as e:
+        fleet_budget("heartbeat_timeout_s")
+    msg = str(e.value)
+    assert "AUTODIST_FLEET_HEARTBEAT_TIMEOUT_S" in msg and "'soon'" in msg
+    # the accepted-knobs/defaults table rides along
+    assert "AUTODIST_FLEET_QUEUE_BOUND" in msg
+    assert "AUTODIST_FLEET_MAX_FRAME_BYTES" in msg
+    monkeypatch.setenv("AUTODIST_FLEET_QUEUE_BOUND", "-4")
+    with pytest.raises(ValueError):
+        fleet_budget("queue_bound")
+    with pytest.raises(ValueError) as e:
+        fleet_budget("frame_cap")                     # unknown name
+    assert "queue_bound" in str(e.value)
+
+
+def test_frame_byte_cap_env_override(monkeypatch):
+    monkeypatch.delenv("AUTODIST_FLEET_MAX_FRAME_BYTES", raising=False)
+    assert frame_byte_cap() == 1 << 20
+    monkeypatch.setenv("AUTODIST_FLEET_MAX_FRAME_BYTES", "2048")
+    assert frame_byte_cap() == 2048
+
+
+# -- bounded drop-and-count state ---------------------------------------------
+
+
+def test_pending_causes_flood_stays_bounded():
+    pc = PendingCauses(maxlen=1024)
+    for i in range(10_000):       # a chief that never answers
+        pc.setdefault(("straggler", f"host-{i}"), {"signal": "straggler"})
+    assert len(pc) == 1024
+    assert pc.dropped == 10_000 - 1024
+    # newest causality survives; the oldest was evicted
+    assert ("straggler", "host-9999") in pc
+    assert ("straggler", "host-0") not in pc
+    # setdefault stays idempotent for live keys (no double-count)
+    before = pc.dropped
+    pc.setdefault(("straggler", "host-9999"), {"signal": "other"})
+    assert pc.dropped == before
+    assert pc.get(("straggler", "host-9999"))["signal"] == "straggler"
+
+
+def test_event_log_samples_signal_storms_with_counts():
+    log = ClusterEventLog(sample_workers_threshold=2, sample_keep=2,
+                          sample_every=4)
+    for w in range(4):            # past the distinct-worker threshold
+        for _ in range(16):
+            log.note_signal("straggler", worker=f"host-{w}", code="T002")
+    assert log.sampled_out > 0
+    recs = [e for e in log.events if e.get("signal") == "straggler"]
+    # skipped records are tallied onto the next admitted one, never lost
+    carried = sum(r.get("sampled_out", 0) for r in recs)
+    assert carried + len(recs) == 4 * 16
+
+
+def test_rank_workers_orders_worst_first():
+    workers = {
+        0: {"wall_p50_s": 0.10, "heartbeat_age_s": 1.0},
+        1: {"wall_p50_s": 0.50, "heartbeat_age_s": 0.1},
+        2: {"wall_p50_s": None, "last_step_wall_s": 0.30,
+            "heartbeat_age_s": 0.2},
+        3: {"wall_p50_s": 0.10, "heartbeat_age_s": 9.0},
+    }
+    assert rank_workers(workers) == [1, 2, 3, 0]      # p50 desc, then age
+    assert rank_workers(workers, 2) == [1, 2]
+
+
+# -- the W-code audit ---------------------------------------------------------
+
+
+def test_fixture_saturated_fires_w001_only():
+    codes = [f.code for f in audit_fixture(
+        os.path.join(DATA, "saturated.json"))]
+    assert codes == ["W001", "W005"]
+
+
+def test_fixture_slow_detection_fires_w002_only():
+    codes = [f.code for f in audit_fixture(
+        os.path.join(DATA, "slow_detection.json"))]
+    assert codes == ["W002", "W005"]
+
+
+def test_fixture_clean_512_is_w005_only():
+    findings = audit_fixture(os.path.join(DATA, "clean_512.json"))
+    assert [f.code for f in findings] == ["W005"]
+    assert findings[-1].data["flagged"] == []
+
+
+def test_w000_when_no_scale_report():
+    assert [f.code for f in fleet_audit(None)] == ["W000"]
+
+
+def test_queue_growing_detector():
+    assert _queue_growing([1, 2, 4, 8, 400, 900, 2000, 4000])
+    assert not _queue_growing([500, 400, 10, 4, 2, 0])     # draining
+    assert not _queue_growing([5, 5, 5, 5, 5, 5])          # flat
+    assert not _queue_growing([])
+
+
+def test_w003_drop_budget_and_w004_growth_limits():
+    with open(os.path.join(DATA, "clean_512.json")) as f:
+        scale = json.load(f)
+    frames = scale["frames"]
+    # push publisher drops just past the budget fraction
+    scale["drops"]["publisher.dropped"] = int(frames * DROP_BUDGET_FRAC) + 1
+    codes = {f.code for f in fleet_audit(scale)}
+    assert "W003" in codes
+    # and snapshot p99 past the growth limit over the embedded baseline
+    scale["chief"]["snapshot_us"]["p99"] = (
+        scale["baseline"]["snapshot_us_p99"] * SNAPSHOT_GROWTH_LIMIT * 1.5)
+    codes = {f.code for f in fleet_audit(scale)}
+    assert "W004" in codes
+
+
+# -- monitor --top ------------------------------------------------------------
+
+
+def _mon_snapshot():
+    return {"frames": 9, "front_step": 4, "workers_total": 5,
+            "skew_s": 0.2, "straggler_addr": "host-1:1",
+            "workers": {
+                w: {"addr": f"host-{w}:1", "last_step": 4,
+                    "steps_behind": 0, "last_step_wall_s": 0.05,
+                    "wall_p50_s": 0.5 if w == 1 else 0.05,
+                    "heartbeat_age_s": 0.1, "age_s": 0.1,
+                    "health": "ok", "findings": 0}
+                for w in range(5)}}
+
+
+def test_monitor_top_ranks_worst_first_and_counts_hidden():
+    from tools.monitor import render_view
+
+    out = render_view(_mon_snapshot(), top=2)
+    lines = out.splitlines()
+    assert "top 2 of 5 worst-first" in lines[0]
+    assert lines[1].lstrip().startswith("w1 ")         # the straggler leads
+    assert "+3 more worker(s) not shown" in out
+    full = render_view(_mon_snapshot())
+    assert "+0 more" not in full and "not shown" not in full
+    assert full.splitlines()[1].lstrip().startswith("w0 ")
+
+
+def test_monitor_cli_top_and_json_over_run_dir(tmp_path, capsys):
+    from tools.monitor import main
+
+    run = tmp_path / "run"
+    run.mkdir()
+    for w in range(5):       # one manifest per worker, like a real run dir
+        with open(run / f"worker_{w}.jsonl", "w") as f:
+            f.write(json.dumps({"kind": "meta", "t": 1000.0, "w": w,
+                                "addr": f"host-{w}:1"}) + "\n")
+            for s in range(4):
+                wall = 0.5 if w == 1 else 0.05
+                f.write(json.dumps({"kind": "step", "t": 1000.0 + s, "w": w,
+                                    "step": s, "wall_s": wall}) + "\n")
+    assert main([str(run), "--once", "--top", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "top 2 of 5 worst-first" in out
+    assert out.splitlines()[1].lstrip().startswith("w1 ")  # straggler leads
+    assert "+3 more worker(s) not shown" in out
+    # --json always carries the FULL worker set, --top or not
+    assert main([str(run), "--once", "--top", "2", "--json"]) == 0
+    view = json.loads(capsys.readouterr().out)["view"]
+    assert len(view["workers"]) == 5
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main([str(empty), "--once"]) == 1
+
+
+# -- lint AD12: exact percentiles stay confined to sketch.py ------------------
+
+
+def test_ad12_flags_exact_percentiles_in_telemetry(tmp_path):
+    from tools.lint import lint_file
+
+    stray = tmp_path / "autodist_tpu" / "telemetry" / "sneaky.py"
+    stray.parent.mkdir(parents=True)
+    stray.write_text(
+        "import statistics\n"
+        "def worker_median(xs):\n"
+        "    return statistics.median(xs)\n"
+        "def p99(xs):\n"
+        "    return sorted(xs)[int(0.99 * len(xs))]\n")
+    codes = [code for _, _, code, _ in lint_file(stray)]
+    assert codes.count("AD12") == 2
+    # the owner module and files outside telemetry/ stay exempt
+    repo = Path(__file__).resolve().parent.parent
+    owner = repo / "autodist_tpu" / "telemetry" / "sketch.py"
+    assert "AD12" not in {code for _, _, code, _ in lint_file(owner)}
+    outside = tmp_path / "autodist_tpu" / "analysis" / "fine.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text("def median(xs):\n    return sorted(xs)[len(xs)//2]\n")
+    assert "AD12" not in {code for _, _, code, _ in lint_file(outside)}
+
+
+# -- end to end over the real socket ------------------------------------------
+
+
+def test_small_fleet_leg_detects_straggler_within_budget():
+    from tools.fleet_check import _run_leg
+
+    scenario = build_scenario("cascading_stragglers", 16, seed=3)
+    report, problems = _run_leg(16, 24, scenario=scenario, seed=3,
+                                detect=True)
+    assert problems == []
+    det = report["detection"]
+    assert det["hook_fired"]
+    assert det["surfaced_t"] is not None
+    assert det["latency_s"] <= MTTR_BUDGET_S
+    assert report["drops"]["chief.frames_dropped"] == 0
+    assert report["chief"]["queue_depth"]["max"] <= \
+        report["chief"]["queue_depth"]["bound"]
+    # the small leg's report (no baseline block yet) audits W005-clean
+    codes = [f.code for f in fleet_audit(report)]
+    assert codes == ["W005"]
+
+
+def test_idle_fleet_leg_is_clean():
+    from tools.fleet_check import _run_leg
+
+    report, problems = _run_leg(8, 12, seed=1)
+    assert problems == []
+    assert report["detection"] is None
+    assert report["frames"] > 0
+
+
+def test_simulator_reports_straggler_injection_anchor():
+    # the armed_t anchor exists iff the scenario scripts a straggler
+    sim = FleetSimulator("127.0.0.1:1", workers=2,
+                         scenario=build_scenario("cascading_stragglers", 2,
+                                                 seed=0),
+                         close_timeout_s=0.05)
+    assert sim.script.first_straggler() is not None
+    idle = FleetSimulator("127.0.0.1:1", workers=2, close_timeout_s=0.05)
+    assert idle.script.first_straggler() is None
+
+
+@pytest.mark.slow
+def test_fleet_check_gate_at_512_workers(tmp_path):
+    from tools.fleet_check import main
+
+    out = tmp_path / "scale.json"
+    assert main(["--workers", "512", "--steps", "48", "--seed", "7",
+                 "--out", str(out)]) == 0
+    with open(out) as f:
+        report = json.load(f)
+    assert report["workers"] == 512
+    assert report["drops"]["chief.frames_dropped"] == 0
+    assert report["detection"]["hook_fired"]

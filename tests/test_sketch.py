@@ -1,0 +1,148 @@
+"""Quantile sketches + the blessed exact helpers
+(autodist_tpu/telemetry/sketch.py, docs/observability.md "Fleet tier").
+
+Pins the accuracy contract (REL_ERROR against exact percentiles on
+adversarial distributions), the exact-merge algebra (associative AND
+commutative bin-wise addition — the property that lets per-worker
+sketches fold in any arrival order), the exact edge cases
+(single-sample, all-equal), the JSON round trip, and the exact helpers'
+equivalence with the ``statistics``-module semantics the rest of
+telemetry used to open-code (AD12 now confines those sorts here) —
+including ``merge_records``'s clock-offset median over a golden
+two-worker manifest pair.
+"""
+import json
+import os
+import random
+import statistics
+
+import pytest
+
+from autodist_tpu.telemetry.sketch import (GROWTH, REL_ERROR, QuantileSketch,
+                                           median_of, quantiles_of,
+                                           upper_median)
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _exact_quantile(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))]
+
+
+# -- accuracy on adversarial distributions -----------------------------------
+
+
+@pytest.mark.parametrize("name,draw", [
+    ("uniform", lambda rng: rng.uniform(0.001, 1.0)),
+    ("bimodal", lambda rng: rng.gauss(0.010, 0.001)
+        if rng.random() < 0.8 else rng.gauss(1.0, 0.05)),
+    ("heavy_tail", lambda rng: 0.005 * (1.0 / max(1e-3, rng.random()))),
+    ("lognormal", lambda rng: rng.lognormvariate(-3.0, 1.5)),
+])
+def test_quantile_within_documented_relative_error(name, draw):
+    rng = random.Random(12345)
+    xs = [abs(draw(rng)) for _ in range(5000)]
+    sk = QuantileSketch().extend(xs)
+    for q in (0.01, 0.1, 0.5, 0.9, 0.99):
+        exact = _exact_quantile(xs, q)
+        got = sk.quantile(q)
+        assert got == pytest.approx(exact, rel=REL_ERROR), \
+            f"{name} q={q}: sketch {got} vs exact {exact}"
+
+
+def test_single_sample_and_all_equal_are_exact():
+    one = QuantileSketch().extend([0.037])
+    assert one.quantile(0.5) == 0.037
+    assert one.quantile(0.99) == 0.037
+    same = QuantileSketch().extend([0.25] * 100)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert same.quantile(q) == 0.25
+    assert QuantileSketch().quantile(0.5) is None
+
+
+def test_zero_and_tiny_values_report_observed_min():
+    sk = QuantileSketch().extend([0.0] * 10 + [1e-12] * 10)
+    assert sk.quantile(0.5) == 0.0
+    assert sk.vmax == 1e-12
+
+
+# -- the merge algebra --------------------------------------------------------
+
+
+def test_merge_is_commutative_and_associative_exactly():
+    rng = random.Random(7)
+    parts = [[abs(rng.gauss(0.05, 0.02)) for _ in range(200)]
+             for _ in range(3)]
+    a, b, c = (QuantileSketch().extend(p) for p in parts)
+
+    ab_c = a.copy().merge(b).merge(c)
+    c_ba = c.copy().merge(b).merge(a)
+    a_bc = a.copy().merge(b.copy().merge(c))
+    assert ab_c == c_ba == a_bc          # exact bin-wise equality
+    whole = QuantileSketch().extend([x for p in parts for x in p])
+    assert ab_c == whole                 # merge == having seen everything
+
+
+def test_merge_matches_pooled_quantiles():
+    rng = random.Random(11)
+    workers = [[abs(rng.gauss(0.05, 0.01)) * (3.0 if w == 5 else 1.0)
+                for _ in range(300)] for w in range(8)]
+    merged = QuantileSketch()
+    for series in workers:
+        merged.merge(QuantileSketch().extend(series))
+    pooled = [x for s in workers for x in s]
+    assert merged.count == len(pooled)
+    for q in (0.5, 0.9, 0.99):
+        assert merged.quantile(q) == pytest.approx(
+            _exact_quantile(pooled, q), rel=REL_ERROR)
+
+
+def test_to_dict_round_trip_preserves_equality_and_json():
+    sk = QuantileSketch().extend([0.001, 0.05, 0.5, 2.0, 0.0])
+    d = json.loads(json.dumps(sk.to_dict()))
+    back = QuantileSketch.from_dict(d)
+    assert back == sk
+    assert back.summary() == sk.summary()
+    assert d["growth"] == GROWTH
+
+
+# -- the exact helpers (the one blessed sorting site) -------------------------
+
+
+def test_exact_helpers_match_statistics_module():
+    rng = random.Random(3)
+    for n in (1, 2, 3, 8, 9, 100):
+        xs = [rng.uniform(0, 1) for _ in range(n)]
+        assert median_of(xs) == pytest.approx(statistics.median(xs))
+        assert upper_median(xs) == sorted(xs)[n // 2]
+    assert median_of([]) is None
+    assert upper_median([]) is None
+    assert quantiles_of([], (0.5,)) == {0.5: None}
+    xs = [float(i) for i in range(101)]
+    assert quantiles_of(xs, (0.0, 0.5, 0.99, 1.0)) == {
+        0.0: 0.0, 0.5: 50.0, 0.99: 99.0, 1.0: 100.0}
+
+
+def test_merge_records_clock_offsets_still_use_exact_median():
+    # the golden skewed two-worker pair: offsets must equal the exact
+    # median of per-step timestamp deltas (median_of replaced the local
+    # _median during the AD12 consolidation — behavior pinned here)
+    from autodist_tpu.telemetry.aggregate import merge_records
+
+    run_dir = os.path.join(DATA, "trace", "skewed_pair")
+    records, stats = merge_records(run_dir)
+    assert records, "golden manifest pair went missing"
+    per_worker = {}
+    for r in records:
+        if r.get("kind") == "step":
+            per_worker.setdefault(r.get("w"), {})[r["step"]] = \
+                r.get("t_raw", r.get("t"))
+    ws = sorted(per_worker)
+    assert len(ws) == 2
+    ref, other = ws
+    shared = sorted(set(per_worker[ref]) & set(per_worker[other]))
+    expect = statistics.median([per_worker[other][k] - per_worker[ref][k]
+                                for k in shared])
+    assert stats["clock_offsets_s"][other] == pytest.approx(expect)
+    assert stats["clock_offsets_s"][ref] == 0.0

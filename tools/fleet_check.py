@@ -1,0 +1,260 @@
+"""CI gate: observability holds at fleet scale (``make fleet-check``,
+wired into ``make check``).
+
+Asserts the bounded-chief contract of docs/observability.md "Fleet tier"
+end-to-end, without a real cluster:
+
+1. BASELINE leg: an 8-worker healthy fleet (production ``StreamPublisher``
+   per worker over the real length-prefixed-JSON socket) against a fresh
+   chief; the chief's self-metered snapshot/fold-in p99 become the
+   same-machine baseline (``--write-baseline`` commits it to
+   ``records/baselines/fleet_chief.json``);
+2. SCALE leg: a ``--workers`` (default 512) cascading-straggler scenario
+   drives the same chief: the pending queue must stay bounded with ZERO
+   dropped frames, every worker must land in the live view, snapshot p99
+   must hold within ``SNAPSHOT_GROWTH_LIMIT``x the 8-worker baseline
+   (the O(top_k) read-path contract), and the scripted straggler must
+   surface in ``ClusterView.step_skew`` — firing a hook-logic
+   ``ElasticTrainer.on_straggler`` — within the MTTR budget;
+3. the W-code fleet audit over the assembled scale report must be clean
+   (W005 only); the report is written as JSON (``--out``) for
+   ``tools/verify_strategy.py --fleet``.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+BASELINE_WORKERS = 8
+# both legs meter at this cadence so the p99s are comparable
+METER_PERIOD_S = 0.2
+# virtual-step pacing: fast enough that 64 steps finish in seconds, slow
+# enough that the meter tick samples a live queue
+STEP_PERIOD_S = 0.05
+DETECT_POLL_S = 0.01
+
+
+def _run_leg(workers, steps, *, scenario=None, seed=0, detect=False,
+             mttr_budget_s=None):
+    """One simulated-fleet run against a fresh chief; returns the leg's
+    half-assembled scale report (and the problems it proved)."""
+    from autodist_tpu.analysis.fleet_audit import MTTR_BUDGET_S
+    from autodist_tpu.elastic import ElasticTrainer
+    from autodist_tpu.fleet import FleetSimulator
+    from autodist_tpu.telemetry.stream import ClusterView, TelemetryCollector
+
+    budget_s = mttr_budget_s if mttr_budget_s is not None else MTTR_BUDGET_S
+    problems = []
+    view = ClusterView()
+    collector = TelemetryCollector(view=view, meter_period_s=METER_PERIOD_S)
+    address = collector.start()
+    sim = FleetSimulator(address, workers=workers, scenario=scenario,
+                         seed=seed, step_period_s=STEP_PERIOD_S)
+    stats = {}
+
+    def _drive():
+        stats.update(sim.run(steps=steps))
+
+    driver = threading.Thread(target=_drive, name="fleet-sim")
+    driver.start()
+
+    # the monitor-poll model: the chief's consumer polls step_skew and
+    # feeds the UNCHANGED ElasticTrainer hook logic — detection latency
+    # is poll-side wall clock, exactly what an operator would see
+    surfaced_t = None
+    fired = []
+    trainer = ElasticTrainer.__new__(ElasticTrainer)  # hook logic only
+    trainer.on_straggler = fired.append
+    trainer._straggler_streak = {}
+    trainer.straggler_signals = 0
+    expect = sim.script.first_straggler() if detect else None
+    expect_addr = f"sim-{expect['worker']}" if expect else None
+    deadline = time.time() + steps * STEP_PERIOD_S + budget_s + 10.0
+    while driver.is_alive() or (detect and surfaced_t is None
+                                and time.time() < deadline):
+        if detect:
+            skew = view.step_skew()
+            if skew and skew.get("straggler_addr") == expect_addr:
+                if surfaced_t is None:
+                    surfaced_t = time.time()
+                trainer.note_straggler(skew)
+                if fired:
+                    break
+        if not driver.is_alive() and not detect:
+            break
+        time.sleep(DETECT_POLL_S)
+    driver.join()
+    # let the chief drain the tail of the stream before reading counters
+    drain_deadline = time.time() + 5.0
+    while collector.queue_depth() and time.time() < drain_deadline:
+        time.sleep(0.01)
+    final = view.snapshot(top=0)  # one full O(workers) read, off the clock
+    collector.stop()
+
+    chief = collector.self_metrics()
+    detection = None
+    if detect:
+        if expect is None:
+            problems.append("detect leg has no scripted straggler")
+        else:
+            injected_t = stats.get("injected", {}).get(
+                "straggler", {}).get("armed_t")
+            latency = (max(0.0, surfaced_t - injected_t)
+                       if surfaced_t is not None and injected_t is not None
+                       else None)
+            detection = {
+                "scenario": sim.script.name,
+                "worker": expect["worker"], "addr": expect_addr,
+                "injected_t": injected_t, "surfaced_t": surfaced_t,
+                "latency_s": latency, "budget_s": budget_s,
+                "hook_fired": bool(fired),
+            }
+    drops = {
+        "publisher.dropped": stats.get("frames_dropped", 0),
+        "chief.frames_dropped": collector.frames_dropped,
+        "view.findings_dropped": view.findings_dropped,
+    }
+    report = {
+        "workers": workers, "steps": steps,
+        "scenario": sim.script.name, "seed": seed,
+        "frames": collector.frames,
+        "frames_per_s": collector.frames / max(1e-9,
+                                               stats.get("elapsed_s", 0.0)),
+        "elapsed_s": stats.get("elapsed_s"),
+        "chief": chief, "drops": drops, "detection": detection,
+    }
+
+    # the leg's own contract checks
+    if len(final.get("workers") or {}) < workers:
+        problems.append(f"live view holds {len(final.get('workers') or {})} "
+                        f"of {workers} workers")
+    if collector.bad_frames:
+        problems.append(f"{collector.bad_frames} bad frame(s) over the "
+                        f"real socket")
+    if collector.frames_dropped:
+        problems.append(f"chief dropped {collector.frames_dropped} "
+                        f"frame(s) (queue bound "
+                        f"{collector.queue_bound})")
+    if stats.get("publishers_dead"):
+        problems.append(f"{stats['publishers_dead']} publisher(s) went "
+                        f"dead mid-run")
+    if detect:
+        if surfaced_t is None:
+            problems.append(f"scripted straggler {expect_addr} never "
+                            f"surfaced in ClusterView")
+        elif detection["latency_s"] is not None \
+                and detection["latency_s"] > budget_s:
+            problems.append(f"straggler surfaced after "
+                            f"{detection['latency_s']:.2f}s — beyond the "
+                            f"{budget_s}s MTTR budget")
+        if not fired:
+            problems.append("on_straggler hook never fired")
+    return report, problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workers", type=int, default=512,
+                    help="scale-leg cluster size (default: 512)")
+    ap.add_argument("--steps", type=int, default=64,
+                    help="virtual steps per leg (default: 64)")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="scenario/jitter seed (default: 7)")
+    ap.add_argument("--out", default=None, metavar="SCALE_JSON",
+                    help="write the scale report here (default: a temp "
+                         "file, path printed)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="commit this machine's 8-worker chief baseline "
+                         "to records/baselines/fleet_chief.json")
+    args = ap.parse_args(argv)
+
+    from autodist_tpu.analysis.fleet_audit import (BASELINE_NAME,
+                                                   SNAPSHOT_GROWTH_LIMIT,
+                                                   fleet_audit)
+    from autodist_tpu.fleet import build_scenario
+
+    problems = []
+
+    # 1. the 8-worker baseline leg (idle, healthy — the committed shape)
+    base_report, base_problems = _run_leg(BASELINE_WORKERS, args.steps,
+                                          seed=args.seed)
+    problems.extend(f"baseline: {p}" for p in base_problems)
+    baseline = {
+        "workers": BASELINE_WORKERS,
+        "snapshot_us_p99": (base_report["chief"]["snapshot_us"] or
+                            {}).get("p99"),
+        "fold_in_us_p99": (base_report["chief"]["fold_in_us"] or
+                           {}).get("p99"),
+    }
+    if not baseline["snapshot_us_p99"]:
+        problems.append("baseline leg metered no snapshots")
+    if args.write_baseline:
+        path = os.path.join(_REPO, BASELINE_NAME)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path}")
+
+    # 2. the scale leg: cascading stragglers at --workers
+    scenario = build_scenario("cascading_stragglers", args.workers,
+                              seed=args.seed)
+    report, scale_problems = _run_leg(args.workers, args.steps,
+                                      scenario=scenario, seed=args.seed,
+                                      detect=True)
+    problems.extend(scale_problems)
+    report["baseline"] = baseline
+
+    snap_p99 = (report["chief"]["snapshot_us"] or {}).get("p99")
+    if snap_p99 and baseline["snapshot_us_p99"]:
+        ratio = snap_p99 / baseline["snapshot_us_p99"]
+        if ratio > SNAPSHOT_GROWTH_LIMIT:
+            problems.append(
+                f"snapshot p99 {snap_p99:.0f}us at {args.workers} workers "
+                f"is {ratio:.1f}x the {BASELINE_WORKERS}-worker baseline "
+                f"({baseline['snapshot_us_p99']:.0f}us) — over the "
+                f"{SNAPSHOT_GROWTH_LIMIT:.0f}x bounded-chief limit")
+
+    out = args.out or os.path.join(
+        tempfile.mkdtemp(prefix="fleet_check_"), "scale.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    # 3. the W-code audit over the report must be clean (W005 only)
+    findings = fleet_audit(report)
+    codes = {f.code for f in findings}
+    if codes & {"W001", "W002", "W003", "W004"}:
+        for wf in findings:
+            if wf.code != "W005":
+                problems.append(f"fleet audit: {wf}")
+    if "W005" not in codes:
+        problems.append(f"fleet audit emitted no W005 table ({codes})")
+
+    if problems:
+        print(f"FAIL: {out}")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    det = report["detection"] or {}
+    print(f"OK: {args.workers} workers / {report['frames']} frame(s) at "
+          f"{report['frames_per_s']:.0f}/s; queue max "
+          f"{report['chief']['queue_depth']['max']} (bound "
+          f"{report['chief']['queue_depth']['bound']}), 0 dropped; "
+          f"snapshot p99 {snap_p99:.0f}us vs baseline "
+          f"{baseline['snapshot_us_p99']:.0f}us; straggler {det.get('addr')} "
+          f"surfaced in {det.get('latency_s'):.2f}s "
+          f"(budget {det.get('budget_s')}s, hook fired); W005 clean "
+          f"({out})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

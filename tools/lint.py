@@ -131,6 +131,19 @@ codebase:
         (seeded analysis fixtures) carry ``# noqa`` with a
         justification.  Scoped to ``autodist_tpu/`` and ``tools/``.
 
+  AD12  exact percentile computation over per-worker series in
+        ``autodist_tpu/telemetry/`` outside ``sketch.py``: a
+        ``statistics.median``/``statistics.quantiles`` call, a directly
+        subscripted ``sorted(...)[...]``, or a ``sorted()`` call inside
+        a *median*/*quantile*/*percentile*/*skew*-named function.  The
+        streaming chief folds hundreds of workers; an exact sort per
+        fold/snapshot is exactly how read latency creeps back to
+        O(workers log workers) and trips the W004 scale gate.  Route
+        through ``telemetry/sketch.py`` (``QuantileSketch`` for
+        mergeable streams, ``median_of``/``upper_median``/
+        ``quantiles_of`` for small bounded series) — the one blessed
+        sorting site.
+
 Exit code 1 when any finding is reported.
 """
 import ast
@@ -278,6 +291,26 @@ def _ad11_applies(path):
         and p.name not in _AD11_EXEMPT
 
 
+# AD12 applies inside autodist_tpu/telemetry/ only; sketch.py IS the
+# blessed exact-percentile site (it wraps the one sorted() the package
+# is allowed)
+_AD12_DIR = "telemetry"
+_AD12_EXEMPT = "sketch.py"
+_AD12_STAT_FNS = ("median", "median_low", "median_high", "quantiles")
+_AD12_CTX_WORDS = ("median", "quantile", "percentile", "skew")
+_AD12_MSG = ("exact percentile computation outside telemetry/sketch.py: "
+             "route per-worker series stats through QuantileSketch / "
+             "median_of / upper_median / quantiles_of so the streaming "
+             "chief's fold and snapshot paths stay sort-free (a "
+             "crept-back exact sort is the W004 scale regression)")
+
+
+def _ad12_applies(path):
+    p = Path(path)
+    return "autodist_tpu" in p.parts and _AD12_DIR in p.parts \
+        and p.name != _AD12_EXEMPT
+
+
 class Checker(ast.NodeVisitor):
     def __init__(self, path, source):
         self.path = path
@@ -291,6 +324,9 @@ class Checker(ast.NodeVisitor):
         self._socket_names = set()      # channel-creating names from socket
         self._lax_ppermute_names = set()  # AD11: ppermute from jax.lax
         self._flop_ctx = 0     # AD03: inside a flops-named def/assign
+        self._statistics_names = set()  # AD12: names from statistics
+        self._stat_ctx = 0     # AD12: inside a median/quantile-named def
+        self._ad12_seen = set()  # call nodes already flagged via subscript
 
     def add(self, lineno, code, msg):
         self.findings.append((self.path, lineno, code, msg))
@@ -319,6 +355,8 @@ class Checker(ast.NodeVisitor):
                 self._socket_names.add(a.asname or a.name)  # AD06 aliases
             if node.module == "jax.lax" and a.name == "ppermute":
                 self._lax_ppermute_names.add(a.asname or a.name)  # AD11
+            if node.module == "statistics" and a.name in _AD12_STAT_FNS:
+                self._statistics_names.add(a.asname or a.name)  # AD12
             self._record_import(a.asname or a.name, node.lineno)
 
     def visit_Name(self, node):
@@ -343,9 +381,13 @@ class Checker(ast.NodeVisitor):
         self._check_defaults(node)
         self._check_unused_locals(node)
         flop_fn = _ad03_applies(self.path) and "flop" in node.name.lower()
+        stat_fn = _ad12_applies(self.path) and any(
+            w in node.name.lower() for w in _AD12_CTX_WORDS)
         self._depth += 1
         self._flop_ctx += flop_fn
+        self._stat_ctx += stat_fn
         self.generic_visit(node)
+        self._stat_ctx -= stat_fn
         self._flop_ctx -= flop_fn
         self._depth -= 1
 
@@ -575,6 +617,20 @@ class Checker(ast.NodeVisitor):
                          "directory (AOT-proved by tools/mosaic_aot_check"
                          ".py, interpret-mode-tested on CPU); import the "
                          "wrapped op from autodist_tpu.ops.pallas instead")
+        # AD12: exact percentile computation in telemetry/ outside the
+        # blessed sketch.py sorting site
+        if _ad12_applies(self.path):
+            bare = (isinstance(f, ast.Attribute)
+                    and f.attr in _AD12_STAT_FNS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "statistics")
+            from_import = (isinstance(f, ast.Name)
+                           and f.id in self._statistics_names)
+            in_ctx = (self._stat_ctx and isinstance(f, ast.Name)
+                      and f.id == "sorted"
+                      and id(node) not in self._ad12_seen)
+            if bare or from_import or in_ctx:
+                self.add(node.lineno, "AD12", _AD12_MSG)
         # AD03: a shape-product inside flops-named code re-derives FLOP
         # accounting that must come from simulator/cost_model.py
         if (self._flop_ctx and self._is_prod_call(node)
@@ -623,6 +679,16 @@ class Checker(ast.NodeVisitor):
                      "call flight().dump so bundle layout, torn-file "
                      "detection and the P-audit's reconstruction "
                      "cannot drift")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # AD12: sorted(...)[k] — a nearest-rank percentile spelled inline
+        if (_ad12_applies(self.path)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "sorted"):
+            self._ad12_seen.add(id(node.value))
+            self.add(node.lineno, "AD12", _AD12_MSG)
         self.generic_visit(node)
 
     def visit_Compare(self, node):

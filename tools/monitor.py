@@ -2,7 +2,7 @@
 
 Usage::
 
-    python tools/monitor.py RUN_DIR [--once] [--interval S] [--json]
+    python tools/monitor.py RUN_DIR [--once] [--interval S] [--json] [--top N]
     python tools/monitor.py --listen [HOST:PORT] [--interval S]
 
 Two sources, one render:
@@ -23,6 +23,10 @@ Two sources, one render:
 ``--once`` renders a single frame and exits (the CI path —
 ``tools/monitor_check.py`` drives it); default is to refresh until
 interrupted.  Exit status 1 when there is nothing to show.
+
+``--top N`` keeps only the N worst workers (recent wall p50 descending,
+then heartbeat age — the same ranking the chief's bounded snapshot
+serves at fleet scale); ``--json`` always carries the full worker set.
 
 ``--postmortem`` switches to the black-box view: list the flight-
 recorder bundles under RUN_DIR (``postmortem/<trigger>_<step>/``),
@@ -68,13 +72,29 @@ def view_from_records(records):
     return view
 
 
-def render_view(snapshot, events=(), now=None):
-    """The status table: one row per worker, then skew + event tail."""
+def render_view(snapshot, events=(), now=None, top=None):
+    """The status table: one row per worker, then skew + event tail.
+
+    ``top=N`` reorders worst-first (recent wall p50 desc, then heartbeat
+    age — :func:`~autodist_tpu.telemetry.stream.rank_workers`, the same
+    ranking the chief's bounded snapshot serves) and keeps N rows; when
+    the snapshot itself is already truncated (a fleet-sized cluster's
+    auto top-k), the hidden remainder is counted either way."""
+    from autodist_tpu.telemetry.stream import rank_workers
+
+    workers = snapshot.get("workers") or {}
+    total = snapshot.get("workers_total", len(workers))
+    if top:
+        order = rank_workers(workers, top)
+    else:
+        order = sorted(workers)
     lines = []
     add = lines.append
     add(f"cluster view — {snapshot.get('frames', 0)} frame(s), "
-        f"front step {snapshot.get('front_step')}")
-    for w, e in sorted((snapshot.get("workers") or {}).items()):
+        f"front step {snapshot.get('front_step')}"
+        + (f", top {len(order)} of {total} worst-first" if top else ""))
+    for w in order:
+        e = workers[w]
         add(f"  w{w} {e.get('addr') or '?':20s} "
             f"step {str(e.get('last_step')):>5s} "
             f"(behind {e.get('steps_behind')}) "
@@ -82,6 +102,9 @@ def render_view(snapshot, events=(), now=None):
             f"age {_fmt_s(e.get('age_s'))} "
             f"health {e.get('health')} "
             f"findings {e.get('findings')}")
+    if total > len(order):
+        add(f"  ... +{total - len(order)} more worker(s) not shown "
+            f"(--json for the full set)")
     if snapshot.get("skew_s") is not None:
         add(f"  skew {_fmt_s(snapshot['skew_s'])}"
             + (f" — STRAGGLER {snapshot['straggler_addr']}"
@@ -174,8 +197,13 @@ def main(argv=None):
                     help="render a single frame and exit (CI path)")
     ap.add_argument("--interval", type=float, default=1.0,
                     help="refresh period in seconds (default 1)")
+    ap.add_argument("--top", type=int, default=None, metavar="N",
+                    help="show only the N worst workers (recent wall p50 "
+                         "descending, then heartbeat age — the chief's "
+                         "bounded-snapshot ranking)")
     ap.add_argument("--json", action="store_true",
-                    help="emit the snapshot as JSON instead of the table")
+                    help="emit the snapshot as JSON instead of the table "
+                         "(always the full worker set)")
     ap.add_argument("--postmortem", action="store_true",
                     help="list RUN_DIR's flight-recorder bundles with "
                          "their P-code root-cause verdicts instead of "
@@ -205,8 +233,12 @@ def main(argv=None):
     shown = False
     try:
         while True:
+            # --json always carries the full worker set (top=0 forces
+            # the O(workers) table); the rendered view defaults to the
+            # snapshot's own bounded auto-truncation at fleet scale
+            want_top = 0 if args.json else args.top
             if collector is not None:
-                snapshot, events = collector.view.snapshot(), []
+                snapshot, events = collector.view.snapshot(top=want_top), []
             else:
                 records, events, latest_t = _load_run_dir(args.path)
                 if not records:
@@ -217,14 +249,15 @@ def main(argv=None):
                     time.sleep(args.interval)
                     continue
                 view = view_from_records(records)
-                snapshot = view.snapshot(now=latest_t)
+                snapshot = view.snapshot(now=latest_t, top=want_top)
             shown = True
             if args.json:
                 print(json.dumps({"view": snapshot,
                                   "events": events[-20:]}, indent=2),
                       flush=True)
             else:
-                print(render_view(snapshot, events), flush=True)
+                print(render_view(snapshot, events, top=args.top),
+                      flush=True)
             if args.once:
                 return 0
             time.sleep(args.interval)

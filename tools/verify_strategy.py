@@ -76,6 +76,16 @@ Targets:
   fixtures under ``tests/data/postmortem`` must fire P001 naming the
   injected worker/step on the NaN-cascade bundle and P002 on the
   stall bundle while the control stays clean.
+- ``--fleet [SCALE_JSON]`` — run the SCALE tier (W-codes) over a fleet
+  scale report (the JSON ``tools/fleet_check.py`` assembles from a
+  simulated-cluster run): chief fold-in saturation is W001, a scripted
+  straggler surfaced past the MTTR budget W002, drops beyond the
+  best-effort budget W003, snapshot latency growing superlinearly vs
+  the committed 8-worker baseline W004 — and every audited report must
+  emit its W005 scale table; with ``--selftest``, the golden fixtures
+  under ``tests/data/fleet`` must fire W001 on the saturated-chief
+  report and W002 on the slow-detection report while the clean
+  512-worker control emits W005 only.
 - ``--runtime [TRACE_DIR]`` — run the RUNTIME audit tier (T-codes): a
   ``jax.profiler`` chrome-trace capture is parsed, its collective
   events matched against the strategy's intended channel table, and
@@ -233,6 +243,15 @@ def main(argv=None):
                          "bundle): first poisoned worker of a NaN "
                          "cascade is P001, a stall death P002; every "
                          "audited bundle must emit its P005 table")
+    ap.add_argument("--fleet", nargs="?", const="", default=None,
+                    metavar="SCALE_JSON",
+                    help="also run the SCALE tier (W-codes) over a fleet "
+                         "scale report (tools/fleet_check.py output): "
+                         "chief fold-in saturation is W001, detection "
+                         "past the MTTR budget W002, drops beyond "
+                         "budget W003, superlinear snapshot latency "
+                         "W004; every audited report must emit its "
+                         "W005 scale table")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write all reports as JSON to this path")
     ap.add_argument("-v", "--verbose", action="store_true",
@@ -240,11 +259,12 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     _force_cpu_devices()
-    from autodist_tpu.analysis import (EVENT_PASSES, LOCKSTEP_PASSES,
-                                       LOWERED_PASSES, POSTMORTEM_PASSES,
-                                       REGRESSION_PASSES, RUNTIME_PASSES,
-                                       SERVING_PASSES, STATIC_PASSES,
-                                       TRACE_PASSES, verify_strategy)
+    from autodist_tpu.analysis import (EVENT_PASSES, FLEET_PASSES,
+                                       LOCKSTEP_PASSES, LOWERED_PASSES,
+                                       POSTMORTEM_PASSES, REGRESSION_PASSES,
+                                       RUNTIME_PASSES, SERVING_PASSES,
+                                       STATIC_PASSES, TRACE_PASSES,
+                                       verify_strategy)
     from autodist_tpu.analysis.cases import (
         EXPECTED_AUDIT_ERROR_CODE, EXPECTED_DONATION_CODE,
         EXPECTED_ERROR_CODES, EXPECTED_LOCKSTEP_DIVERGENT_CODE,
@@ -304,6 +324,10 @@ def main(argv=None):
         base = passes if passes is not None else \
             STATIC_PASSES + TRACE_PASSES
         passes = base + POSTMORTEM_PASSES
+    if args.fleet is not None:
+        base = passes if passes is not None else \
+            STATIC_PASSES + TRACE_PASSES
+        passes = base + FLEET_PASSES
     trace_dir = args.runtime or None
     event_records = None
     if args.events:
@@ -331,6 +355,17 @@ def main(argv=None):
     # with the root-cause tier selected, every audited bundle must
     # produce its machine-readable P005 bundle table
     want_p005 = bool(passes) and "postmortem-audit" in passes
+    # with the scale tier selected, every audited scale report must
+    # produce its machine-readable W005 scale table
+    want_w005 = bool(passes) and "fleet-audit" in passes
+    fleet_scale = None
+    if args.fleet:
+        from autodist_tpu.analysis.fleet_audit import load_scale
+
+        try:
+            fleet_scale = load_scale(args.fleet)
+        except (OSError, ValueError) as e:
+            ap.error(f"--fleet {args.fleet}: cannot read scale report: {e}")
     postmortem_bundle = None
     if args.postmortem:
         from autodist_tpu.telemetry.flight_recorder import load_bundle
@@ -406,6 +441,23 @@ def main(argv=None):
                   f"postmortem audit produced no P005 table")
             failed = True
 
+    if args.fleet:
+        # a standalone scale-report target: audit the fleet run itself,
+        # with or without record targets alongside
+        from autodist_tpu.analysis.fleet_audit import fleet_audit
+        from autodist_tpu.analysis.report import Report
+
+        findings = fleet_audit(fleet_scale)
+        report = Report(strategy_id="fleet-scale")
+        report.extend(findings)
+        results[args.fleet] = report
+        _print_report(os.path.basename(args.fleet), report, args.verbose)
+        failed = failed or not report.ok
+        if not any(f.code == "W005" for f in findings):
+            print(f"[ERROR] {os.path.basename(args.fleet)}: fleet "
+                  f"audit produced no W005 table")
+            failed = True
+
     for path in args.targets:
         try:
             with open(path) as f:
@@ -435,6 +487,7 @@ def main(argv=None):
                                  event_records=event_records,
                                  serving_metrics=serving_metrics,
                                  postmortem_bundle=postmortem_bundle,
+                                 fleet_scale=fleet_scale,
                                  **case)
         results[path] = report
         _print_report(os.path.basename(path), report, args.verbose)
@@ -459,6 +512,13 @@ def main(argv=None):
             if p5 is None and postmortem_bundle is not None:
                 print(f"[ERROR] {os.path.basename(path)}: postmortem "
                       f"audit produced no P005 table")
+                failed = True
+        if want_w005:
+            w5 = next((f for f in report.findings if f.code == "W005"),
+                      None)
+            if w5 is None and fleet_scale is not None:
+                print(f"[ERROR] {os.path.basename(path)}: fleet "
+                      f"audit produced no W005 table")
                 failed = True
         if want_q004:
             q4 = next((f for f in report.findings if f.code == "Q004"),
@@ -814,6 +874,48 @@ def main(argv=None):
                     else:
                         print("postmortem selftest passed: the control "
                               "stays clean with its P005 table")
+        if args.fleet is not None:
+            # the golden scale-report fixtures (tests/data/fleet): the
+            # saturated-chief report must fire W001, the slow-detection
+            # report W002, and the clean 512-worker control must stay
+            # clean with its W005 scale table
+            from autodist_tpu.analysis.fleet_audit import \
+                audit_fixture as fleet_fixture
+            from autodist_tpu.analysis.report import Report
+
+            fixdir = os.path.join(REPO, "tests", "data", "fleet")
+            checks = (
+                ("saturated", "saturated.json", "W001"),
+                ("slow-detection", "slow_detection.json", "W002"),
+                ("control", "clean_512.json", None),
+            )
+            for label, fname, want in checks:
+                findings = fleet_fixture(os.path.join(fixdir, fname))
+                report = Report()
+                report.extend(findings)
+                results[f"<fleet-{label}-selftest>"] = report
+                _print_report(f"fleet selftest ({label})", report,
+                              args.verbose)
+                codes = {f.code for f in findings}
+                if want is not None:
+                    if want not in codes:
+                        print(f"[ERROR] fleet selftest ({label}): "
+                              f"expected {want} did not fire "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print(f"fleet selftest passed: the {label} "
+                              f"fixture fires {want}")
+                else:
+                    bad = codes & {"W001", "W002", "W003", "W004"}
+                    if bad or "W005" not in codes:
+                        print(f"[ERROR] fleet selftest (control): "
+                              f"expected a clean W005 "
+                              f"(got {sorted(codes)})")
+                        failed = True
+                    else:
+                        print("fleet selftest passed: the 512-worker "
+                              "control stays clean with its W005 table")
         if args.runtime is not None:
             # the golden trace fixtures (tests/data/trace): the
             # exposed-comm step must be caught as T001, the skewed
