@@ -2,7 +2,7 @@
 # lint, local tests, distributed tests, benchmarks).
 PY ?= python
 
-.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check postmortem-check fleet-check check
+.PHONY: test test-all test-dist native proto bench lint clean mosaic-aot aot-fused-norm verify audit telemetry-check timeline-check monitor-check chaos perf-gate serve-check postmortem-check fleet-check check
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -59,6 +59,13 @@ aot-gpt-levers:
 aot-equarx:
 	$(PY) tools/aot_equarx.py
 
+# fused-normalization lever proof (the F008 remediation): the fused
+# Pallas batch norm's deviceless Mosaic compile for v5e vs the unfused
+# reference lowering at the same norm site — >= 30% fewer XLA-counted
+# HBM bytes asserted; writes records/v5e_aot/fused_norm_lever.json
+aot-fused-norm:
+	$(PY) tools/aot_fused_norm.py
+
 lint:
 	$(PY) tools/lint.py
 	$(PY) -m compileall -q autodist_tpu tests examples
@@ -81,7 +88,11 @@ verify:
 # double-count against jaxpr_flops; the seeded remat case must be
 # caught as F002, the seeded all-f32 case as F003, the seeded
 # dropped-donation case as F004, and --suggest must map each to its
-# documented strategy/engine delta) plus the cross-rank LOCKSTEP
+# documented strategy/engine delta; every target must also emit its
+# F007 HBM-traffic table — per-region bytes, arithmetic intensity,
+# roofline legs — with F008 flagging any genuinely memory-bound step
+# toward the fused-norm/GroupNorm byte levers) plus the cross-rank
+# LOCKSTEP
 # verifier (L-codes: every strategy's step expanded into per-rank
 # rendezvous traces and proven deadlock-free with its L006 trace table;
 # the seeded broken-ring case must fire exactly L003 and the seeded

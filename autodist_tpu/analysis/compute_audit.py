@@ -35,6 +35,18 @@ prices the MFU ceiling statically:
                consumed by ``tools/telemetry_report.py --compute``,
                AutoStrategy's ``predicted_mfu_ceiling`` gauges and
                ``bench.py``'s cpu_proxy records
+  F007 INFO    machine-readable HBM-traffic table (``Finding.data``):
+               fusion-aware per-region bytes
+               (``cost_model.hbm_traffic_from_ops``), arithmetic
+               intensity, the roofline step time
+               ``max(flops/peak, bytes/bw)`` and its verdict word, and
+               the roofline-capped MFU ceiling — the byte view F006's
+               FLOP view cannot price
+  F008 WARNING memory-bound step: the roofline's HBM term dominates the
+               compute term beyond MEMORY_BOUND_RATIO at real traffic
+               volume, naming the top HBM-traffic sites (the measured
+               ResNet-50 83.4 GB/99.8 ms failure mode) — remediated by
+               the fused-norm / GroupNorm knob (``--suggest``)
 
 FLOP accounting is single-source: every per-op count routes through
 ``cost_model.dot_flops`` / ``conv_flops`` / ``elementwise_flops`` — the
@@ -80,6 +92,12 @@ BF16_MIN_FLOPS = 1e5
 # elementwise share of the realized work beyond which F005 fires
 ELEMENTWISE_SHARE_TOL = 0.25
 ELEMENTWISE_MIN_FLOPS = 1e5
+# F008 (memory-bound step) fires when the roofline's HBM term exceeds
+# the compute term by this factor AND the step moves real traffic —
+# the floor keeps the records sweep's tiny synthetic steps (a few kB)
+# from tripping a verdict that only means something at HBM scale
+MEMORY_BOUND_RATIO = 1.5
+MEMORY_BOUND_MIN_BYTES = 1e9
 
 CONTRACTION_KINDS = ("dot_general", "dot", "convolution")
 # the pretty-printer's single-line ``: tensor<...>`` ops (no regions);
@@ -96,6 +114,14 @@ ELEMENTWISE_KINDS = (
 
 _COMPUTE_RE = re.compile(
     r'"?stablehlo\.(' + "|".join(CONTRACTION_KINDS + ELEMENTWISE_KINDS)
+    + r')"?[\s(]')
+# the BYTE view additionally walks reductions (BN batch-stats, loss
+# means, optimizer norms): they move every operand byte through HBM even
+# though the FLOP-share heuristic above deliberately excludes them.
+# Kept as a separate regex so the F005/F006 FLOP tables stay pinned.
+_TRAFFIC_RE = re.compile(
+    r'"?stablehlo\.('
+    + "|".join(CONTRACTION_KINDS + ("reduce",) + ELEMENTWISE_KINDS)
     + r')"?[\s(]')
 # ``contracting_dims = [1] x [0]`` (pretty) / ``lhs_contracting_dimensions
 # = [1]`` (generic #stablehlo.dot attribute)
@@ -120,6 +146,9 @@ class ComputeOp:
     in_loop: bool = False
     count: float = 1.0        # static multiplicity (call sites x trips)
     region: str = "fwd"
+    in_bytes: float = 0.0     # operand bytes per execution (byte view)
+    in_types: tuple = ()      # operand tensor types (fused-region dedup key)
+    out_type: str = ""        # result tensor type
 
     @property
     def is_contraction(self):
@@ -205,12 +234,14 @@ def _parse_contraction(raw) -> Optional[ComputeOp]:
                 contract *= lhs_dims[d]
         flops = dot_flops(out_dims, contract)
     out_bytes, _ = _tensor_bytes(outs[0])
+    in_bytes = sum(_tensor_bytes(t)[0] for t in ins)
     sig = f"{raw.kind} ({', '.join(ins)}) -> {outs[0]} [{dims_note}]"
     shapes = sorted(list(ins) + [outs[0]])
     return ComputeOp(
         kind=raw.kind, flops=flops, out_bytes=out_bytes, dtype=lhs_dt,
         signature=sig, shape_key="|".join(shapes), function=raw.function,
-        in_loop=raw.in_loop, count=raw.count)
+        in_loop=raw.in_loop, count=raw.count, in_bytes=in_bytes,
+        in_types=tuple(ins), out_type=outs[0])
 
 
 def _parse_elementwise(raw) -> Optional[ComputeOp]:
@@ -223,11 +254,19 @@ def _parse_elementwise(raw) -> Optional[ComputeOp]:
         if not types:
             return None
         ty = types[-1]     # ``%1 = stablehlo.tanh %0 : tensor<8x32xf32>``
+        # shorthand trailer elides operand types (all equal to the
+        # result); operand COUNT is the SSA uses on the op line minus
+        # the result binding
+        n_in = max(1, raw.text.count("%") - 1)
+        ins = (ty,) * n_in
     dims, dt = _dims_of(ty)
+    out_bytes, _ = _tensor_bytes(ty)
     return ComputeOp(
         kind="elementwise", flops=elementwise_flops(dims), dtype=dt,
         signature=f"{raw.kind} {ty}", shape_key=ty, function=raw.function,
-        in_loop=raw.in_loop, count=raw.count)
+        in_loop=raw.in_loop, count=raw.count, out_bytes=out_bytes,
+        in_bytes=sum(_tensor_bytes(t)[0] for t in ins),
+        in_types=tuple(ins), out_type=ty)
 
 
 def extract_compute_ops(text: str) -> List[ComputeOp]:
@@ -240,6 +279,51 @@ def extract_compute_ops(text: str) -> List[ComputeOp]:
                                single_line_kinds=frozenset(ELEMENTWISE_KINDS)):
         op = (_parse_contraction(raw) if raw.kind in CONTRACTION_KINDS
               else _parse_elementwise(raw))
+        if op is not None:
+            ops.append(op)
+    _classify_regions(ops)
+    return ops
+
+
+def _parse_reduce(raw) -> Optional[ComputeOp]:
+    """A ``stablehlo.reduce``: one combiner application per input
+    element (the elementwise FLOP rule on the INPUT dims), and — the
+    part the byte view exists for — the full operand read plus the
+    reduced-result write."""
+    from autodist_tpu.simulator.cost_model import elementwise_flops
+
+    ins, outs = _split_types(raw.trailer)
+    if not ins or not outs:
+        return None
+    data_ins = [t for t in ins if "x" in t] or ins[:1]   # drop scalar inits
+    dims, dt = _dims_of(data_ins[0])
+    out_bytes = sum(_tensor_bytes(t)[0] for t in outs)
+    return ComputeOp(
+        kind="reduce", flops=elementwise_flops(dims), dtype=dt,
+        signature=f"reduce {data_ins[0]} -> {outs[0]}",
+        shape_key=data_ins[0], function=raw.function, in_loop=raw.in_loop,
+        count=raw.count, out_bytes=out_bytes,
+        in_bytes=sum(_tensor_bytes(t)[0] for t in data_ins),
+        in_types=tuple(data_ins), out_type=outs[0])
+
+
+def extract_traffic_ops(text: str) -> List[ComputeOp]:
+    """Parse the BYTE view of a lowered module: every
+    dot/conv/elementwise/reduce op with operand+result tensor types and
+    bytes filled in, through the same shared walker (scan-trip
+    multiplicities included).  Feeds
+    ``cost_model.hbm_traffic_from_ops`` and :func:`audit_traffic`; kept
+    separate from :func:`extract_compute_ops` so the pinned F005/F006
+    FLOP totals never shift when the byte walker grows new op kinds."""
+    ops = []
+    for raw in walk_module_ops(text, _TRAFFIC_RE,
+                               single_line_kinds=frozenset(ELEMENTWISE_KINDS)):
+        if raw.kind in CONTRACTION_KINDS:
+            op = _parse_contraction(raw)
+        elif raw.kind == "reduce":
+            op = _parse_reduce(raw)
+        else:
+            op = _parse_elementwise(raw)
         if op is not None:
             ops.append(op)
     _classify_regions(ops)
@@ -409,6 +493,82 @@ def audit_compute(ops: List[ComputeOp], *, model_flops=None,
     return findings
 
 
+def audit_traffic(ops: List[ComputeOp], *, model_flops=None,
+                  source="lowered module", peak_flops=None,
+                  hbm_gbps=None) -> List[Finding]:
+    """The BYTE view (F007/F008): price the module's static HBM traffic
+    through ``cost_model.hbm_traffic_from_ops``, put it on the roofline
+    against the realized FLOPs, and flag a memory-bound step.
+
+    ``ops`` is :func:`extract_traffic_ops` output.  All byte/second
+    arithmetic routes through the cost model's single-source rules
+    (``hbm_traffic_from_ops`` / ``roofline_s`` / ``roofline_bound`` /
+    ``predicted_mfu_ceiling`` — lint AD13 enforces the confinement)."""
+    from autodist_tpu.simulator.cost_model import (DEFAULT_HBM_GBPS,
+                                                   DEFAULT_PEAK_FLOPS,
+                                                   hbm_traffic_from_ops,
+                                                   predicted_mfu_ceiling,
+                                                   roofline_bound, roofline_s)
+
+    peak = DEFAULT_PEAK_FLOPS if peak_flops is None else peak_flops
+    bw = DEFAULT_HBM_GBPS if hbm_gbps is None else hbm_gbps
+    traffic = hbm_traffic_from_ops(ops)
+    total = traffic["total_bytes"]
+    realized = sum(op.total_flops for op in ops if op.is_contraction)
+    per_region = {}
+    for r in traffic["regions"]:
+        per_region[r["region"]] = per_region.get(r["region"], 0.0) \
+            + r["bytes"]
+    compute_s = (realized / peak) if peak else 0.0
+    hbm_s = total / (bw * 1e9) if bw else 0.0
+    rl = roofline_s(realized, total, peak_flops=peak, hbm_gbps=bw)
+    bound = roofline_bound(realized, total, peak_flops=peak, hbm_gbps=bw)
+    ceiling_rl = predicted_mfu_ceiling(
+        model_flops or realized, realized, hbm_bytes=total,
+        peak_flops=peak, hbm_gbps=bw)
+    top = traffic["regions"][:5]
+    data = {
+        "hbm_bytes": round(total, 1),
+        "by_class": traffic["by_class"],
+        "per_region": {k: round(v, 1) for k, v in sorted(per_region.items())},
+        "arithmetic_intensity": round(realized / total, 3) if total else None,
+        "compute_s": compute_s,
+        "hbm_s": hbm_s,
+        "roofline_s": rl,
+        "roofline_bound": bound,
+        "peak_flops": peak,
+        "hbm_gbps": bw,
+        "predicted_mfu_ceiling_roofline": round(ceiling_rl, 4),
+        "top_sites": top,
+        "n_regions": len(traffic["regions"]),
+        "n_ops": traffic["n_ops"],
+        "source": source,
+    }
+    findings = [Finding(
+        Severity.INFO, "F007", "compute-audit",
+        f"HBM-traffic table ({len(traffic['regions'])} fused region(s), "
+        f"{source}): {_fmt_bytes(total)}/step, arithmetic intensity "
+        + (f"{realized / total:.1f} FLOP/B" if total else "n/a")
+        + f", roofline {rl * 1e3:.2f} ms ({bound}-bound), "
+        f"roofline MFU ceiling {ceiling_rl:.3f}",
+        "traffic", data=data)]
+    if total >= MEMORY_BOUND_MIN_BYTES \
+            and hbm_s > compute_s * MEMORY_BOUND_RATIO:
+        sites = "; ".join(
+            f"{_fmt_bytes(r['bytes'])} {r['site']}"
+            f"{' [in-scan]' if r['in_loop'] else ''}" for r in top[:3])
+        findings.append(_f(
+            Severity.WARNING, "F008",
+            f"memory-bound step: HBM traffic {_fmt_bytes(total)} needs "
+            f"{hbm_s * 1e3:.2f} ms at {bw:.0f} GB/s vs "
+            f"{compute_s * 1e3:.2f} ms of MXU time "
+            f"({_fmt_flops(realized)}) — the roofline is "
+            f"{hbm_s / max(compute_s, 1e-12):.1f}x bytes-dominated (threshold "
+            f"{MEMORY_BOUND_RATIO}x); top HBM-traffic sites: {sites}",
+            "roofline"))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # lowered-level donation check (F004)
 # ---------------------------------------------------------------------------
@@ -506,9 +666,13 @@ def compute_audit_pass(ctx):
 
         model = jaxpr_flops(ctx.jaxpr)
     findings = audit_compute(ops, model_flops=model, source=source)
+    findings.extend(audit_traffic(
+        extract_traffic_ops(text), model_flops=model, source=source))
     args, outs = parse_main_signature(text)
     findings.extend(audit_donation(
         args, outs, getattr(ctx, "donated_invars", None), source))
     ctx.compute_summary = next(
         (f.data for f in findings if f.code == "F006"), None)
+    ctx.traffic_summary = next(
+        (f.data for f in findings if f.code == "F007"), None)
     return findings

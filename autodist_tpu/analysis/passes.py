@@ -89,6 +89,12 @@ Finding codes (stable; tests and tools match on them):
                threshold (MXU idles through HBM-bound epilogues)
   F006 INFO    machine-readable compute table + predicted MFU ceiling
                (carried in Finding.data)
+  F007 INFO    machine-readable HBM-traffic table: per-region bytes,
+               arithmetic intensity, both roofline legs and the
+               roofline-clamped MFU ceiling (carried in Finding.data)
+  F008 WARNING memory-bound step: the HBM byte leg dominates the MXU
+               leg beyond MEMORY_BOUND_RATIO — byte levers (fused
+               norm, GroupNorm), not FLOP levers, move the wall
   T000 INFO    runtime audit skipped (no trace capture available)
   T001 ERROR   measured exposed-comm fraction beyond the predicted
                exposure + tolerance (the promised overlap is not

@@ -3,7 +3,8 @@
 The lowered-tier compute audit (:mod:`compute_audit`) names what the
 lowering wastes — f32 contractions the MXU would run 2x faster on bf16
 (F003), recompute paying FLOPs for HBM the budget may not need back
-(F002), donations that silently became full per-step copies (F004).
+(F002), a bytes-dominated roofline the fused-norm knob lifts (F008),
+donations that silently became full per-step copies (F004).
 This module closes the loop: :func:`suggest_remediations` consumes a
 verify :class:`~autodist_tpu.analysis.report.Report` and emits concrete,
 machine-readable deltas — the builder kwargs or ``distribute()`` knobs
@@ -21,8 +22,8 @@ from typing import List, Optional
 
 # finding codes this module knows how to remediate, in the order the
 # suggestions are emitted (compute levers first — they move the MFU
-# ceiling — then the memory/donation repairs)
-REMEDIABLE_CODES = ("F003", "F002", "F004")
+# ceiling — then the byte/donation repairs)
+REMEDIABLE_CODES = ("F003", "F002", "F008", "F004")
 
 
 @dataclasses.dataclass
@@ -49,6 +50,11 @@ class Remediation:
 def _f006(report):
     return next((f.data for f in report.findings
                  if f.code == "F006" and f.data), None)
+
+
+def _f007(report):
+    return next((f.data for f in report.findings
+                 if f.code == "F007" and f.data), None)
 
 
 def _fmt_flops(f):
@@ -90,7 +96,14 @@ def _remediate_f002(finding, table) -> Remediation:
 
     The FLOPs-paid/HBM-saved trade lives in the F006 table's
     ``recompute`` groups (the F002 finding itself is prose); the gain
-    quotes the total across groups."""
+    prices BOTH sides of the keep-vs-recompute trade on the roofline —
+    the MXU seconds the recompute pays vs the HBM seconds re-reading the
+    kept residuals would cost — so the suggestion says which side the
+    chip actually wins."""
+    from autodist_tpu.simulator.cost_model import (DEFAULT_HBM_GBPS,
+                                                   DEFAULT_MXU_EFF,
+                                                   DEFAULT_PEAK_FLOPS)
+
     groups = (table or {}).get("recompute") or []
     paid = sum(g.get("flops_paid", 0.0) for g in groups)
     saved = sum(g.get("hbm_saved_bytes", 0.0) for g in groups)
@@ -98,6 +111,12 @@ def _remediate_f002(finding, table) -> Remediation:
     if paid:
         gain = (f"stop paying {_fmt_flops(paid)}/step for "
                 f"~{saved / 1e6:.1f} MB of residuals")
+        recompute_s = paid / (DEFAULT_PEAK_FLOPS * DEFAULT_MXU_EFF)
+        reread_s = saved / (DEFAULT_HBM_GBPS * 1e9)
+        verdict = "keep" if recompute_s > reread_s else "recompute"
+        gain += (f"; roofline: recompute costs {recompute_s * 1e6:.1f} us "
+                 f"of MXU vs {reread_s * 1e6:.1f} us of HBM re-reads — "
+                 f"{verdict} the residuals")
     return Remediation(
         code="F002", kind="engine",
         action="distribute(..., remat=False)",
@@ -106,6 +125,37 @@ def _remediate_f002(finding, table) -> Remediation:
                  "headroom, drop the remat policy (or narrow jax."
                  "checkpoint to the attention block) and keep the "
                  "residuals resident"),
+        expected_gain=gain)
+
+
+def _remediate_f008(finding, traffic) -> Remediation:
+    """Memory-bound step -> the fused-norm / GroupNorm model knob.
+
+    The expected bytes saved come from the audit's own traffic table:
+    the fused kernel collapses each normalization's separate stats /
+    normalize / epilogue round-trips into one read + one write, so
+    ~2/3 of the fused-region (non-MXU) HBM traffic disappears at the
+    norm sites."""
+    fused_bytes = ((traffic or {}).get("by_class") or {}).get("fused", 0.0)
+    gain = ""
+    if fused_bytes:
+        gain = (f"~{fused_bytes * (2.0 / 3.0) / 1e9:.2f} GB/step of "
+                f"norm-site HBM traffic fused away "
+                f"(records/v5e_aot/fused_norm_lever.json)")
+    if traffic and traffic.get("predicted_mfu_ceiling_roofline") is not None:
+        gain += (", lifting the roofline MFU ceiling "
+                 f"{traffic['predicted_mfu_ceiling_roofline']:.3f}"
+                 if gain else "lifts the roofline MFU ceiling "
+                 f"{traffic['predicted_mfu_ceiling_roofline']:.3f}")
+    return Remediation(
+        code="F008", kind="model",
+        action='ResNet(norm="bn_fused")  # or norm="gn"',
+        knob={"norm": "bn_fused"},
+        message=(finding.message + " — the fused Pallas batch norm "
+                 "(ops/pallas/fused_norm.py) computes stats + normalize "
+                 "+ scale-bias in one VMEM pass (one activation read "
+                 "instead of three); GroupNorm additionally removes the "
+                 "batch-stats traffic and its cross-replica skew"),
         expected_gain=gain)
 
 
@@ -130,12 +180,15 @@ def suggest_remediations(report) -> List["Remediation"]:
     F002 keeps the largest recompute group's numbers) and orders them
     by :data:`REMEDIABLE_CODES`."""
     table = _f006(report)
+    traffic = _f007(report)
     by_code = {}
     for f in report.findings:
         if f.code == "F003" and "F003" not in by_code:
             by_code["F003"] = _remediate_f003(f, table)
         elif f.code == "F002" and "F002" not in by_code:
             by_code["F002"] = _remediate_f002(f, table)
+        elif f.code == "F008" and "F008" not in by_code:
+            by_code["F008"] = _remediate_f008(f, traffic)
         elif f.code == "F004" and "F004" not in by_code:
             by_code["F004"] = _remediate_f004(f)
     return [by_code[c] for c in REMEDIABLE_CODES if c in by_code]

@@ -4,6 +4,9 @@ DenseNet121/InceptionV3, bert.py, ncf.py; ``examples/lm1b/`` LSTM LM)."""
 from autodist_tpu.models.resnet import (  # noqa: F401
     ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
 )
+from autodist_tpu.models.norm import (  # noqa: F401
+    FusedBatchNorm, FusedGroupNorm,
+)
 from autodist_tpu.models.vgg import VGG16  # noqa: F401
 from autodist_tpu.models.densenet import DenseNet121, DenseNet169  # noqa: F401
 from autodist_tpu.models.inception import InceptionV3  # noqa: F401

@@ -99,13 +99,34 @@ class ResNet(nn.Module):
     # pass (docs/performance.md), at a small stats-precision cost.  A perf
     # lever for bench sweeps (BENCH_BN_STATS=bf16), not the default.
     bn_f32_stats: bool = True
+    # "bn": flax nn.BatchNorm (XLA's multi-pass lowering; exact default).
+    # "bn_fused": the single-VMEM-pass Pallas batch norm
+    #   (ops/pallas/fused_norm.py) — one activation HBM read instead of
+    #   three, the F008 memory-bound remediation knob.
+    # "gn": fused GroupNorm — per-sample stats, no batch-stats traffic
+    #   or running-average state at all (BENCH_NORM=fused|gn in bench.py,
+    #   ":fused_norm"/":gn" strategy variants in examples/benchmark.py).
+    norm: str = "bn"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
-                       epsilon=1e-5, dtype=self.dtype,
-                       force_float32_reductions=self.bn_f32_stats)
+        if self.norm == "bn":
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           force_float32_reductions=self.bn_f32_stats)
+        elif self.norm == "bn_fused":
+            from autodist_tpu.models.norm import FusedBatchNorm
+
+            norm = partial(FusedBatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        elif self.norm == "gn":
+            from autodist_tpu.models.norm import FusedGroupNorm
+
+            norm = partial(FusedGroupNorm, num_groups=32, epsilon=1e-5,
+                           dtype=self.dtype)
+        else:
+            raise ValueError(f"unknown norm {self.norm!r}")
         x = x.astype(self.dtype)
         if self.stem == "space_to_depth":
             x = space_to_depth(x, 2)

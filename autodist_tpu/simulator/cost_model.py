@@ -190,9 +190,140 @@ def elementwise_flops(out_shape):
     return n
 
 
+# -- single-source HBM-byte accounting --------------------------------------
+# Every per-op HBM-traffic number routes through these rules, mirroring
+# the FLOP single-sourcing above (tools/lint.py AD13 rejects ad-hoc
+# itemsize/byte-product arithmetic in hbm/roofline/traffic contexts
+# elsewhere): the lowered-tier byte walker (analysis/compute_audit.py)
+# and the roofline terms below share them.
+
+
+def hbm_traffic_from_ops(ops):
+    """Fusion-aware static HBM-traffic model over a lowered module's
+    compute ops (``compute_audit.extract_traffic_ops`` — the shared
+    :func:`analysis.hlo_audit.walk_module_ops` walker with scan-trip
+    multiplicities).
+
+    Accounting rules:
+
+    - contractions (dot/conv) materialize their operands and results
+      individually: ``in_bytes + out_bytes`` per execution — MXU ops
+      anchor their own fusions;
+    - maximal runs of consecutive NON-contraction ops (elementwise +
+      reduce) in the same function/loop placement form one FUSED region:
+      XLA's fusion pass keeps the intermediate chain in
+      registers/VMEM, so the region bills each distinct external operand
+      buffer ONCE (deduped by tensor type within the region) plus one
+      materialized result write — never the per-op round-trips;
+    - every term scales by the op's static multiplicity (call sites x
+      scan trips, from the walker).
+
+    Returns ``{"total_bytes", "by_class": {"contraction", "fused"},
+    "regions": [...], "n_ops"}`` — ``regions`` entries carry ``bytes``,
+    ``kind``, ``site`` (a representative signature), ``function``,
+    ``in_loop``, ``count``, ``region`` (fwd/bwd/update/in-scan) and
+    ``n_ops``, sorted by descending bytes so F008 can name the top
+    HBM-traffic sites."""
+    regions = []
+    by_class = {"contraction": 0.0, "fused": 0.0}
+    run = None     # accumulating fused region
+
+    def flush():
+        nonlocal run
+        if run is None:
+            return
+        seen = set()
+        in_bytes = 0.0
+        for t, b in run["ins"]:
+            if t in seen:
+                continue
+            seen.add(t)
+            in_bytes += b
+        total = (in_bytes + run["out_bytes"]) * run["count"]
+        by_class["fused"] += total
+        regions.append({
+            "kind": "fused", "bytes": round(total, 1),
+            "site": run["site"], "function": run["function"],
+            "in_loop": run["in_loop"], "count": run["count"],
+            "region": run["region"], "n_ops": run["n_ops"]})
+        run = None
+
+    for op in ops:
+        count = max(1.0, float(getattr(op, "count", 1.0)))
+        if getattr(op, "is_contraction", False):
+            flush()
+            total = (float(op.in_bytes) + float(op.out_bytes)) * count
+            by_class["contraction"] += total
+            regions.append({
+                "kind": op.kind, "bytes": round(total, 1),
+                "site": op.signature, "function": op.function,
+                "in_loop": op.in_loop, "count": count,
+                "region": op.region, "n_ops": 1})
+            continue
+        key = (op.function, op.in_loop, count, op.region)
+        if run is not None and run["key"] != key:
+            flush()
+        if run is None:
+            run = {"key": key, "ins": [], "out_bytes": 0.0,
+                   "site": op.signature, "best": -1.0,
+                   "function": op.function, "in_loop": op.in_loop,
+                   "count": count, "region": op.region, "n_ops": 0}
+        in_types = getattr(op, "in_types", ()) or \
+            ((op.out_type,) if getattr(op, "out_type", "") else ())
+        for t in in_types:
+            run["ins"].append((t, float(op.in_bytes) / max(1, len(in_types))))
+        # the region's materialized write: its LAST op's result (earlier
+        # results are the chain's VMEM temporaries)
+        run["out_bytes"] = float(op.out_bytes)
+        if float(op.out_bytes) > run["best"]:
+            run["best"] = float(op.out_bytes)
+            run["site"] = op.signature
+        run["n_ops"] += 1
+    flush()
+    regions.sort(key=lambda r: -r["bytes"])
+    total = by_class["contraction"] + by_class["fused"]
+    return {"total_bytes": round(total, 1),
+            "by_class": {k: round(v, 1) for k, v in by_class.items()},
+            "regions": regions, "n_ops": len(ops)}
+
+
+def hbm_traffic(text):
+    """Static per-op HBM-traffic model of a lowered StableHLO module:
+    parse every dot/conv/elementwise/reduce op through the shared
+    ``analysis/hlo_audit.py`` walker and apply the fusion-aware byte
+    rules of :func:`hbm_traffic_from_ops`."""
+    from autodist_tpu.analysis.compute_audit import extract_traffic_ops
+
+    return hbm_traffic_from_ops(extract_traffic_ops(text))
+
+
+def roofline_s(flops, hbm_bytes, *, peak_flops=DEFAULT_PEAK_FLOPS,
+               hbm_gbps=DEFAULT_HBM_GBPS):
+    """Static roofline step time: ``max(flops / peak_flops,
+    bytes / hbm_bw)`` — the chip can never finish a step before it has
+    both issued the FLOPs and moved the bytes, so whichever term wins
+    names the bound.  ``flops`` should be the REALIZED count (the work
+    the chip actually executes), ``hbm_bytes`` the step's HBM traffic
+    (:func:`hbm_traffic`, or a measured number)."""
+    compute_s = float(flops) / float(peak_flops) if peak_flops else 0.0
+    hbm_s = float(hbm_bytes) / (float(hbm_gbps) * 1e9) if hbm_gbps else 0.0
+    return max(compute_s, hbm_s)
+
+
+def roofline_bound(flops, hbm_bytes, *, peak_flops=DEFAULT_PEAK_FLOPS,
+                   hbm_gbps=DEFAULT_HBM_GBPS):
+    """``"memory"`` when the HBM term of :func:`roofline_s` dominates the
+    compute term, else ``"compute"`` — the F007/F008 verdict word."""
+    compute_s = float(flops) / float(peak_flops) if peak_flops else 0.0
+    hbm_s = float(hbm_bytes) / (float(hbm_gbps) * 1e9) if hbm_gbps else 0.0
+    return "memory" if hbm_s > compute_s else "compute"
+
+
 def predicted_mfu_ceiling(model_flops, realized_flops,
                           mxu_eff=DEFAULT_MXU_EFF,
-                          f32_contraction_frac=0.0):
+                          f32_contraction_frac=0.0, *, hbm_bytes=None,
+                          peak_flops=DEFAULT_PEAK_FLOPS,
+                          hbm_gbps=DEFAULT_HBM_GBPS):
     """Best MFU the lowered program can reach: the calibrated MXU
     efficiency discounted by the lowering's FLOP overhead — MFU counts
     MODEL flops, the chip executes REALIZED flops, so
@@ -203,14 +334,31 @@ def predicted_mfu_ceiling(model_flops, realized_flops,
     at f32 (the F003 finding's ``f32_flops / total``): those run the MXU
     at ``1/F32_CONTRACTION_SLOWDOWN`` of the bf16 issue rate, so the
     ceiling (measured against bf16 peak) divides by the blended slowdown
-    — the term a bf16-master strategy sheds."""
+    — the term a bf16-master strategy sheds.
+
+    ``hbm_bytes`` (the step's HBM traffic, :func:`hbm_traffic` or a
+    measured number) adds the ROOFLINE ceiling: the step can never run
+    faster than ``roofline_s``, so the reachable MFU is also capped at
+    ``model_flops / (roofline_s * peak_flops)`` and the returned ceiling
+    is the min of the compute and roofline ceilings — a memory-bound
+    model finally reports an honest number instead of the MXU story.
+    Without ``hbm_bytes`` the pre-roofline behavior is unchanged (the
+    committed perf-gate baselines pin it)."""
     if not model_flops or not realized_flops or realized_flops <= 0:
         base = float(mxu_eff)
     else:
         base = float(mxu_eff) * min(
             1.0, float(model_flops) / float(realized_flops))
     f = min(1.0, max(0.0, float(f32_contraction_frac)))
-    return base / (1.0 + f * (F32_CONTRACTION_SLOWDOWN - 1.0))
+    ceiling = base / (1.0 + f * (F32_CONTRACTION_SLOWDOWN - 1.0))
+    if hbm_bytes:
+        mf = float(model_flops or realized_flops or 0.0)
+        rl = roofline_s(float(realized_flops or model_flops or 0.0),
+                        hbm_bytes, peak_flops=peak_flops,
+                        hbm_gbps=hbm_gbps)
+        if mf > 0.0 and rl > 0.0 and peak_flops:
+            ceiling = min(ceiling, mf / (rl * float(peak_flops)))
+    return ceiling
 
 
 def jaxpr_flops(jaxpr):

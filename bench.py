@@ -332,8 +332,14 @@ def _build_resnet(n_chips, batch_per_chip):
     # experiments only, never the recorded default)
     stem = os.environ.get("BENCH_STEM", "conv")
     bn_f32 = os.environ.get("BENCH_BN_STATS", "f32") != "bf16"
+    # BENCH_NORM=fused selects the single-VMEM-pass Pallas batch norm
+    # (the F008 memory-bound remediation — one activation HBM read
+    # instead of three); BENCH_NORM=gn the stat-free GroupNorm variant
+    norm = {"fused": "bn_fused", "gn": "gn"}.get(
+        os.environ.get("BENCH_NORM", "bn"), "bn")
     spec, sync_kwargs, sync_extras = _bench_sync(n_chips)
-    model = ResNet50(num_classes=1000, stem=stem, bn_f32_stats=bn_f32)
+    model = ResNet50(num_classes=1000, stem=stem, bn_f32_stats=bn_f32,
+                     norm=norm)
     loss_fn, params, state = train_lib.classifier_capture(model, (224, 224, 3))
     ad = AutoDist(resource_spec=spec,
                   strategy_builder=AllReduce(**sync_kwargs))
@@ -349,7 +355,7 @@ def _build_resnet(n_chips, batch_per_chip):
     gbatch["image"] = jnp.asarray(gbatch["image"], jnp.bfloat16)
     return sess, gbatch, MODELS["resnet50"]["train_flops_per_example"], {
         "stem": stem, "bn_stats": "f32" if bn_f32 else "bf16",
-        **sync_extras}
+        "norm": norm, **sync_extras}
 
 
 def _build_gpt(n_chips, batch_per_chip):
@@ -672,6 +678,17 @@ def _cpu_proxy(steps=8):
         if table:
             out["compute_audit"] = table
             out["predicted_mfu_ceiling"] = table["predicted_mfu_ceiling"]
+        # the F007 byte view of the same lowering: per-region HBM bytes,
+        # arithmetic intensity, and the roofline verdict ride in the
+        # record so memory-boundedness is diffable between windows too
+        traffic = next((f.data for f in report.findings
+                        if f.code == "F007"), None)
+        if traffic:
+            out["traffic_audit"] = {
+                k: traffic[k] for k in
+                ("hbm_bytes", "by_class", "arithmetic_intensity",
+                 "roofline_s", "roofline_bound",
+                 "predicted_mfu_ceiling_roofline") if k in traffic}
     except Exception as e:  # the proxy record is the priority
         out["compute_audit_error"] = f"{type(e).__name__}: {e}"
     return out

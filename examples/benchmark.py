@@ -25,7 +25,7 @@ import optax
 
 
 def build(model_name, seq_len, image_size, streaming_loss=False,
-          remat=False):
+          remat=False, norm="bn"):
     from autodist_tpu.models import (
         BERT_BASE, BERT_LARGE, DenseNet121, InceptionV3, LMConfig, NCFConfig,
         ResNet50, ResNet101, VGG16,
@@ -39,9 +39,16 @@ def build(model_name, seq_len, image_size, streaming_loss=False,
             f"--streaming_loss/--remat only apply to GPT/Llama, not "
             f"{model_name} — refusing to measure a configuration that "
             f"would not take effect")
+    if norm != "bn" and model_name not in ("resnet50", "resnet101"):
+        raise SystemExit(
+            f"':fused_norm'/':gn' swap the ResNet normalization layer, "
+            f"not {model_name}'s — refusing to measure a configuration "
+            f"that would not take effect")
     if model_name in ("resnet50", "resnet101", "vgg16", "densenet121", "inception_v3"):
-        model = {"resnet50": ResNet50, "resnet101": ResNet101, "vgg16": VGG16,
-                 "densenet121": DenseNet121, "inception_v3": InceptionV3}[model_name]()
+        cls = {"resnet50": ResNet50, "resnet101": ResNet101, "vgg16": VGG16,
+               "densenet121": DenseNet121, "inception_v3": InceptionV3}[model_name]
+        model = cls(norm=norm) if model_name in ("resnet50",
+                                                 "resnet101") else cls()
         loss_fn, params, state = train_lib.classifier_capture(
             model, (image_size, image_size, 3))
 
@@ -227,6 +234,22 @@ def _real_pipeline(args, cap, B, sess):
     return DevicePrefetcher(rebuild(), sess, depth=2)
 
 
+# MODEL-level strategy-string variants: consumed by build(norm=...), not
+# by the strategy builder — ':fused_norm' swaps ResNet's nn.BatchNorm for
+# the single-VMEM-pass Pallas kernel (the F008 memory-bound remediation),
+# ':gn' for the stat-free fused GroupNorm
+MODEL_VARIANTS = {"fused_norm": "bn_fused", "gn": "gn"}
+
+
+def _model_norm(strategy_name):
+    """The norm knob a ``Name:variant`` strategy string selects (the last
+    model-level variant wins; ``"bn"`` when none present)."""
+    _, _, variants = strategy_name.partition(":")
+    norms = [MODEL_VARIANTS[v] for v in variants.split(":")
+             if v in MODEL_VARIANTS]
+    return norms[-1] if norms else "bn"
+
+
 def _make_builder(args, strategy_name, resource_spec=None):
     """``Name`` or ``Name:variant[:variant]`` — AllReduce-family variants:
     ``overlap``/``barrier`` (sync schedule), ``two_level``/``flat``
@@ -239,8 +262,11 @@ def _make_builder(args, strategy_name, resource_spec=None):
     replica_ici`` factorization, e.g. ``--mesh
     "replica_dcn=2,replica_ici=4"``), e.g. ``AllReduce:two_level``,
     ``AllReduce:bf16_master`` or ``AllReduce:overlap:sharded_update``;
-    ``--ar_chunk_size`` sets the family's bucket-group granularity so
-    the overlap term has buckets to pipeline."""
+    the MODEL-level variants ``fused_norm``/``gn`` (ResNet norm knob —
+    see ``MODEL_VARIANTS``) ride the same string but are consumed by
+    ``build(norm=...)``; ``--ar_chunk_size`` sets the family's
+    bucket-group granularity so the overlap term has buckets to
+    pipeline."""
     from autodist_tpu import strategy as S
 
     name, _, variants = strategy_name.partition(":")
@@ -277,11 +303,14 @@ def _make_builder(args, strategy_name, resource_spec=None):
                     "request required)")
             kwargs["schedule_ir"] = entries[0]["ir"]
             kwargs.setdefault("hierarchy", "two_level")
+        elif variant in MODEL_VARIANTS:
+            pass  # model-level: consumed by build(norm=...), not the builder
         else:
             raise SystemExit(f"unknown strategy variant {variant!r} in "
                              f"{strategy_name!r} (overlap | barrier | "
                              f"two_level | flat | sharded_update | "
-                             f"bf16_master | equarx | searched_schedule)")
+                             f"bf16_master | equarx | searched_schedule | "
+                             f"fused_norm | gn)")
     if args.ar_chunk_size and issubclass(builder_cls, S.AllReduce):
         kwargs["chunk_size"] = args.ar_chunk_size
     return builder_cls(**kwargs)
@@ -360,7 +389,8 @@ def sweep(args):
         os.makedirs(records_dir, exist_ok=True)
     for name in strategies:
         cap = build(args.model, args.seq_len, args.image_size,
-                    streaming_loss=args.streaming_loss, remat=args.remat)
+                    streaming_loss=args.streaming_loss, remat=args.remat,
+                    norm=_model_norm(name))
         eps, record, sess = run_one(args, name, cap, n_chips)
         measured[name] = record.step_time_s
         est = estimate(sess._t.strategy, sess._t.model_item,
@@ -502,7 +532,8 @@ def main():
 
     n_chips = jax.device_count()
     cap = build(args.model, args.seq_len, args.image_size,
-                streaming_loss=args.streaming_loss, remat=args.remat)
+                streaming_loss=args.streaming_loss, remat=args.remat,
+                norm=_model_norm(args.autodist_strategy))
     _, record, sess = run_one(args, args.autodist_strategy, cap, n_chips)
     if args.records_dir:
         os.makedirs(args.records_dir, exist_ok=True)

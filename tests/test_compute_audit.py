@@ -7,6 +7,14 @@ level, the lowered donation check (F004), the jaxpr-vs-HLO FLOP
 reconciliation contract over the recorded sweep, the seeded recompute /
 dropped-donation cases, the engine verify gates, the AutoStrategy
 predicted-MFU-ceiling export, and the AD03 lint rule.
+
+Also covers the HBM byte view: the traffic extractor + hbm_traffic pins
+on the conv-fusion fixture (F007 table), the memory-bound flip (F008)
+in both directions plus its absolute-bytes floor, the roofline
+reconciliation against the measured v5e ResNet-50 step, the
+``predicted_mfu_ceiling(hbm_bytes=...)`` roofline clamp, the
+F008 -> fused-norm remediation knob, the committed GPT roofline-lever
+record, and the AD13 byte-arithmetic lint rule.
 """
 import os
 
@@ -456,3 +464,174 @@ def test_ad03_exempts_cost_model_tests_and_non_flop_products(tmp_path):
               "def step_flops(out, k):\n"
               "    return dot_flops(out, k)\n")
     assert "AD03" not in _lint_snippet(tmp_path, "autodist_tpu/r.py", routed)
+
+
+# -- HBM byte view: traffic extractor, F007/F008, roofline -------------------
+
+
+def test_traffic_extractor_pins_conv_fusion_fixture():
+    from autodist_tpu.analysis.compute_audit import extract_traffic_ops
+    from autodist_tpu.simulator.cost_model import hbm_traffic
+
+    traffic = hbm_traffic(_fixture("conv_fusion.stablehlo.txt"))
+    assert traffic["total_bytes"] == pytest.approx(44224.0)
+    assert traffic["by_class"] == {"contraction": pytest.approx(11456.0),
+                                   "fused": pytest.approx(32768.0)}
+    assert traffic["n_ops"] == 3
+    # the extractor feeds the same walker: one op per traffic site
+    ops = extract_traffic_ops(_fixture("conv_fusion.stablehlo.txt"))
+    assert len(ops) == 3
+    assert {o.kind for o in ops} == {"convolution", "elementwise"}
+
+
+def test_f007_table_always_present_with_roofline_fields():
+    from autodist_tpu.analysis.compute_audit import audit_traffic
+
+    ops = [_cop(1e9, kind="add", sig="add big", in_bytes=2e9, out_bytes=1e9,
+                in_types=("f32",), out_type="f32")]
+    findings = audit_traffic(ops, peak_flops=100e12, hbm_gbps=819.0)
+    f007 = next(f for f in findings if f.code == "F007")
+    assert f007.severity is Severity.INFO
+    for key in ("hbm_bytes", "by_class", "arithmetic_intensity", "compute_s",
+                "hbm_s", "roofline_s", "roofline_bound",
+                "predicted_mfu_ceiling_roofline", "top_sites"):
+        assert key in f007.data, key
+    assert f007.data["roofline_bound"] == "memory"
+    assert f007.data["hbm_bytes"] == pytest.approx(3e9)
+
+
+def test_f008_flips_on_bytes_dominated_and_stays_quiet_when_compute_bound():
+    from autodist_tpu.analysis.compute_audit import audit_traffic
+
+    # bytes dominate: 3 GB at 819 GB/s >> 1 GFLOP of MXU time
+    memory = [_cop(1e9, kind="add", sig="add big", in_bytes=2e9,
+                   out_bytes=1e9, in_types=("f32",), out_type="f32")]
+    codes = _codes(audit_traffic(memory, peak_flops=100e12, hbm_gbps=819.0))
+    assert codes.count("F008") == 1
+    f008 = next(f for f in audit_traffic(memory, peak_flops=100e12,
+                                         hbm_gbps=819.0) if f.code == "F008")
+    assert f008.severity is Severity.WARNING
+    assert "memory-bound" in f008.message
+    assert "add big" in f008.message  # names the top HBM site
+
+    # flops dominate: 1 PFLOP on a 100-TFLOP/s part vs 1.5 GB of traffic
+    compute = [_cop(1e15, sig="dot big", in_bytes=1e9, out_bytes=5e8,
+                    in_types=("bf16", "bf16"), out_type="f32")]
+    assert "F008" not in _codes(
+        audit_traffic(compute, peak_flops=100e12, hbm_gbps=819.0))
+
+
+def test_f008_respects_absolute_bytes_floor():
+    from autodist_tpu.analysis.compute_audit import (MEMORY_BOUND_MIN_BYTES,
+                                                     audit_traffic)
+
+    # heavily bytes-dominated ratio, but 3 MB total -- under the floor, so
+    # a toy step never carries the memory-bound warning
+    tiny = [_cop(1e3, kind="add", sig="add tiny", in_bytes=2e6, out_bytes=1e6,
+                 in_types=("f32",), out_type="f32")]
+    assert 3e6 < MEMORY_BOUND_MIN_BYTES
+    assert "F008" not in _codes(
+        audit_traffic(tiny, peak_flops=100e12, hbm_gbps=819.0))
+
+
+def test_roofline_reconciles_measured_v5e_resnet_step():
+    from autodist_tpu.simulator.cost_model import roofline_bound, roofline_s
+
+    # BENCH_MEASURED.json: 99.8 ms/step, XLA-counted 6.12 TFLOP, 83.4 GB
+    # of HBM traffic, 197 bf16 TFLOP/s peak, 819 GB/s HBM.  The byte leg
+    # is what explains the wall -- the step is memory-bound, and the
+    # roofline lands within 25% of the measured step time.
+    measured_s = 0.0998
+    pred = roofline_s(6.12e12, 83.4e9, peak_flops=197e12, hbm_gbps=819.0)
+    assert abs(pred - measured_s) / measured_s < 0.25
+    assert roofline_bound(6.12e12, 83.4e9,
+                          peak_flops=197e12, hbm_gbps=819.0) == "memory"
+    # and the bytes leg, not the flops leg, is the binding one
+    assert pred == pytest.approx(83.4e9 / (819.0 * 1e9))
+
+
+def test_predicted_mfu_ceiling_roofline_clamp():
+    # 2-arg behaviour is unchanged (pinned elsewhere); the opt-in
+    # hbm_bytes kwarg lowers the ceiling when the step is memory-bound
+    plain = predicted_mfu_ceiling(3.14e12, 6.12e12)
+    clamped = predicted_mfu_ceiling(3.14e12, 6.12e12, hbm_bytes=83.4e9,
+                                    peak_flops=197e12, hbm_gbps=819.0)
+    assert plain == pytest.approx(0.2309, abs=1e-4)
+    assert clamped == pytest.approx(0.1565, abs=1e-4)
+    assert clamped < plain
+    # compute-bound traffic leaves the ceiling alone
+    assert predicted_mfu_ceiling(
+        3.14e12, 6.12e12, hbm_bytes=1e6,
+        peak_flops=197e12, hbm_gbps=819.0) == pytest.approx(plain)
+
+
+def test_f008_maps_to_fused_norm_knob():
+    import types
+
+    from autodist_tpu.analysis.compute_audit import audit_traffic
+    from autodist_tpu.analysis.remediation import suggest_remediations
+
+    ops = [_cop(1e9, kind="add", sig="add big", in_bytes=2e9, out_bytes=1e9,
+                in_types=("f32",), out_type="f32")]
+    findings = audit_traffic(ops, peak_flops=100e12, hbm_gbps=819.0)
+    rems = {r.code: r for r in suggest_remediations(
+        types.SimpleNamespace(findings=findings))}
+    assert "F008" in rems
+    assert rems["F008"].kind == "model"
+    assert rems["F008"].knob == {"norm": "bn_fused"}
+    assert "bn_fused" in rems["F008"].action
+    assert rems["F008"].expected_gain
+
+
+def test_gpt_b32_lever_record_is_roofline_priced():
+    import json
+
+    from autodist_tpu.simulator.cost_model import (DEFAULT_HBM_GBPS,
+                                                   DEFAULT_MXU_EFF,
+                                                   DEFAULT_PEAK_FLOPS,
+                                                   roofline_s)
+
+    path = os.path.join(REPO, "records", "v5e_aot", "gpt_b32_lever.json")
+    with open(path) as f:
+        lever = json.load(f)
+    pred = roofline_s(lever["xla_flops"], lever["xla_bytes_accessed"],
+                      peak_flops=DEFAULT_PEAK_FLOPS * DEFAULT_MXU_EFF,
+                      hbm_gbps=DEFAULT_HBM_GBPS)
+    assert round(pred * 1e3, 2) == lever["roofline_pred_step_ms"]
+    assert lever["roofline_bound"] == "memory"
+    assert (lever["predicted_mfu_ceiling_roofline"]
+            < lever["predicted_mfu_ceiling"])
+
+
+# -- AD13: byte arithmetic routed through cost_model -------------------------
+
+
+_AD13_ITEMSIZE = ("def hbm_step_bytes(x):\n"
+                  "    return x.size * x.dtype.itemsize\n")
+_AD13_PROD = ("import math\n"
+              "def traffic_for(x):\n"
+              "    return 4 * math.prod(x.shape)\n")
+_AD13_ASSIGN = ("import numpy as np\n"
+                "roofline_bytes = x.size * x.dtype.itemsize\n")
+
+
+def test_ad13_flags_adhoc_byte_arithmetic_in_traffic_contexts(tmp_path):
+    assert "AD13" in _lint_snippet(tmp_path, "autodist_tpu/x.py",
+                                   _AD13_ITEMSIZE)
+    assert "AD13" in _lint_snippet(tmp_path, "tools/y.py", _AD13_PROD)
+    assert "AD13" in _lint_snippet(tmp_path, "autodist_tpu/z.py",
+                                   _AD13_ASSIGN)
+
+
+def test_ad13_exempts_blessed_walkers_tests_and_plain_byte_code(tmp_path):
+    # the single-source byte walkers are the blessed homes
+    for rel in ("autodist_tpu/simulator/cost_model.py",
+                "autodist_tpu/analysis/hlo_audit.py",
+                "autodist_tpu/analysis/compute_audit.py"):
+        assert "AD13" not in _lint_snippet(tmp_path, rel, _AD13_ITEMSIZE)
+    assert "AD13" not in _lint_snippet(tmp_path, "tests/t.py", _AD13_ITEMSIZE)
+    # byte arithmetic OUTSIDE an hbm/roofline/traffic-named context is the
+    # ordinary buffer-sizing idiom, not roofline accounting
+    ok = ("def bucket_bytes(x):\n"
+          "    return x.size * x.dtype.itemsize\n")
+    assert "AD13" not in _lint_snippet(tmp_path, "autodist_tpu/ok.py", ok)

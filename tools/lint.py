@@ -144,6 +144,20 @@ codebase:
         ``quantiles_of`` for small bounded series) — the one blessed
         sorting site.
 
+  AD13  ad-hoc HBM-byte arithmetic in engine/tool code: an ``.itemsize``
+        access or a shape-product (``prod(...)`` over ``.shape``) inside
+        an *hbm*/*roofline*/*traffic*-named function or assignment.
+        HBM-traffic accounting must route through
+        ``simulator/cost_model.py`` (``hbm_traffic`` /
+        ``hbm_traffic_from_ops`` / ``roofline_s``) and the audit walkers
+        (``analysis/hlo_audit.py`` / ``analysis/compute_audit.py`` — the
+        type-string parsers that feed it) so the roofline the F007/F008
+        audit prints and the bytes a tool prices a lever with can never
+        drift apart — a local ``nbytes`` re-derivation is exactly how a
+        double-counted operand slips into a memory-bound verdict.
+        Scoped to ``autodist_tpu/`` and ``tools/``; the three blessed
+        accounting sites are exempt.
+
 Exit code 1 when any finding is reported.
 """
 import ast
@@ -311,6 +325,24 @@ def _ad12_applies(path):
         and p.name != _AD12_EXEMPT
 
 
+# AD13 shares AD01's engine+tool scope; simulator/cost_model.py is the
+# single-source byte/roofline accounting site and the two audit walkers
+# (hlo_audit.py, compute_audit.py) are the type-string parsers feeding it
+_AD13_EXEMPT = ("cost_model.py", "hlo_audit.py", "compute_audit.py")
+_AD13_CTX_WORDS = ("hbm", "roofline", "traffic")
+_AD13_MSG = ("ad-hoc HBM-byte arithmetic ({what}) in a {word}-named "
+             "context: route byte accounting through simulator/"
+             "cost_model.py (hbm_traffic/hbm_traffic_from_ops/"
+             "roofline_s) and the audit walkers so the F007/F008 "
+             "roofline and lever-pricing tools cannot drift")
+
+
+def _ad13_applies(path):
+    p = Path(path)
+    return any(part in _AD01_PARTS for part in p.parts) \
+        and p.name not in _AD13_EXEMPT
+
+
 class Checker(ast.NodeVisitor):
     def __init__(self, path, source):
         self.path = path
@@ -324,6 +356,7 @@ class Checker(ast.NodeVisitor):
         self._socket_names = set()      # channel-creating names from socket
         self._lax_ppermute_names = set()  # AD11: ppermute from jax.lax
         self._flop_ctx = 0     # AD03: inside a flops-named def/assign
+        self._bytes_ctx = []   # AD13: hbm/roofline/traffic-named context
         self._statistics_names = set()  # AD12: names from statistics
         self._stat_ctx = 0     # AD12: inside a median/quantile-named def
         self._ad12_seen = set()  # call nodes already flagged via subscript
@@ -363,6 +396,12 @@ class Checker(ast.NodeVisitor):
         self.used.add(node.id)
 
     def visit_Attribute(self, node):
+        # AD13: a dtype .itemsize access inside an hbm/roofline/traffic-
+        # named context re-derives byte accounting that must come from
+        # simulator/cost_model.py + the audit walkers
+        if node.attr == "itemsize" and self._bytes_ctx:
+            self.add(node.lineno, "AD13", _AD13_MSG.format(
+                what=".itemsize", word=self._bytes_ctx[-1]))
         self.generic_visit(node)
 
     # -- other checks ------------------------------------------------------
@@ -383,10 +422,16 @@ class Checker(ast.NodeVisitor):
         flop_fn = _ad03_applies(self.path) and "flop" in node.name.lower()
         stat_fn = _ad12_applies(self.path) and any(
             w in node.name.lower() for w in _AD12_CTX_WORDS)
+        bytes_fn = _ad13_applies(self.path) and next(
+            (w for w in _AD13_CTX_WORDS if w in node.name.lower()), None)
         self._depth += 1
         self._flop_ctx += flop_fn
         self._stat_ctx += stat_fn
+        if bytes_fn:
+            self._bytes_ctx.append(bytes_fn)
         self.generic_visit(node)
+        if bytes_fn:
+            self._bytes_ctx.pop()
         self._stat_ctx -= stat_fn
         self._flop_ctx -= flop_fn
         self._depth -= 1
@@ -470,8 +515,15 @@ class Checker(ast.NodeVisitor):
                      "exactly how an L003 cross-epoch wrap slips in")
         flop_target = _ad03_applies(self.path) and any(
             "flop" in getattr(t, "id", "").lower() for t in node.targets)
+        bytes_target = _ad13_applies(self.path) and next(
+            (w for w in _AD13_CTX_WORDS for t in node.targets
+             if w in getattr(t, "id", "").lower()), None)
         self._flop_ctx += flop_target
+        if bytes_target:
+            self._bytes_ctx.append(bytes_target)
         self.generic_visit(node)
+        if bytes_target:
+            self._bytes_ctx.pop()
         self._flop_ctx -= flop_target
 
     # -- AD03: ad-hoc FLOP arithmetic --------------------------------------
@@ -631,6 +683,12 @@ class Checker(ast.NodeVisitor):
                       and id(node) not in self._ad12_seen)
             if bare or from_import or in_ctx:
                 self.add(node.lineno, "AD12", _AD12_MSG)
+        # AD13: a shape-product inside an hbm/roofline/traffic-named
+        # context is the byte-side twin of AD03
+        if (self._bytes_ctx and self._is_prod_call(node)
+                and self._has_shape_operand(node)):
+            self.add(node.lineno, "AD13", _AD13_MSG.format(
+                what="shape-product", word=self._bytes_ctx[-1]))
         # AD03: a shape-product inside flops-named code re-derives FLOP
         # accounting that must come from simulator/cost_model.py
         if (self._flop_ctx and self._is_prod_call(node)

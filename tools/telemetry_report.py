@@ -303,16 +303,25 @@ def load_compute(path):
     """Extract F006 compute tables from a compute-audit artifact: a
     ``verify_strategy --compute --json`` report (F006 findings carry the
     table in ``data``) or a bare ``AutoStrategy.last_compute_audit``
-    dict dump.  Returns ``[(name, table), ...]``."""
+    dict dump.  When the report also carries the F007 HBM-traffic table
+    it is attached under the F006 table's ``"traffic"`` key (the
+    roofline join).  Returns ``[(name, table), ...]``."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict) and "realized_flops" in doc:
         return [(doc.get("strategy", os.path.basename(path)), doc)]
     out = []
     for name, report in (doc.items() if isinstance(doc, dict) else []):
+        table, traffic = None, None
         for finding in report.get("findings", []):
             if finding.get("code") == "F006" and finding.get("data"):
-                out.append((os.path.basename(name), finding["data"]))
+                table = dict(finding["data"])
+            elif finding.get("code") == "F007" and finding.get("data"):
+                traffic = finding["data"]
+        if table is not None:
+            if traffic is not None:
+                table["traffic"] = traffic
+            out.append((os.path.basename(name), table))
     return out
 
 
@@ -369,6 +378,33 @@ def render_compute(computes, summary=None):
                     row += (f" ({measured / ceiling:.0%} of ceiling: "
                             f"{verdict})")
             lines.append(row)
+        traffic = table.get("traffic")
+        if traffic:
+            row = (f"  HBM traffic: "
+                   f"{_fmt_bytes(int(traffic.get('hbm_bytes', 0)))} "
+                   f"({traffic.get('arithmetic_intensity', 0):.1f} "
+                   f"flops/byte)  roofline "
+                   f"{_fmt_s(traffic.get('roofline_s', 0))}")
+            if summary and summary.get("hbm_peak_bytes") is not None:
+                row += (f"  — measured peak "
+                        f"{_fmt_bytes(int(summary['hbm_peak_bytes']))}")
+            lines.append(row)
+            bound = traffic.get("roofline_bound")
+            if bound:
+                verdict = (
+                    "the step is MEMORY-bound: byte levers (fused norm, "
+                    "norm=\"gn\", bf16 activations) move the wall, more "
+                    "MXU efficiency does not" if bound == "memory" else
+                    "the step is compute-bound: the F006 FLOP levers "
+                    "(remat off, bf16 contractions) move the wall, not "
+                    "byte traffic")
+                row = f"  roofline verdict: {verdict}"
+                if summary and summary.get("step_time_p50_s"):
+                    rl = traffic.get("roofline_s") or 0.0
+                    row += (f" (roofline explains "
+                            f"{rl / summary['step_time_p50_s']:.0%} of "
+                            f"the measured p50 wall)")
+                lines.append(row)
     return "\n".join(lines)
 
 
@@ -663,8 +699,12 @@ def main(argv=None):
                     help="compute-audit artifact (verify_strategy "
                          "--compute --json output or an "
                          "AutoStrategy.last_compute_audit dump): show the "
-                         "F006 FLOP table and join the predicted MFU "
-                         "ceiling against the measured achieved MFU")
+                         "F006 FLOP table, join the predicted MFU "
+                         "ceiling against the measured achieved MFU, and "
+                         "when the report carries the F007 HBM-traffic "
+                         "table, print the roofline memory-bound-vs-"
+                         "compute-bound verdict next to the measured "
+                         "memory_stats peak")
     ap.add_argument("--timeline", nargs="?", const="", default=None,
                     metavar="REPORT_JSON",
                     help="runtime-audit artifact (verify_strategy "

@@ -28,7 +28,9 @@ Targets:
   realized FLOP table of each target's lowering is diffed against the
   jaxpr's model FLOPs — recompute, bf16-eligible f32 contractions,
   dropped donations, elementwise share, and the predicted MFU ceiling
-  (the F006 table every target must emit); with ``--selftest``, the
+  (the F006 table every target must emit), plus the BYTE view: the
+  fusion-aware HBM-traffic table with its roofline verdict (F007, also
+  mandatory) and the memory-bound warning F008; with ``--selftest``, the
   seeded remat-everything case must be caught as F002 and the seeded
   dropped-donation case as F004.
 - ``--lockstep`` — run the cross-rank LOCKSTEP verifier (L-codes): each
@@ -191,7 +193,9 @@ def main(argv=None):
                     help="also run the lowered-tier HLO compute audit "
                          "(F-codes): realized-vs-model FLOPs, recompute, "
                          "dtype and donation checks, predicted MFU "
-                         "ceiling; every target must emit its F006 table")
+                         "ceiling, and the HBM-traffic/roofline byte "
+                         "view; every target must emit its F006 + F007 "
+                         "tables")
     ap.add_argument("--lockstep", action="store_true",
                     help="also run the cross-rank LOCKSTEP verifier "
                          "(L-codes): expand each strategy's step into "
@@ -585,6 +589,18 @@ def main(argv=None):
                           f"(precision-aware counting must attribute "
                           f"each contraction exactly once)")
                     failed = True
+            # the byte view rides the same pass: every target must also
+            # emit its F007 HBM-traffic table (roofline verdict included)
+            f7 = next((f for f in report.findings if f.code == "F007"),
+                      None)
+            if f7 is None:
+                print(f"[ERROR] {os.path.basename(path)}: compute audit "
+                      f"produced no F007 HBM-traffic table")
+                failed = True
+            elif f7.data.get("roofline_bound") not in ("memory", "compute"):
+                print(f"[ERROR] {os.path.basename(path)}: F007 carries "
+                      f"no roofline verdict")
+                failed = True
 
     for path in args.case:
         case = _load_case_file(path)
