@@ -96,7 +96,12 @@ verify:
 # verifier (L-codes: every strategy's step expanded into per-rank
 # rendezvous traces and proven deadlock-free with its L006 trace table;
 # the seeded broken-ring case must fire exactly L003 and the seeded
-# divergent-cond case exactly L001)
+# divergent-cond case exactly L001) plus the DETERMINISM tier (N-codes:
+# every strategy's PRNG key lineage, batch-shard coverage, and lowered
+# order-hazard scatters audited — every target must emit its N006
+# key-lineage table with its determinism class and zero N001-N003; the
+# seeded replicated-dropout case must fire exactly N001 and the seeded
+# shard-overlap case exactly N003)
 audit:
 	$(PY) tools/verify_strategy.py --hlo records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --hlo --selftest
@@ -104,6 +109,8 @@ audit:
 	$(PY) tools/verify_strategy.py --compute --suggest --selftest
 	$(PY) tools/verify_strategy.py --lockstep records/cpu_mesh/*.json
 	$(PY) tools/verify_strategy.py --lockstep --selftest
+	$(PY) tools/verify_strategy.py --determinism records/cpu_mesh/*.json
+	$(PY) tools/verify_strategy.py --determinism --selftest
 
 # live telemetry gate (docs/observability.md): a 5-step CPU-mesh session
 # with telemetry on must emit a schema-valid JSONL manifest with per-step

@@ -39,6 +39,12 @@ pluggable passes producing a severity-ranked :class:`Report`:
   produced (``tools/fleet_check.py``) judged against the bounded-chief
   contract (fold-in saturation, MTTR detection latency, drop budget,
   snapshot growth vs the committed 8-worker baseline) — W-codes
+- ``determinism-audit`` — DETERMINISM tier: PRNG key lineage (the
+  split/fold_in derivation graph joined with the varying-axes
+  analysis), batch_spec x mesh shard coverage, and lowered order-hazard
+  scatters — proving key independence, shard disjointness, and the
+  strategy's determinism class (bitwise | reduction_order | stochastic)
+  before a step runs — N-codes
 
 Entry points: :func:`verify_strategy` (library), ``tools/verify_strategy.py``
 (CLI, ``make verify``), the ``verify=`` knob on
@@ -47,7 +53,8 @@ See ``docs/analysis.md``.
 """
 from autodist_tpu.analysis.report import (Finding, Report, Severity,  # noqa: F401
                                           StrategyVerificationError)
-from autodist_tpu.analysis.passes import (EVENT_PASSES, FLEET_PASSES,  # noqa: F401
+from autodist_tpu.analysis.passes import (DETERMINISM_PASSES,  # noqa: F401
+                                          EVENT_PASSES, FLEET_PASSES,
                                           LOCKSTEP_PASSES, LOWERED_PASSES,
                                           PASS_REGISTRY, POSTMORTEM_PASSES,
                                           REGRESSION_PASSES, RUNTIME_PASSES,

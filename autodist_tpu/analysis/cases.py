@@ -38,6 +38,14 @@ EXPECTED_PRECISION_CODE = "F003"
 # other pass's ERROR set and caught ONLY by the lockstep tier.
 EXPECTED_LOCKSTEP_RING_CODE = "L003"
 EXPECTED_LOCKSTEP_DIVERGENT_CODE = "L001"
+# the two seeded determinism cases for the N-code tier
+# (``tools/verify_strategy.py --determinism --selftest``): a dropout
+# mask drawn from a replicated key (build_replicated_dropout_case) and
+# a replicated batch_spec leaving every replica reading the same rows
+# (build_shard_overlap_case).  Both are clean under every other pass's
+# ERROR set and caught ONLY by the determinism tier.
+EXPECTED_DETERMINISM_DROPOUT_CODE = "N001"
+EXPECTED_DETERMINISM_SHARD_CODE = "N003"
 
 
 def build_rejected_case(num_chips=8):
@@ -318,6 +326,104 @@ def build_ppermute_ring_case(num_chips=8):
         resource_spec=spec,
         batch_shapes={"x": ((num_chips * 16, d), "float32")},
         hbm_bytes_per_device=16 * 1024 ** 3,
+    )
+
+
+def build_replicated_dropout_case(num_chips=8):
+    """The seeded REPLICATED-DROPOUT case for the determinism tier
+    (``tools/verify_strategy.py --determinism --selftest``).
+
+    The loss hand-rolls dropout from a key built INSIDE the step —
+    ``jax.random.PRNGKey(0)`` with no ``fold_in(axis_index)`` — so every
+    data replica draws the IDENTICAL mask and the "independent" gradient
+    noise is perfectly correlated across the mesh.  The classic
+    loss-still-decreases bug: numerically nothing diverges, no
+    collective deadlocks, the spec lints clean, FLOPs and bytes match
+    the plan — every existing tier passes.  Only the key-lineage walk
+    joined with the varying-axes analysis sees that a replicated key
+    feeds a draw applied to data-varying activations: ``N001``
+    (:data:`EXPECTED_DETERMINISM_DROPOUT_CODE`), remediated by
+    ``utils/rng.replica_key``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    d = 64
+    params = {"w": jnp.zeros((d, d))}
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w"])   # (B_local, d) data-varying
+        # the bug: a raw in-step key, never folded with axis_index — the
+        # blessed constructors (utils/rng.py) would make this per-replica
+        key = jax.random.PRNGKey(0)  # noqa: AD14 seeded replicated-key fixture
+        mask = jax.random.bernoulli(key, 0.9, h.shape)
+        h = jnp.where(mask, h / 0.9, 0.0)
+        return (jnp.mean(jnp.square(h))
+                + 1e-6 * sum(jnp.sum(jnp.square(x))
+                             for x in jax.tree.leaves(p)))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 16, d), "float32")},
+        hbm_bytes_per_device=16 * 1024 ** 3,
+    )
+
+
+def build_shard_overlap_case(num_chips=8):
+    """The seeded SHARD-OVERLAP case for the determinism tier
+    (``tools/verify_strategy.py --determinism --selftest``).
+
+    A perfectly ordinary MLP — no stray collectives, no bad specs, fits
+    HBM — distributed with ``batch_spec=P()``: the global batch is
+    REPLICATED onto every device instead of sharded over the data axis.
+    Each "replica" computes the same gradient on the same rows, the
+    all-reduce averages R identical contributions, and the effective
+    global batch is R times smaller than the engine accounts for — loss
+    still decreases, every existing tier is clean.  Only the static
+    batch_spec x mesh coverage diff sees the overlap: ``N003``
+    (:data:`EXPECTED_DETERMINISM_SHARD_CODE`), remediated by the
+    corrected ``batch_spec``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+
+    d = 64
+    params = {"w": jnp.zeros((d, d))}
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w"])
+        return (jnp.mean(jnp.square(h))
+                + 1e-6 * sum(jnp.sum(jnp.square(x))
+                             for x in jax.tree.leaves(p)))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(num_chips)
+    strategy = AllReduce().build(item, spec)
+    return dict(
+        strategy=strategy,
+        model_item=item,
+        resource_spec=spec,
+        batch_shapes={"x": ((num_chips * 16, d), "float32")},
+        hbm_bytes_per_device=16 * 1024 ** 3,
+        # the bug: replicate the batch instead of sharding it over the
+        # data axis — forwarded to GraphTransformer(batch_spec=...)
+        batch_spec=P(),
     )
 
 

@@ -148,6 +148,23 @@ Finding codes (stable; tests and tools match on them):
                predicates (collective-free loop body)
   L006 INFO    machine-readable per-rank trace table (carried in
                Finding.data; lands on ctx.lockstep_summary)
+  N000 INFO    determinism audit skipped (nothing attached to analyze)
+  N001 ERROR   replicated PRNG key feeds a per-replica stochastic op:
+               identical dropout masks/noise on every data replica
+               (correlated gradient noise; named key + mesh axes)
+  N002 ERROR   key stream reused: one key consumed by two random ops,
+               or inside a scan without a per-iteration split/fold_in
+  N003 ERROR   batch-shard overlap/gap: batch_spec x mesh coverage
+               broken (replicas reading the same rows, or shards the
+               gradient sync never reconciles)
+  N004 WARNING nondeterministic lowered op (possibly-colliding scatter)
+               inside a strategy whose contract is otherwise bitwise
+  N005 WARNING shard_map-body key derived without an axis-index fold_in
+               where per-replica variance is required
+  N006 INFO    machine-readable key-lineage table + the strategy's
+               determinism class (bitwise | reduction_order |
+               stochastic; carried in Finding.data, lands on
+               ctx.determinism_summary)
   TR001 ERROR  tracing the strategy's train step failed
   TR002 INFO   trace skipped (trace passes did not run)
 
@@ -185,6 +202,15 @@ interpreter that expands the traced jaxpr, the lowered module's
 replica_groups, and the schedule-IR bucket programs into each rank's
 ordered rendezvous trace and proves the emitted schedule deadlock-free
 — the gate ``schedule_search`` runs on every candidate before pricing.
+The N-codes form the DETERMINISM tier
+(:mod:`autodist_tpu.analysis.determinism_audit`): a PRNG key-lineage
+dataflow walk (split/fold_in derivation graph joined with the C-tier
+varying-axes analysis), the batch_spec x mesh shard-coverage diff, and
+an HLO leg for order-hazard scatters — proving key independence, shard
+disjointness, and each strategy's determinism CLASS (``bitwise |
+reduction_order | stochastic``, the contract the elastic reshard gate
+and the equivalence tests consume via ``determinism_class``) before a
+step runs.
 """
 import numpy as np
 
@@ -899,6 +925,16 @@ def lockstep_audit_pass(ctx):
     return _run(ctx)
 
 
+def determinism_audit_pass(ctx):
+    """Determinism-tier pass: PRNG key lineage + batch-shard coverage +
+    lowered order-hazard scatters, exporting the strategy's determinism
+    class (:mod:`autodist_tpu.analysis.determinism_audit`)."""
+    from autodist_tpu.analysis.determinism_audit import \
+        determinism_audit_pass as _run
+
+    return _run(ctx)
+
+
 def runtime_audit_pass(ctx):
     """Runtime-tier pass: the measured timeline of a ``jax.profiler``
     capture vs the intended channels and the cost estimate, plus
@@ -971,6 +1007,7 @@ PASS_REGISTRY = {
     "hlo-audit": hlo_audit_pass,
     "compute-audit": compute_audit_pass,
     "lockstep-audit": lockstep_audit_pass,
+    "determinism-audit": determinism_audit_pass,
     "runtime-audit": runtime_audit_pass,
     "regression-audit": regression_audit_pass,
     "reaction-audit": reaction_audit_pass,
@@ -992,6 +1029,12 @@ LOWERED_PASSES = ("hlo-audit", "compute-audit")
 # the CLI's --lockstep, the runner/AOT verify gates, and the
 # schedule_search / AutoStrategy candidate gate
 LOCKSTEP_PASSES = ("lockstep-audit",)
+# the DETERMINISM tier: PRNG key-lineage + shard-coverage + lowered
+# order-hazard analysis exporting the strategy's determinism class;
+# opt-in via verify_strategy(passes=...), the CLI's --determinism, the
+# runner/AOT verify gates, the elastic reshard gate, and AutoStrategy's
+# candidate audit
+DETERMINISM_PASSES = ("determinism-audit",)
 # passes over a MEASURED jax.profiler capture + aggregated manifests;
 # opt-in via verify_strategy(passes=..., trace_dir=...), the CLI's
 # --runtime, and the watchdog's post-capture auto-analysis

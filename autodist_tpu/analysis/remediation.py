@@ -21,9 +21,11 @@ import dataclasses
 from typing import List, Optional
 
 # finding codes this module knows how to remediate, in the order the
-# suggestions are emitted (compute levers first — they move the MFU
-# ceiling — then the byte/donation repairs)
-REMEDIABLE_CODES = ("F003", "F002", "F008", "F004")
+# suggestions are emitted (determinism correctness repairs first — a
+# replicated key or an overlapping shard silently corrupts training —
+# then the compute levers that move the MFU ceiling, then the
+# byte/donation repairs)
+REMEDIABLE_CODES = ("N001", "N003", "F003", "F002", "F008", "F004")
 
 
 @dataclasses.dataclass
@@ -174,6 +176,42 @@ def _remediate_f004(finding) -> Remediation:
         expected_gain="removes one full state-buffer copy per step")
 
 
+def _remediate_n001(finding) -> Remediation:
+    """Replicated key feeding a per-replica stochastic op -> derive the
+    key through utils/rng.replica_key (fold_in(axis_index)) so every
+    data replica draws an independent stream."""
+    axes = (finding.data or {}).get("varying") or []
+    return Remediation(
+        code="N001", kind="model",
+        action='key = rng.replica_key(key, axis="replica")',
+        knob={"rng": "replica_key"},
+        message=(finding.message + " — utils/rng.replica_key folds "
+                 "axis_index into the key inside the shard_map body, so "
+                 "the lineage tracker proves the derived stream differs "
+                 "per replica at trace time"
+                 + (f" (current varying axes: {axes})" if axes else "")),
+        expected_gain=("independent dropout masks / noise per data "
+                       "replica — gradient noise decorrelates"))
+
+
+def _remediate_n003(finding) -> Remediation:
+    """Batch-shard overlap/gap -> correct the batch_spec so the data
+    axes partition the batch exactly once."""
+    spec = (finding.data or {}).get("suggested_batch_spec") or []
+    spec_str = ", ".join(repr(a) for a in spec) or "<data axes>"
+    return Remediation(
+        code="N003", kind="engine",
+        action=f"distribute(..., batch_spec=P(({spec_str}),))",
+        knob={"batch_spec": list(spec)},
+        message=(finding.message + " — shard the batch dimension over "
+                 "exactly the data axes so every replica reads a "
+                 "disjoint shard and the gradient sync reconciles all "
+                 "of them"),
+        expected_gain=("each replica trains on distinct rows; the "
+                       "effective global batch matches the accounted "
+                       "one"))
+
+
 def suggest_remediations(report) -> List["Remediation"]:
     """Map a verify/audit :class:`Report`'s F-code findings to concrete
     strategy/engine deltas.  Dedups by code (one delta per waste class —
@@ -183,7 +221,11 @@ def suggest_remediations(report) -> List["Remediation"]:
     traffic = _f007(report)
     by_code = {}
     for f in report.findings:
-        if f.code == "F003" and "F003" not in by_code:
+        if f.code == "N001" and "N001" not in by_code:
+            by_code["N001"] = _remediate_n001(f)
+        elif f.code == "N003" and "N003" not in by_code:
+            by_code["N003"] = _remediate_n003(f)
+        elif f.code == "F003" and "F003" not in by_code:
             by_code["F003"] = _remediate_f003(f, table)
         elif f.code == "F002" and "F002" not in by_code:
             by_code["F002"] = _remediate_f002(f, table)

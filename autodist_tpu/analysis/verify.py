@@ -19,9 +19,10 @@ does not mask every other finding behind a trace error.
 import dataclasses
 from typing import Any, Dict, Optional
 
-from autodist_tpu.analysis.passes import (EVENT_PASSES, FLEET_PASSES,
-                                          LOCKSTEP_PASSES, LOWERED_PASSES,
-                                          PASS_REGISTRY, POSTMORTEM_PASSES,
+from autodist_tpu.analysis.passes import (DETERMINISM_PASSES, EVENT_PASSES,
+                                          FLEET_PASSES, LOCKSTEP_PASSES,
+                                          LOWERED_PASSES, PASS_REGISTRY,
+                                          POSTMORTEM_PASSES,
                                           REGRESSION_PASSES, RUNTIME_PASSES,
                                           SERVING_PASSES, STATIC_PASSES,
                                           TRACE_PASSES)
@@ -63,6 +64,9 @@ class AnalysisContext:
     audit_summary: Optional[dict] = None
     # the lockstep verifier's machine-readable L006 per-rank trace table
     lockstep_summary: Optional[dict] = None
+    # the determinism audit's machine-readable N006 table (key lineage +
+    # the strategy's determinism class: bitwise|reduction_order|stochastic)
+    determinism_summary: Optional[dict] = None
     # the compute audit's machine-readable table (the F006 payload:
     # model/realized FLOPs, per-region attribution, predicted MFU ceiling)
     compute_summary: Optional[dict] = None
@@ -238,8 +242,10 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
     trace_selected = [p for p in selected if p in TRACE_PASSES]
     lowered_selected = [p for p in selected if p in LOWERED_PASSES]
     lockstep_selected = [p for p in selected if p in LOCKSTEP_PASSES]
+    determinism_selected = [p for p in selected if p in DETERMINISM_PASSES]
     runtime_selected = [p for p in selected if p in RUNTIME_PASSES]
-    if trace_selected or lowered_selected or lockstep_selected:
+    if trace_selected or lowered_selected or lockstep_selected \
+            or determinism_selected:
         _run_trace(ctx, report, transformer, rng)
         for name in trace_selected:
             report.extend(PASS_REGISTRY[name](ctx))
@@ -248,6 +254,10 @@ def verify_transformer(transformer, batch_shapes, *, donate=True,
         # lockstep tier after the lowered tier: it expands the same
         # trace/lowering into per-rank rendezvous traces
         for name in lockstep_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+        # determinism tier last: key lineage over the same trace, plus
+        # the lowered leg's order-hazard scatter walk
+        for name in determinism_selected:
             report.extend(PASS_REGISTRY[name](ctx))
     for name in runtime_selected:
         report.extend(PASS_REGISTRY[name](ctx))
@@ -377,7 +387,9 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
     trace_selected = [p for p in selected if p in TRACE_PASSES]
     lowered_selected = [p for p in selected if p in LOWERED_PASSES]
     lockstep_selected = [p for p in selected if p in LOCKSTEP_PASSES]
-    if trace_selected or lowered_selected or lockstep_selected:
+    determinism_selected = [p for p in selected if p in DETERMINISM_PASSES]
+    if trace_selected or lowered_selected or lockstep_selected \
+            or determinism_selected:
         if batch_shapes is None or model_item is None:
             report.add(Severity.INFO, "TR002", "trace",
                        "trace skipped: no batch_shapes/model given — trace "
@@ -397,6 +409,10 @@ def verify_strategy(strategy, model_item=None, resource_spec=None, *,
         # module, and the schedule-IR bucket programs into per-rank
         # rendezvous traces and proves them deadlock-free
         for name in lockstep_selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+        # determinism tier after it: PRNG key lineage + shard coverage
+        # over the same trace, order-hazard scatters off the same lowering
+        for name in determinism_selected:
             report.extend(PASS_REGISTRY[name](ctx))
 
     # runtime (measured) tier: needs no trace of its own — it consumes

@@ -272,9 +272,11 @@ def aot_compile_step(
         # the real TPU lowering (the realized collective schedule vs the
         # strategy's plan — an implicit reshard is an X001 ERROR), PLUS
         # the lockstep tier proving the real lowering's rendezvous
-        # schedule deadlock-free rank by rank; an infeasible strategy
-        # raises here, before the minutes-long compile
-        from autodist_tpu.analysis.passes import (LOCKSTEP_PASSES,
+        # schedule deadlock-free rank by rank, PLUS the determinism tier
+        # proving key independence and shard disjointness; an infeasible
+        # strategy raises here, before the minutes-long compile
+        from autodist_tpu.analysis.passes import (DETERMINISM_PASSES,
+                                                  LOCKSTEP_PASSES,
                                                   LOWERED_PASSES,
                                                   PASS_REGISTRY,
                                                   STATIC_PASSES,
@@ -295,7 +297,7 @@ def aot_compile_step(
         ctx.lowered_source = f"TPU lowering for {topology}"
         report = Report(strategy_id=strategy.id)
         for pass_name in (STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
-                          + LOCKSTEP_PASSES):
+                          + LOCKSTEP_PASSES + DETERMINISM_PASSES):
             report.extend(PASS_REGISTRY[pass_name](ctx))
         logging.info("AOT strategy verification:\n%s", report)
         report.raise_for_errors()

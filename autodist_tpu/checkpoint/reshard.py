@@ -223,14 +223,30 @@ def _restore_template(sess, t, manifest):
 def _verify_restored(sess, batch_shapes, raise_on_error=True):
     """The post-reshard gate: the re-planned schedule must verify clean
     BEFORE the first step runs (Y-codes statically; with batch shapes the
-    full trace tier plus the X-code HLO audit of the new lowering)."""
-    from autodist_tpu.analysis import (LOWERED_PASSES, STATIC_PASSES,
-                                       TRACE_PASSES, verify_transformer)
+    full trace tier plus the X-code HLO audit of the new lowering and
+    the N-code determinism audit — the restored schedule's determinism
+    class bounds what "EXACT" can mean for the R->R' transition)."""
+    from autodist_tpu.analysis import (DETERMINISM_PASSES, LOWERED_PASSES,
+                                       STATIC_PASSES, TRACE_PASSES,
+                                       verify_transformer)
 
     passes = STATIC_PASSES if batch_shapes is None else \
-        STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
+        STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES + DETERMINISM_PASSES
     report = verify_transformer(sess._t, batch_shapes,
                                 donate=sess._donate, passes=passes)
+    summary = next((f.data for f in report.findings
+                    if f.code == "N006" and f.data), None)
+    if summary is not None:
+        from autodist_tpu.analysis.determinism_audit import \
+            determinism_class
+
+        logging.info(
+            "Post-restore determinism class: %s (resharded equivalence "
+            "holds %s)", determinism_class(summary),
+            {"bitwise": "bitwise",
+             "reduction_order": "up to reduction order",
+             "stochastic": "in expectation (PRNG draws present)"}[
+                 determinism_class(summary)])
     if report.findings:
         logging.info("Post-restore verification:\n%s", report)
     if raise_on_error:

@@ -34,6 +34,7 @@ from autodist_tpu.kernel.synchronization import all_reduce as ar_sync
 from autodist_tpu.model_item import path_name
 from autodist_tpu.ops.sparse import replica_axis_context
 from autodist_tpu.utils import logging
+from autodist_tpu.utils.rng import host_key
 
 
 class _SpecBox:
@@ -876,7 +877,7 @@ class GraphTransformer:
             for key, base in ar_sync.init_compressor_states(
                 self.buckets).items()}
         rng_shapes = jax.eval_shape(
-            lambda: rng if rng is not None else jax.random.PRNGKey(0))
+            lambda: rng if rng is not None else host_key(0))
         mut_shapes = (jax.eval_shape(lambda: self.model_item.mutable_state)
                       if self.model_item.mutable_state is not None else None)
 
@@ -981,7 +982,7 @@ class GraphTransformer:
             "mutable": (fresh(self.model_item.mutable_state)
                         if self.model_item.mutable_state is not None else None),
             "step": jax.device_put(jnp.zeros((), jnp.int32), rep),
-            "rng": fresh(rng if rng is not None else jax.random.PRNGKey(0)),
+            "rng": fresh(rng if rng is not None else host_key(0)),
         }
         return state
 

@@ -34,6 +34,7 @@ import jax
 import numpy as np
 
 from autodist_tpu.utils import logging
+from autodist_tpu.utils.rng import host_key
 
 
 class TokenBarrier:
@@ -234,7 +235,7 @@ class AsyncPSSession:
         self._lock = threading.Lock()
         self._has_rng = bool(has_rng)
         self._has_aux = bool(has_aux)
-        self._base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._base_rng = rng if rng is not None else host_key(0)
         self._grad = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
         self._apply = jax.jit(lambda g, st, p: optimizer.update(g, st, p))
         self.staleness = int(staleness)

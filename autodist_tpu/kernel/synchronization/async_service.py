@@ -44,6 +44,7 @@ import numpy as np
 
 from autodist_tpu.kernel.synchronization.async_ps import TokenBarrier
 from autodist_tpu.utils import logging
+from autodist_tpu.utils.rng import host_key
 
 _EXPOSED = ("pull", "push", "may_start", "advance", "stats")
 
@@ -294,7 +295,7 @@ class AsyncPSClusterSession:
         (default True there) blocks until every worker has pushed its
         ``steps`` steps so the returned params include every
         contribution."""
-        base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        base_rng = rng if rng is not None else host_key(0)
         step_base = self._step_base
 
         def _rng_for_step(i):

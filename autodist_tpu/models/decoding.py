@@ -17,13 +17,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from autodist_tpu.utils.rng import host_key
 
 
 @functools.lru_cache(maxsize=64)
 def _cache_shapes(model, B):
     """Zero KV-cache template per (module, batch) WITHOUT materializing a
     full parameter init: eval_shape gives the structure abstractly."""
-    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+    shapes = jax.eval_shape(model.init, host_key(0),
                             jnp.zeros((B, 1), jnp.int32))["cache"]
     return jax.tree.map(lambda s: (tuple(s.shape), s.dtype), shapes,
                         is_leaf=lambda s: hasattr(s, "shape"))
@@ -115,6 +116,6 @@ def generate(model, max_position, params, prompt, max_new_tokens,
     buf0 = np.zeros((B, total), np.int32)
     buf0[:, :P] = prompt
     cache = fresh_cache(model, B)
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else host_key(0)
     rollout = _make_rollout(model, total, float(temperature))
     return rollout(params, cache, jnp.asarray(buf0), jnp.int32(P), rng)

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import optax
 
 from autodist_tpu.const import BATCH_MASK_KEY
+from autodist_tpu.utils.rng import host_key
 
 
 def softmax_cross_entropy(logits, labels, mask=None):
@@ -26,7 +27,7 @@ def classifier_capture(model, input_shape, rng=None, with_batch_stats=True):
     ``loss_fn`` follows the framework convention for models with mutable
     state: ``loss_fn(params, state, batch) -> (loss, new_state)``.
     """
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else host_key(0)
     variables = model.init(rng, jnp.zeros((1,) + tuple(input_shape)), train=False)
     params = variables["params"]
     state = {k: v for k, v in variables.items() if k != "params"}
@@ -57,7 +58,7 @@ def bert_capture(config, seq_len, rng=None):
     """
     from autodist_tpu.models.bert import BertForPreTraining, pretraining_loss
 
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else host_key(0)
     model = BertForPreTraining(config)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     params = model.init(rng, dummy, deterministic=True)["params"]
@@ -102,7 +103,7 @@ def gpt_capture(config, seq_len, rng=None, streaming_loss=False,
     from autodist_tpu.models.gpt import GPT, gpt_loss
     from autodist_tpu.ops.losses import streaming_softmax_xent
 
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else host_key(0)
     model = GPT(config)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     # return_hidden at init: the param tree is identical (all params are
@@ -147,7 +148,7 @@ def llama_capture(config, seq_len, rng=None, streaming_loss=False,
     from autodist_tpu.models.llama import Llama, llama_loss
     from autodist_tpu.ops.losses import streaming_softmax_xent
 
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else host_key(0)
     model = Llama(config)
     dummy = jnp.zeros((1, seq_len), jnp.int32)
     # see gpt_capture: identical param tree, no init-time logits tensor
@@ -179,7 +180,7 @@ def lm_capture(config, seq_len, rng=None):
     from autodist_tpu.models.lm import LSTMBody, lm_loss
     from autodist_tpu.ops.sparse import embedding_lookup
 
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else host_key(0)
     c = config
     body = LSTMBody(c)
     k_emb, k_body = jax.random.split(rng)
@@ -199,7 +200,7 @@ def lm_capture(config, seq_len, rng=None):
 def ncf_capture(config, rng=None):
     from autodist_tpu.models.ncf import NeuMF, ncf_loss
 
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else host_key(0)
     model = NeuMF(config)
     dummy = jnp.zeros((1,), jnp.int32)
     params = model.init(rng, dummy, dummy)["params"]

@@ -296,22 +296,24 @@ class DistributedSession:
 
     def _verify_gbatch(self, gbatch, hbm_bytes_per_device=None,
                        raise_on_error=True):
-        from autodist_tpu.analysis import (LOCKSTEP_PASSES, LOWERED_PASSES,
+        from autodist_tpu.analysis import (DETERMINISM_PASSES,
+                                           LOCKSTEP_PASSES, LOWERED_PASSES,
                                            STATIC_PASSES, TRACE_PASSES,
                                            verify_transformer)
 
         batch_shapes = jax.tree.map(
             lambda x: (tuple(x.shape), x.dtype), gbatch)
-        # all four static tiers: the lowered audits (X-codes / F-codes)
-        # surface realized reshards and compute waste, and the lockstep
-        # tier (L-codes) proves the schedule deadlock-free rank by rank,
-        # BEFORE the first step runs
+        # all five static tiers: the lowered audits (X-codes / F-codes)
+        # surface realized reshards and compute waste, the lockstep tier
+        # (L-codes) proves the schedule deadlock-free rank by rank, and
+        # the determinism tier (N-codes) proves key independence + shard
+        # disjointness, BEFORE the first step runs
         report = verify_transformer(
             self._t, batch_shapes, donate=self._donate,
             hbm_bytes_per_device=(hbm_bytes_per_device
                                   or self._verify_budget),
             passes=STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
-            + LOCKSTEP_PASSES)
+            + LOCKSTEP_PASSES + DETERMINISM_PASSES)
         if report.findings:
             logging.info("Strategy verification:\n%s", report)
         if raise_on_error:

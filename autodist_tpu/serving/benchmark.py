@@ -17,6 +17,7 @@ record), ``BENCH_SERVE=1 bench.py`` (attaches it to the round's JSON),
 ``tools/perf_gate.py`` (re-measures and gates).
 """
 import time
+from autodist_tpu.utils.rng import host_key
 
 SERVE_PROXY_METRIC = "serving_decode_overhead"
 SERVE_RECORD_NAME = "gpt_tiny_serve_decode"
@@ -44,7 +45,7 @@ def measure_serve_decode(num_slots=NUM_SLOTS, max_total=MAX_TOTAL,
 
     cfg = GPT_TINY
     model = GPT(cfg, decode=True)
-    params = model.init(jax.random.PRNGKey(0),
+    params = model.init(host_key(0),
                         np.zeros((1, 1), np.int32))["params"]
     n = jax.device_count()
     ad = AutoDist(resource_spec=ResourceSpec.from_num_chips(n))

@@ -31,6 +31,7 @@ import numpy as np
 from autodist_tpu.serving.admission import AdmissionQueue, BatchPolicy
 from autodist_tpu.serving.slots import SLOT_AXIS, SlotTable, plan_slots
 from autodist_tpu.utils import logging
+from autodist_tpu.utils.rng import host_key
 
 
 class ServingEngine:
@@ -111,7 +112,7 @@ class ServingEngine:
         self._bufs = self._place_table(
             jnp.zeros((S, self.max_total), jnp.int32))
         self._rngs = self._place_table(jnp.stack(
-            [jax.random.PRNGKey(self._rng_seed + i) for i in range(S)]))
+            [host_key(self._rng_seed + i) for i in range(S)]))
         # host mirrors: positions advance deterministically (+1 per
         # active step), so the control loop never fetches them back
         self._ts = np.zeros(S, np.int32)
@@ -234,7 +235,7 @@ class ServingEngine:
             slot = self.table.alloc(req.rid)
             assert slot is not None  # admissible() respected free count
             req.slot = slot
-            rng = jax.random.PRNGKey(self._rng_seed + req.rid)
+            rng = host_key(self._rng_seed + req.rid)
             if self.prefill_devices:
                 cache_one, buf_row, rng = self._prefill(req, rng)
                 cache_one, buf_row, rng = self._place_replicated(

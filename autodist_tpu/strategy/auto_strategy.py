@@ -194,7 +194,8 @@ class AutoStrategy(StrategyBuilder):
         ceiling in the ``auto_strategy.predicted_mfu_ceiling`` gauge, so
         the screening pipeline prices realized-FLOP waste (recompute,
         lowering-added work) before a single step runs."""
-        from autodist_tpu.analysis import (LOCKSTEP_PASSES, LOWERED_PASSES,
+        from autodist_tpu.analysis import (DETERMINISM_PASSES,
+                                           LOCKSTEP_PASSES, LOWERED_PASSES,
                                            STATIC_PASSES,
                                            StrategyVerificationError,
                                            verify_strategy)
@@ -207,8 +208,9 @@ class AutoStrategy(StrategyBuilder):
                 strategy, model_item, resource_spec,
                 batch_shapes=self._audit_shapes,
                 hbm_bytes_per_device=self._hbm_budget,
-                passes=STATIC_PASSES + LOWERED_PASSES + LOCKSTEP_PASSES)
-            bad = {"X001", "X002", "L001", "L004"} & \
+                passes=STATIC_PASSES + LOWERED_PASSES + LOCKSTEP_PASSES
+                + DETERMINISM_PASSES)
+            bad = {"X001", "X002", "L001", "L004", "N001", "N003"} & \
                 set(report.error_codes())
             audit = next((f.data for f in report.findings
                           if f.code == "X006"), None)

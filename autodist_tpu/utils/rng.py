@@ -1,0 +1,59 @@
+"""Blessed PRNG key construction (the N-code determinism contract).
+
+Every key the engine or a model threads into a stochastic op derives
+from exactly three constructors, so the determinism audit
+(:mod:`autodist_tpu.analysis.determinism_audit`) can prove key
+independence statically instead of trusting call sites:
+
+- :func:`host_key` — the ONE place in ``autodist_tpu/`` allowed to call
+  ``jax.random.PRNGKey`` (lint AD14 confines raw key construction here);
+  it names the host-level root of every derivation chain.
+- :func:`replica_key` — folds ``axis_index`` over the data axes into a
+  key INSIDE a ``shard_map`` body.  This is the N005 predicate made
+  constructive: the fold's operand is axis-varying, so the lineage
+  tracker proves the derived key differs per replica (independent
+  dropout masks / noise across data-parallel replicas) at trace time —
+  no run needed.
+- :func:`step_key` — folds the step counter so two steps never reuse a
+  stream (the scan-iteration leg of N002).
+
+The engine's own step path (``GraphTransformer._spmd_step``) composes
+all three folds — ``fold_in(fold_in(fold_in(rng, step), axis_index),
+micro_idx)`` — which is why the GPT/BERT dropout masks are
+replica-varying under DP meshes (pinned by
+``tests/test_determinism_audit.py``).  Composed pipeline/tensor/expert
+axes (ROADMAP item 1) must derive their per-stage / per-expert keys the
+same way: ``replica_key(key, ("stage", "expert"))`` keeps the N-code
+gate green by construction.
+"""
+import jax
+
+
+def host_key(seed=0):
+    """The blessed host-level root key (the one raw ``PRNGKey`` site).
+
+    ``host_key(seed)`` is bit-identical to ``jax.random.PRNGKey(seed)``,
+    so migrating a call site never changes sampled values — it only
+    routes construction through the module the AD14 lint pins.
+    """
+    return jax.random.PRNGKey(seed)
+
+
+def replica_key(key, axis):
+    """Derive a per-replica key inside a ``shard_map`` body.
+
+    ``axis`` is a mesh axis name or a tuple of names; tuple axes
+    linearize through :func:`autodist_tpu.parallel.collectives.axis_index`
+    (``idx = idx * size(a) + axis_index(a)``), so every device on the
+    composed axis gets a distinct fold operand.  The fold operand is
+    axis-varying, which is exactly the lineage proof N001/N005 look for.
+    """
+    from autodist_tpu.parallel.collectives import axis_index
+
+    return jax.random.fold_in(key, axis_index(axis))
+
+
+def step_key(key, step):
+    """Derive a per-step key (no stream reuse across steps / scan
+    iterations — the N002 contract)."""
+    return jax.random.fold_in(key, step)

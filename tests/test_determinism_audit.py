@@ -1,0 +1,476 @@
+"""PRNG & determinism auditor (autodist_tpu/analysis/determinism_audit.py).
+
+Covers the combined lineage + varying-axes walk (roots, splits, fold_ins,
+indexed children), each N-code's fire/clean pair (N001 replicated key,
+N002 reuse + scan staleness, N003 batch-shard coverage, N004 order-hazard
+scatters, N005 missing axis-fold warning), the determinism-class lattice,
+the two seeded fixtures' exact code sets, the engine's own dropout key
+threading (clean by construction), the N001/N003 remediations, the
+AutoStrategy demotion path, and the AD14 lint rule.
+"""
+import importlib.util
+import os
+import pathlib
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.analysis import (DETERMINISM_PASSES, LOWERED_PASSES,
+                                   STATIC_PASSES, TRACE_PASSES, Severity,
+                                   StrategyVerificationError,
+                                   verify_strategy)
+from autodist_tpu.analysis.cases import (
+    EXPECTED_DETERMINISM_DROPOUT_CODE, EXPECTED_DETERMINISM_SHARD_CODE,
+    build_replicated_dropout_case, build_shard_overlap_case)
+from autodist_tpu.analysis.determinism_audit import (_State, _Val, _walk,
+                                                     batch_coverage,
+                                                     determinism_audit_pass,
+                                                     determinism_class)
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DET_CHAIN = STATIC_PASSES + TRACE_PASSES + DETERMINISM_PASSES
+
+
+def _ctx(jaxpr, axis_sizes, transformer=None):
+    return types.SimpleNamespace(
+        jaxpr=jaxpr, transformer=transformer, strategy=None,
+        axis_sizes=dict(axis_sizes), axis_names=tuple(axis_sizes))
+
+
+def _codes(findings):
+    return sorted({f.code for f in findings})
+
+
+def _errors(findings):
+    return sorted({f.code for f in findings if int(f.severity) >= 2})
+
+
+def _mesh8():
+    return Mesh(np.array(jax.devices()[:8]), ("replica",))
+
+
+def _smap(body, in_specs=P("replica"), out_specs=P()):
+    f = jax.shard_map(body, mesh=_mesh8(), in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+    return jax.make_jaxpr(f)(jnp.zeros((8, 4)))
+
+
+# -- the lineage walk --------------------------------------------------------
+
+
+def test_walk_builds_root_split_index_lineage():
+    def f(x):
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (4,))
+        b = jax.random.normal(k2, (4,))
+        return jnp.sum(a) + jnp.sum(b) + jnp.sum(x)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,)))
+    state = _State(("replica",))
+    _walk(state, jaxpr, [_Val()])
+    ops = {r["op"] for r in state.labels.values()}
+    assert "seed" in ops and "split" in ops
+    sites = list(state.sites.values())
+    assert len(sites) == 2
+    # the two draws consume DISTINCT derived streams
+    assert sites[0]["label"] != sites[1]["label"]
+    # every derived row names its parent back toward the seed root
+    derived = [r for r in state.labels.values() if r["op"] != "seed"]
+    assert all(r["parent"] for r in derived)
+
+
+def test_split_streams_are_independent_no_n002():
+    def f(x):
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        return (jnp.sum(jax.random.normal(k1, (4,)))
+                + jnp.sum(jax.random.uniform(k2, (4,))) + jnp.sum(x))
+
+    findings = determinism_audit_pass(
+        _ctx(jax.make_jaxpr(f)(jnp.zeros((4,))), {"replica": 8}))
+    assert _errors(findings) == []
+
+
+# -- N001 / N005: replicated keys in sharded bodies --------------------------
+
+
+def test_n001_replicated_key_feeding_per_replica_dropout():
+    def body(x):
+        key = jax.random.PRNGKey(0)
+        mask = jax.random.bernoulli(key, 0.9, x.shape)
+        return jax.lax.pmean(jnp.mean(jnp.where(mask, x, 0.0)), "replica")
+
+    findings = determinism_audit_pass(_ctx(_smap(body), {"replica": 8}))
+    assert _errors(findings) == ["N001"]
+    (f,) = [f for f in findings if f.code == "N001"]
+    assert "replica" in f.message and f.data["applied_per_replica"]
+
+
+def test_n001_clean_when_axis_index_is_folded_in():
+    def body(x):
+        key = jax.random.fold_in(jax.random.PRNGKey(0),
+                                 jax.lax.axis_index("replica"))
+        mask = jax.random.bernoulli(key, 0.9, x.shape)
+        return jax.lax.pmean(jnp.mean(jnp.where(mask, x, 0.0)), "replica")
+
+    findings = determinism_audit_pass(_ctx(_smap(body), {"replica": 8}))
+    assert _errors(findings) == []
+    (n6,) = [f for f in findings if f.code == "N006"]
+    assert all(c["replica_derived"] for c in n6.data["consumptions"])
+    assert n6.data["determinism_class"] == "stochastic"
+
+
+def test_n005_warns_on_unfolded_key_not_applied_to_data():
+    def body(x):
+        noise = jax.random.normal(jax.random.PRNGKey(7), (4,))
+        return (jnp.mean(noise)
+                + jax.lax.pmean(jnp.mean(x), "replica"))
+
+    findings = determinism_audit_pass(_ctx(_smap(body), {"replica": 8}))
+    assert _errors(findings) == []
+    assert "N005" in _codes(findings)
+
+
+def test_n001_silent_on_unsharded_mesh():
+    def f(x):
+        mask = jax.random.bernoulli(jax.random.PRNGKey(0), 0.9, x.shape)
+        return jnp.mean(jnp.where(mask, x, 0.0))
+
+    findings = determinism_audit_pass(
+        _ctx(jax.make_jaxpr(f)(jnp.zeros((4,))), {"replica": 1}))
+    assert _errors(findings) == []
+
+
+# -- N002: stream reuse ------------------------------------------------------
+
+
+def test_n002_two_draws_from_one_key():
+    def f(x):
+        key = jax.random.PRNGKey(0)
+        a = jax.random.normal(key, (4,))
+        b = jax.random.uniform(key, (8,))
+        return jnp.sum(a) + jnp.sum(b) + jnp.sum(x)
+
+    findings = determinism_audit_pass(
+        _ctx(jax.make_jaxpr(f)(jnp.zeros((4,))), {"replica": 8}))
+    assert _errors(findings) == ["N002"]
+    (f2,) = [f for f in findings if f.code == "N002"]
+    assert f2.data["consumptions"] == 2
+
+
+def test_n002_loop_invariant_key_inside_scan():
+    def f(x):
+        key = jax.random.PRNGKey(0)
+
+        def step(c, _):
+            return c + jnp.sum(jax.random.normal(key, (2,))), None
+
+        c, _ = jax.lax.scan(step, 0.0, None, length=4)
+        return c + jnp.sum(x)
+
+    findings = determinism_audit_pass(
+        _ctx(jax.make_jaxpr(f)(jnp.zeros((4,))), {"replica": 8}))
+    assert _errors(findings) == ["N002"]
+    (f2,) = [f for f in findings if f.code == "N002"]
+    assert f2.data.get("kind") == "scan_reuse"
+
+
+def test_n002_clean_when_iteration_index_folded():
+    def f(x):
+        key = jax.random.PRNGKey(0)
+
+        def step(c, i):
+            k = jax.random.fold_in(key, i)
+            return c + jnp.sum(jax.random.normal(k, (2,))), None
+
+        c, _ = jax.lax.scan(step, 0.0, jnp.arange(4))
+        return c + jnp.sum(x)
+
+    findings = determinism_audit_pass(
+        _ctx(jax.make_jaxpr(f)(jnp.zeros((4,))), {"replica": 8}))
+    assert _errors(findings) == []
+
+
+# -- N003: batch-shard coverage ----------------------------------------------
+
+
+def test_batch_coverage_overlap_gap_and_clean():
+    assert batch_coverage(P("replica"), ("replica",), {"replica": 8}) \
+        == ([], [])
+    assert batch_coverage(P(), ("replica",), {"replica": 8}) \
+        == (["replica"], [])
+    assert batch_coverage(P("model"), ("replica",),
+                          {"replica": 8, "model": 2}) \
+        == (["replica"], ["model"])
+    # grouped spec entries and size-1 axes
+    assert batch_coverage(P(("dcn", "ici")), ("dcn", "ici"),
+                          {"dcn": 2, "ici": 4}) == ([], [])
+    assert batch_coverage(None, ("replica",), {"replica": 1}) == ([], [])
+
+
+def test_n003_pass_reports_overlap_and_suggests_spec():
+    t = types.SimpleNamespace(batch_spec=P(), data_axes=("replica",))
+    findings = determinism_audit_pass(_ctx(None, {"replica": 8}, t))
+    assert _errors(findings) == ["N003"]
+    (f,) = [f for f in findings if f.code == "N003"]
+    assert f.data["kind"] == "overlap"
+    assert f.data["suggested_batch_spec"] == ["replica"]
+    (n6,) = [f for f in findings if f.code == "N006"]
+    assert n6.data["shard_overlap"] == ["replica"]
+
+
+def test_n003_pass_reports_gap_axis():
+    t = types.SimpleNamespace(batch_spec=P("model"),
+                              data_axes=("replica",))
+    findings = determinism_audit_pass(
+        _ctx(None, {"replica": 8, "model": 2}, t))
+    kinds = {f.data["kind"] for f in findings if f.code == "N003"}
+    assert kinds == {"overlap", "gap"}
+
+
+# -- N004: order-hazard scatters ---------------------------------------------
+
+
+def test_n004_colliding_scatter_in_bitwise_contract():
+    def f(x, idx):
+        return jnp.zeros((8,)).at[idx].add(x)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,)),
+                              jnp.zeros((4,), jnp.int32))
+    findings = determinism_audit_pass(_ctx(jaxpr, {"replica": 8}))
+    assert "N004" in _codes(findings)
+    (n6,) = [f for f in findings if f.code == "N006"]
+    assert n6.data["determinism_class"] == "reduction_order"
+    assert n6.data["nondeterministic_sites"]
+
+
+def test_n004_suppressed_when_strategy_is_already_stochastic():
+    def f(x, idx):
+        noise = jax.random.normal(jax.random.PRNGKey(0), (4,))
+        return jnp.zeros((8,)).at[idx].add(x + noise)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros((4,)),
+                              jnp.zeros((4,), jnp.int32))
+    findings = determinism_audit_pass(_ctx(jaxpr, {"replica": 8}))
+    assert "N004" not in _codes(findings)
+    (n6,) = [f for f in findings if f.code == "N006"]
+    assert n6.data["determinism_class"] == "stochastic"
+
+
+def test_n000_skip_when_nothing_attached():
+    findings = determinism_audit_pass(_ctx(None, {}))
+    assert _codes(findings) == ["N000"]
+
+
+# -- the class lattice -------------------------------------------------------
+
+
+def test_determinism_class_joins_to_weakest():
+    assert determinism_class("bitwise") == "bitwise"
+    assert determinism_class(None) == "bitwise"
+    assert determinism_class("bitwise", "stochastic") == "stochastic"
+    assert determinism_class("reduction_order", "bitwise") \
+        == "reduction_order"
+    # an unknown contract degrades conservatively
+    assert determinism_class("garbage") == "stochastic"
+
+
+def test_determinism_class_bitwise_pair_needs_same_schedule():
+    a = {"determinism_class": "bitwise", "schedule_fingerprint": "f1"}
+    same = {"determinism_class": "bitwise", "schedule_fingerprint": "f1"}
+    other = {"determinism_class": "bitwise", "schedule_fingerprint": "f2"}
+    assert determinism_class(a, same) == "bitwise"
+    # a different reduction tree legally rounds differently
+    assert determinism_class(a, other) == "reduction_order"
+    assert determinism_class(a, {"determinism_class": "stochastic"}) \
+        == "stochastic"
+
+
+# -- the seeded fixtures -----------------------------------------------------
+
+
+@pytest.mark.parametrize("build,want", [
+    (build_replicated_dropout_case, EXPECTED_DETERMINISM_DROPOUT_CODE),
+    (build_shard_overlap_case, EXPECTED_DETERMINISM_SHARD_CODE),
+])
+def test_seeded_fixture_fires_exactly_its_code(build, want):
+    kw = build()
+    report = verify_strategy(passes=DET_CHAIN, **kw)
+    assert set(report.error_codes()) == {want}
+    # and stays clean under every pre-existing tier
+    clean = verify_strategy(
+        passes=STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES, **kw)
+    assert clean.ok, clean.error_codes()
+
+
+def test_n006_table_on_a_clean_strategy():
+    params = {"w": jnp.zeros((64, 64))}
+
+    def loss_fn(p, batch):
+        h = batch["x"] @ p["w"]
+        return jnp.mean(h * h) + 1e-6 * jnp.sum(jnp.square(p["w"]))
+
+    item = ModelItem(loss_fn, params, optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(8)
+    report = verify_strategy(AllReduce().build(item, spec), item, spec,
+                             passes=DET_CHAIN,
+                             batch_shapes={"x": ((128, 64), "float32")})
+    assert report.ok, report.error_codes()
+    (n6,) = [f for f in report.findings if f.code == "N006"]
+    t = n6.data
+    assert t["determinism_class"] in ("bitwise", "reduction_order")
+    assert t["shard_overlap"] == [] and t["shard_gap"] == []
+    assert t["schedule_fingerprint"]
+    assert t["data_axes"]
+    # a draw-free step promises bits back on re-run
+    assert not t["consumptions"]
+
+
+def test_engine_dropout_key_threading_is_replica_derived():
+    """Satellite pin: the engine's own has_rng path (fold_in(step) ->
+    fold_in(axis_index) -> fold_in(micro_idx)) keeps a GPT-with-dropout
+    step off the N001/N005 path — every flax dropout draw's lineage is
+    replica-derived by construction."""
+    from autodist_tpu.models import GPTConfig, train_lib
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=2, intermediate_size=32, max_position=32,
+                    dropout_rate=0.1, dtype=jnp.float32)
+    loss_fn, params, sparse = train_lib.gpt_capture(cfg, 16)
+    item = ModelItem(loss_fn, params, optax.adam(1e-3),
+                     sparse_vars=sparse, has_rng=True)
+    spec = ResourceSpec.from_num_chips(8)
+    report = verify_strategy(
+        AllReduce().build(item, spec), item, spec, passes=DET_CHAIN,
+        batch_shapes={"tokens": ((16, 16), "int32"),
+                      "targets": ((16, 16), "int32")})
+    assert report.ok, report.error_codes()
+    assert "N005" not in [f.code for f in report.findings]
+    (n6,) = [f for f in report.findings if f.code == "N006"]
+    t = n6.data
+    assert t["determinism_class"] == "stochastic"
+    assert t["consumptions"]
+    axes = set(t["data_axes"])
+    for c in t["consumptions"]:
+        assert c["replica_derived"] or (set(c["varying"]) & axes), c
+
+
+# -- remediation + AutoStrategy demotion -------------------------------------
+
+
+def test_remediations_for_n001_and_n003():
+    from autodist_tpu.analysis.remediation import suggest_remediations
+    from autodist_tpu.analysis.report import Finding, Report
+
+    rep = Report(strategy_id="x")
+    rep.extend([
+        Finding(Severity.ERROR, "N003", "determinism-audit", "overlap",
+                data={"suggested_batch_spec": ["replica"]}),
+        Finding(Severity.ERROR, "N001", "determinism-audit", "replicated",
+                data={"varying": []}),
+    ])
+    rems = suggest_remediations(rep)
+    # correctness repairs lead the suggestion order
+    assert [r.code for r in rems] == ["N001", "N003"]
+    assert rems[0].kind == "model"
+    assert rems[0].knob == {"rng": "replica_key"}
+    assert rems[1].kind == "engine"
+    assert rems[1].knob == {"batch_spec": ["replica"]}
+    assert "replica" in rems[1].action
+
+
+def test_auto_strategy_demotes_n001(monkeypatch):
+    """A candidate whose audit reports a replicated stochastic key is
+    demoted exactly like an X001 plan divergence."""
+    import autodist_tpu.analysis as analysis
+    from autodist_tpu.analysis.report import Finding, Report
+    from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+    def fake_verify(*args, **kwargs):
+        rep = Report(strategy_id="fake")
+        rep.extend([Finding(Severity.ERROR, "N001", "determinism-audit",
+                            "replicated key feeds a stochastic op")])
+        return rep
+
+    monkeypatch.setattr(analysis, "verify_strategy", fake_verify)
+    params = {"w": jnp.zeros((16, 16))}
+    item = ModelItem(lambda p, b: jnp.sum(jnp.square(p["w"])), params,
+                     optax.adam(1e-3))
+    spec = ResourceSpec.from_num_chips(8)
+    auto = AutoStrategy(candidates=[AllReduce()],
+                        audit_batch_shapes={"x": ((16, 16), "float32")})
+    with pytest.raises(StrategyVerificationError):
+        auto.build(item, spec)
+    ((_name, rep),) = auto.last_rejected
+    assert rep.error_codes() == ["N001"]
+
+
+# -- AD14 lint rule ----------------------------------------------------------
+
+
+def _lint_snippet(tmp_path, relpath, source):
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [code for _p, _ln, code, _m in lint.lint_file(p)]
+
+
+_AD14_RAW = ("import jax\n"
+             "k = jax.random.PRNGKey(0)\n")
+_AD14_NEWSTYLE = ("import jax\n"
+                  "k = jax.random.key(0)\n")
+_AD14_FROM = ("from jax.random import PRNGKey\n"
+              "k = PRNGKey(0)\n")
+_AD14_BLESSED = ("from autodist_tpu.utils.rng import host_key\n"
+                 "k = host_key(0)\n")
+
+
+def test_ad14_flags_raw_key_construction_in_package(tmp_path):
+    assert "AD14" in _lint_snippet(
+        tmp_path, "autodist_tpu/models/foo.py", _AD14_RAW)
+    assert "AD14" in _lint_snippet(
+        tmp_path, "autodist_tpu/models/foo.py", _AD14_NEWSTYLE)
+    assert "AD14" in _lint_snippet(
+        tmp_path, "autodist_tpu/serving/foo.py", _AD14_FROM)
+    # '# noqa' suppresses a justified raw key (the seeded fixtures)
+    assert "AD14" not in _lint_snippet(
+        tmp_path, "autodist_tpu/models/foo.py",
+        _AD14_RAW.replace("(0)\n", "(0)  # noqa: seeded fixture\n"))
+
+
+def test_ad14_exempts_blessed_site_and_out_of_scope(tmp_path):
+    assert "AD14" not in _lint_snippet(
+        tmp_path, "autodist_tpu/utils/rng.py", _AD14_RAW)
+    assert "AD14" not in _lint_snippet(tmp_path, "tools/t.py", _AD14_RAW)
+    assert "AD14" not in _lint_snippet(tmp_path, "tests/t.py", _AD14_RAW)
+    # the blessed wrapper is a plain Name call: never flagged
+    assert "AD14" not in _lint_snippet(
+        tmp_path, "autodist_tpu/models/foo.py", _AD14_BLESSED)
+
+
+def test_repo_is_ad14_clean():
+    spec = importlib.util.spec_from_file_location(
+        "lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    findings = []
+    for dirpath, _dirs, files in os.walk(
+            os.path.join(REPO, "autodist_tpu")):
+        for f in files:
+            if f.endswith(".py"):
+                findings += [x for x in lint.lint_file(
+                    pathlib.Path(dirpath) / f) if x[2] == "AD14"]
+    assert findings == []

@@ -158,6 +158,20 @@ codebase:
         Scoped to ``autodist_tpu/`` and ``tools/``; the three blessed
         accounting sites are exempt.
 
+  AD14  raw PRNG key construction (``jax.random.PRNGKey`` /
+        ``jax.random.key``) in ``autodist_tpu/`` outside the blessed
+        derivation site ``utils/rng.py`` (``host_key`` /
+        ``replica_key`` / ``step_key``).  A locally minted key is
+        invisible to the N-code determinism audit's lineage contract:
+        ``host_key`` names the root the key table reports, and
+        ``replica_key`` is the fold_in(axis_index) derivation that
+        keeps a per-replica stochastic op off the N001 path —
+        hand-rolled construction is exactly how a replicated key
+        reaches a dropout mask.  Deliberate raw keys (seeded
+        determinism fixtures) carry ``# noqa`` with a justification.
+        Scoped to ``autodist_tpu/``; tools and tests seed keys
+        legitimately.
+
 Exit code 1 when any finding is reported.
 """
 import ast
@@ -343,6 +357,23 @@ def _ad13_applies(path):
         and p.name not in _AD13_EXEMPT
 
 
+# AD14 applies inside autodist_tpu/ only; utils/rng.py IS the blessed
+# key-derivation site (host_key wraps the one PRNGKey the package is
+# allowed), and tools/tests seed raw keys legitimately
+_AD14_EXEMPT = "rng.py"
+_AD14_MSG = ("raw PRNG key construction ({what}) outside utils/rng.py: "
+             "mint roots with host_key and derive per-replica/per-step "
+             "streams with replica_key/step_key so the N-code "
+             "determinism audit's key-lineage contract (N001/N006) "
+             "stays provable; '# noqa' with a justification for seeded "
+             "determinism fixtures")
+
+
+def _ad14_applies(path):
+    p = Path(path)
+    return "autodist_tpu" in p.parts and p.name != _AD14_EXEMPT
+
+
 class Checker(ast.NodeVisitor):
     def __init__(self, path, source):
         self.path = path
@@ -358,6 +389,7 @@ class Checker(ast.NodeVisitor):
         self._flop_ctx = 0     # AD03: inside a flops-named def/assign
         self._bytes_ctx = []   # AD13: hbm/roofline/traffic-named context
         self._statistics_names = set()  # AD12: names from statistics
+        self._prngkey_names = set()  # AD14: PRNGKey/key from jax.random
         self._stat_ctx = 0     # AD12: inside a median/quantile-named def
         self._ad12_seen = set()  # call nodes already flagged via subscript
 
@@ -390,6 +422,8 @@ class Checker(ast.NodeVisitor):
                 self._lax_ppermute_names.add(a.asname or a.name)  # AD11
             if node.module == "statistics" and a.name in _AD12_STAT_FNS:
                 self._statistics_names.add(a.asname or a.name)  # AD12
+            if node.module == "jax.random" and a.name in ("PRNGKey", "key"):
+                self._prngkey_names.add(a.asname or a.name)  # AD14
             self._record_import(a.asname or a.name, node.lineno)
 
     def visit_Name(self, node):
@@ -638,6 +672,23 @@ class Checker(ast.NodeVisitor):
                          f"(serving/slots.py) so byte/block accounting, "
                          f"shard layout and occupancy telemetry stay "
                          f"authoritative")
+        # AD14: raw PRNG key construction — key minting must route
+        # through utils/rng.py (host_key/replica_key/step_key) so the
+        # N-code determinism audit's lineage contract stays provable
+        if _ad14_applies(self.path):
+            what = ""
+            if isinstance(f, ast.Attribute) and f.attr == "PRNGKey":
+                what = "jax.random.PRNGKey"
+            elif (isinstance(f, ast.Attribute) and f.attr == "key"
+                    and ((isinstance(f.value, ast.Attribute)
+                          and f.value.attr == "random")
+                         or (isinstance(f.value, ast.Name)
+                             and f.value.id == "random"))):
+                what = "jax.random.key"
+            elif isinstance(f, ast.Name) and f.id in self._prngkey_names:
+                what = f"{f.id} (from jax.random)"
+            if what:
+                self.add(node.lineno, "AD14", _AD14_MSG.format(what=what))
         # AD11: raw lax.ppermute outside the blessed permutation sites —
         # the kernel/collectives.py wrapper validates the perm first
         if _ad11_applies(self.path):

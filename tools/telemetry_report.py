@@ -286,16 +286,26 @@ def load_audit(path):
     """Extract per-phase intended/realized byte tables from an audit
     artifact: a ``verify_strategy --hlo --json`` report (X006 findings
     carry the table in ``data``) or a bare ``AutoStrategy.last_audit``
-    dict dump.  Returns ``[(name, table), ...]``."""
+    dict dump.  When the same report carries the determinism audit's
+    N006 key-lineage summary, the strategy's determinism class rides
+    along under the table's ``"determinism_class"`` key so the rendered
+    verdict says what "matches the plan" can mean bitwise.
+    Returns ``[(name, table), ...]``."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict) and "intended" in doc and "realized" in doc:
         return [(doc.get("strategy", os.path.basename(path)), doc)]
     out = []
     for name, report in (doc.items() if isinstance(doc, dict) else []):
+        det = next((f.get("data", {}).get("determinism_class")
+                    for f in report.get("findings", [])
+                    if f.get("code") == "N006" and f.get("data")), None)
         for finding in report.get("findings", []):
             if finding.get("code") == "X006" and finding.get("data"):
-                out.append((os.path.basename(name), finding["data"]))
+                table = dict(finding["data"])
+                if det and "determinism_class" not in table:
+                    table["determinism_class"] = det
+                out.append((os.path.basename(name), table))
     return out
 
 
@@ -417,9 +427,11 @@ def render_audit(audits, summary=None):
         intended = table.get("intended", {})
         realized = table.get("realized", {})
         predicted = table.get("predicted", {})
+        det = table.get("determinism_class")
         lines.append(f"HLO audit — {name} "
                      f"({table.get('n_collectives', '?')} collective(s), "
-                     f"{table.get('source', 'lowered module')}):")
+                     f"{table.get('source', 'lowered module')}"
+                     + (f", determinism: {det}" if det else "") + "):")
         for phase in sorted(set(intended) | set(realized) | set(predicted)):
             row = (f"  {phase:12s} intended {_fmt_bytes(int(intended.get(phase, 0)))}"
                    f"  realized {_fmt_bytes(int(realized.get(phase, 0)))}")

@@ -41,6 +41,16 @@ Targets:
   machine-readable L006 per-rank trace table; with ``--selftest``, the
   seeded broken-ring case must fire exactly L003 and the seeded
   divergent-cond case exactly L001 (both clean under every other pass).
+- ``--determinism`` — run the DETERMINISM tier (N-codes): each target's
+  PRNG key lineage (the split/fold_in derivation graph joined with the
+  varying-axes analysis), its batch_spec x mesh shard coverage, and the
+  lowered module's order-hazard scatters are audited — a replicated key
+  feeding a per-replica stochastic op is N001, key-stream reuse N002, a
+  batch-shard overlap/gap N003 — and every target must emit its N006
+  key-lineage table with the strategy's determinism class (``bitwise |
+  reduction_order | stochastic``); with ``--selftest``, the seeded
+  replicated-dropout case must fire exactly N001 and the seeded
+  shard-overlap case exactly N003 (both clean under every other pass).
 - ``--regression`` — run the cross-run REGRESSION tier (R-codes): each
   record target is diffed against its blessed baseline in
   ``records/baselines/<name>.json`` (throughput/engine-overhead R001,
@@ -202,6 +212,12 @@ def main(argv=None):
                          "every rank's ordered rendezvous trace and "
                          "prove it deadlock-free; every target must "
                          "emit its L006 per-rank trace table")
+    ap.add_argument("--determinism", action="store_true",
+                    help="also run the DETERMINISM tier (N-codes): PRNG "
+                         "key lineage, batch-shard coverage, and lowered "
+                         "order-hazard scatters; every target must emit "
+                         "its N006 key-lineage table with the strategy's "
+                         "determinism class")
     ap.add_argument("--suggest", action="store_true",
                     help="map each report's F-code findings to concrete "
                          "strategy/engine deltas (analysis.remediation): "
@@ -263,29 +279,31 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     _force_cpu_devices()
-    from autodist_tpu.analysis import (EVENT_PASSES, FLEET_PASSES,
-                                       LOCKSTEP_PASSES, LOWERED_PASSES,
-                                       POSTMORTEM_PASSES, REGRESSION_PASSES,
-                                       RUNTIME_PASSES, SERVING_PASSES,
-                                       STATIC_PASSES, TRACE_PASSES,
-                                       verify_strategy)
+    from autodist_tpu.analysis import (DETERMINISM_PASSES, EVENT_PASSES,
+                                       FLEET_PASSES, LOCKSTEP_PASSES,
+                                       LOWERED_PASSES, POSTMORTEM_PASSES,
+                                       REGRESSION_PASSES, RUNTIME_PASSES,
+                                       SERVING_PASSES, STATIC_PASSES,
+                                       TRACE_PASSES, verify_strategy)
     from autodist_tpu.analysis.cases import (
-        EXPECTED_AUDIT_ERROR_CODE, EXPECTED_DONATION_CODE,
+        EXPECTED_AUDIT_ERROR_CODE, EXPECTED_DETERMINISM_DROPOUT_CODE,
+        EXPECTED_DETERMINISM_SHARD_CODE, EXPECTED_DONATION_CODE,
         EXPECTED_ERROR_CODES, EXPECTED_LOCKSTEP_DIVERGENT_CODE,
         EXPECTED_LOCKSTEP_RING_CODE, EXPECTED_PRECISION_CODE,
         EXPECTED_RECOMPUTE_CODE, build_divergent_cond_collective_case,
         build_dropped_donation_case, build_f32_contraction_case,
         build_ppermute_ring_case, build_recompute_case,
-        build_rejected_case, build_reshard_case)
+        build_rejected_case, build_replicated_dropout_case,
+        build_reshard_case, build_shard_overlap_case)
 
     if args.suggest:
         # remediation consumes the compute audit's F-codes
         args.compute = args.compute or not args.hlo
 
-    if (args.hlo or args.compute or args.lockstep
+    if (args.hlo or args.compute or args.lockstep or args.determinism
             or args.runtime is not None) and args.static_only:
-        ap.error("--hlo/--compute/--lockstep/--runtime need the traced "
-                 "step; drop --static-only")
+        ap.error("--hlo/--compute/--lockstep/--determinism/--runtime "
+                 "need the traced step; drop --static-only")
 
     hbm_bytes = int(args.hbm_gib * 1024 ** 3)
     if args.device_kind:
@@ -308,6 +326,10 @@ def main(argv=None):
         base = passes if passes is not None else \
             STATIC_PASSES + TRACE_PASSES
         passes = base + LOCKSTEP_PASSES
+    if args.determinism:
+        base = passes if passes is not None else \
+            STATIC_PASSES + TRACE_PASSES
+        passes = base + DETERMINISM_PASSES
     if args.runtime is not None:
         base = passes if passes is not None else \
             STATIC_PASSES + TRACE_PASSES + LOWERED_PASSES
@@ -341,6 +363,9 @@ def main(argv=None):
     # with the lockstep tier selected, every record target must produce
     # its machine-readable L006 per-rank trace table
     want_l006 = bool(passes) and "lockstep-audit" in passes
+    # with the determinism tier selected, every record target must
+    # produce its machine-readable N006 key-lineage table
+    want_n006 = bool(passes) and "determinism-audit" in passes
     # with a lowered compute pass selected, every record target must
     # produce its machine-readable F006 compute table
     want_f006 = bool(passes) and "compute-audit" in passes
@@ -509,6 +534,18 @@ def main(argv=None):
             if l6 is None:
                 print(f"[ERROR] {os.path.basename(path)}: lockstep "
                       f"verifier produced no L006 trace table")
+                failed = True
+        if want_n006:
+            n6 = next((f for f in report.findings if f.code == "N006"),
+                      None)
+            if n6 is None:
+                print(f"[ERROR] {os.path.basename(path)}: determinism "
+                      f"audit produced no N006 key-lineage table")
+                failed = True
+            elif n6.data.get("determinism_class") not in (
+                    "bitwise", "reduction_order", "stochastic"):
+                print(f"[ERROR] {os.path.basename(path)}: N006 carries "
+                      f"no determinism class")
                 failed = True
         if want_p005:
             p5 = next((f for f in report.findings if f.code == "P005"),
@@ -710,6 +747,29 @@ def main(argv=None):
                 else:
                     print(f"lockstep selftest passed: the {label} case "
                           f"is {want} and nothing else")
+        if args.determinism:
+            # the two seeded determinism cases: clean under every other
+            # pass, each caught by the N-code tier as EXACTLY its own
+            # code — the replicated in-step dropout key as N001, the
+            # replicated batch_spec as N003
+            for label, build, want in (
+                    ("replicated-dropout", build_replicated_dropout_case,
+                     EXPECTED_DETERMINISM_DROPOUT_CODE),
+                    ("shard-overlap", build_shard_overlap_case,
+                     EXPECTED_DETERMINISM_SHARD_CODE)):
+                report = verify_strategy(passes=passes, **build())
+                results[f"<determinism-{label}-selftest>"] = report
+                _print_report(f"determinism selftest (expected {want})",
+                              report, args.verbose)
+                got = set(report.error_codes())
+                if got != {want}:
+                    print(f"[ERROR] determinism selftest ({label}): "
+                          f"expected exactly {{{want!r}}} as the ERROR "
+                          f"set (got {sorted(got)})")
+                    failed = True
+                else:
+                    print(f"determinism selftest passed: the {label} "
+                          f"case is {want} and nothing else")
         if args.regression:
             # the golden regression fixtures (tests/data/regression):
             # the seeded slow manifest must fire R001, the NaN manifest
